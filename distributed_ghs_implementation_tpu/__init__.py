@@ -1,0 +1,44 @@
+"""TPU-native distributed minimum-spanning-tree framework.
+
+A brand-new framework with the capabilities of the reference GHS implementation
+(``Trisanu-007/Distributed_GHS_Implementation``): exact MSTs of weighted graphs,
+NetworkX weight parity, graph generation/partitioning tooling, experiment
+harness, and visualization — redesigned TPU-first.
+
+Instead of the reference's per-vertex message passing (one thread or MPI rank
+per graph vertex, ``/root/reference/ghs_implementation.py:46-116`` and
+``ghs_implementation_mpi.py:40-115``), the GHS protocol is recast as a batched
+Borůvka-style graph-contraction kernel: the TEST/ACCEPT/REJECT minimum-outgoing-
+edge search becomes a ``segment_min`` over an edge list, the CONNECT/INITIATE/
+CHANGEROOT fragment merge becomes pointer-jumping union-find, and levels run in
+an on-device ``lax.while_loop``, with edges shardable over a TPU mesh and
+per-level minima combined over ICI.
+
+Public API (mirrors the reference surface, ``ghs_implementation.py:416-442``):
+
+    >>> from distributed_ghs_implementation_tpu import GHSAlgorithm
+    >>> mst = GHSAlgorithm(num_nodes, edges).run()
+
+or the functional form:
+
+    >>> from distributed_ghs_implementation_tpu import minimum_spanning_tree
+"""
+
+from distributed_ghs_implementation_tpu.api import (
+    GHSAlgorithm,
+    MSTResult,
+    minimum_spanning_forest,
+    minimum_spanning_tree,
+)
+from distributed_ghs_implementation_tpu.graphs.edgelist import Graph
+
+__version__ = "0.1.0"
+
+__all__ = [
+    "GHSAlgorithm",
+    "Graph",
+    "MSTResult",
+    "minimum_spanning_forest",
+    "minimum_spanning_tree",
+    "__version__",
+]

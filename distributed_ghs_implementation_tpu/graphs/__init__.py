@@ -1,0 +1,32 @@
+"""Graph data layer: in-memory edge lists, generators, and on-disk formats.
+
+Covers the reference's L0 data layer (``create_graph_files.py``,
+``create_simple_test.py``) — generation, vertex partitioning, persistence —
+rebuilt around dense NumPy arrays that feed the TPU kernel directly.
+"""
+
+from distributed_ghs_implementation_tpu.graphs.edgelist import Graph
+from distributed_ghs_implementation_tpu.graphs.generators import (
+    erdos_renyi_graph,
+    line_graph,
+    reference_random_graph,
+    rmat_graph,
+    simple_test_graph,
+)
+from distributed_ghs_implementation_tpu.graphs.io import (
+    read_dimacs,
+    read_partition_dir,
+    write_partition_dir,
+)
+
+__all__ = [
+    "Graph",
+    "erdos_renyi_graph",
+    "line_graph",
+    "read_dimacs",
+    "read_partition_dir",
+    "reference_random_graph",
+    "rmat_graph",
+    "simple_test_graph",
+    "write_partition_dir",
+]

@@ -1,0 +1,199 @@
+"""Dense edge-list graph container feeding the batched MST kernel.
+
+The reference keeps graphs as NetworkX objects plus per-vertex adjacency dicts
+(``/root/reference/ghs_implementation.py:417-429``,
+``ghs_implementation_mpi.py:74-92``). Here the canonical form is three NumPy
+arrays ``(u, v, w)`` of undirected edges, from which we derive the *interleaved
+directed layout* the kernel consumes: undirected edge ``e = (a, b, w)`` becomes
+directed slots ``2e = a->b`` and ``2e+1 = b->a``. The interleaving makes the
+global directed-slot order agree with undirected-edge order, so per-fragment
+minimum-outgoing-edge tie-breaking by directed slot id is a *total order on
+undirected edges* — the property that guarantees Borůvka hooking only ever
+forms 2-cycles (deterministic, race-free merges; contrast the reference's
+symmetric-CONNECT dedup workarounds at ``ghs_implementation_mpi.py:217-230``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Iterable, Sequence, Tuple
+
+import numpy as np
+
+# Weights are int64 on the host for exactness; the device kernel picks int32 or
+# float32 per graph (int weights below 2**31 stay exact end to end).
+_INT_DTYPES = (np.int8, np.int16, np.int32, np.int64, np.uint8, np.uint16, np.uint32)
+
+
+@dataclasses.dataclass(frozen=True)
+class Graph:
+    """An undirected weighted graph as dense arrays.
+
+    Attributes:
+      num_nodes: vertex count ``n``; vertices are ``0..n-1``.
+      u, v, w: parallel arrays of undirected edges (``u[i] < v[i]`` after
+        canonicalization). ``w`` is int64 or float64 on the host.
+    """
+
+    num_nodes: int
+    u: np.ndarray
+    v: np.ndarray
+    w: np.ndarray
+
+    @property
+    def num_edges(self) -> int:
+        return int(self.u.shape[0])
+
+    @property
+    def is_integer_weighted(self) -> bool:
+        return self.w.dtype.kind in "iu"
+
+    @property
+    def total_weight(self):
+        return self.w.sum()
+
+    # ------------------------------------------------------------------
+    # Construction
+    # ------------------------------------------------------------------
+    @staticmethod
+    def from_edges(
+        num_nodes: int,
+        edges: Iterable[Tuple[int, int, float]] | np.ndarray,
+        *,
+        dedup: bool = True,
+    ) -> "Graph":
+        """Build from an iterable of ``(u, v, weight)`` triples.
+
+        Self-loops are dropped; parallel edges keep the minimum weight when
+        ``dedup`` (an MST never uses the heavier duplicate). Mirrors the edge
+        list accepted by the reference driver
+        (``ghs_implementation.py:416-429``).
+        """
+        arr = np.asarray(list(edges) if not isinstance(edges, np.ndarray) else edges)
+        if arr.size == 0:
+            e = np.zeros(0, dtype=np.int64)
+            return Graph(int(num_nodes), e, e.copy(), np.zeros(0, dtype=np.int64))
+        if arr.ndim != 2 or arr.shape[1] != 3:
+            raise ValueError(f"edges must be (m, 3) triples, got shape {arr.shape}")
+        u = arr[:, 0].astype(np.int64)
+        v = arr[:, 1].astype(np.int64)
+        wcol = arr[:, 2]
+        if np.all(wcol == np.floor(wcol)):
+            w = wcol.astype(np.int64)
+        else:
+            w = wcol.astype(np.float64)
+        return Graph.from_arrays(num_nodes, u, v, w, dedup=dedup)
+
+    @staticmethod
+    def from_arrays(
+        num_nodes: int,
+        u: np.ndarray,
+        v: np.ndarray,
+        w: np.ndarray,
+        *,
+        dedup: bool = True,
+    ) -> "Graph":
+        """Build from parallel arrays; canonicalizes, drops loops, dedups."""
+        num_nodes = int(num_nodes)
+        u = np.asarray(u)
+        v = np.asarray(v)
+        w = np.asarray(w)
+        if (
+            min(u.min(initial=0), v.min(initial=0)) < 0
+            or max(u.max(initial=-1), v.max(initial=-1)) >= num_nodes
+        ):
+            raise ValueError("edge endpoint out of range")
+        lo = np.minimum(u, v).astype(np.int64)
+        hi = np.maximum(u, v).astype(np.int64)
+        keep = lo != hi  # drop self-loops
+        lo, hi, w = lo[keep], hi[keep], w[keep]
+        if dedup and lo.size:
+            # Keep min weight per (lo, hi) pair: stable sort by (lo, hi, w).
+            order = np.lexsort((w, hi, lo))
+            lo, hi, w = lo[order], hi[order], w[order]
+            first = np.ones(lo.size, dtype=bool)
+            first[1:] = (lo[1:] != lo[:-1]) | (hi[1:] != hi[:-1])
+            lo, hi, w = lo[first], hi[first], w[first]
+        if w.dtype.kind in "iu":
+            w = w.astype(np.int64)
+        else:
+            w = w.astype(np.float64)
+        return Graph(num_nodes, lo, hi, w)
+
+    @staticmethod
+    def from_networkx(g) -> "Graph":
+        """Convert a ``networkx.Graph`` with ``weight`` edge attributes."""
+        edges = [(a, b, d.get("weight", 1)) for a, b, d in g.edges(data=True)]
+        return Graph.from_edges(g.number_of_nodes(), edges)
+
+    # ------------------------------------------------------------------
+    # Views
+    # ------------------------------------------------------------------
+    def edge_triples(self) -> list:
+        """Edges as ``[(u, v, w), ...]`` with Python scalars."""
+        return [
+            (int(a), int(b), (int(c) if self.is_integer_weighted else float(c)))
+            for a, b, c in zip(self.u, self.v, self.w)
+        ]
+
+    def to_networkx(self):
+        import networkx as nx
+
+        g = nx.Graph()
+        g.add_nodes_from(range(self.num_nodes))
+        g.add_weighted_edges_from(self.edge_triples())
+        return g
+
+    def device_weight_dtype(self) -> np.dtype:
+        """Pick the on-device weight dtype (int32 when exact, else float32)."""
+        if self.is_integer_weighted and (
+            self.w.size == 0
+            or (self.w.min() > np.iinfo(np.int32).min and self.w.max() < np.iinfo(np.int32).max)
+        ):
+            return np.dtype(np.int32)
+        return np.dtype(np.float32)
+
+    def directed_arrays(
+        self, *, pad_to: int | None = None
+    ) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+        """Interleaved directed layout ``(src, dst, w)`` of length ``2m``.
+
+        Slot ``2e`` is ``u[e]->v[e]``, slot ``2e+1`` is ``v[e]->u[e]``; the
+        undirected id of slot ``s`` is ``s >> 1``. Optionally right-pads to
+        ``pad_to`` slots with inert self-edges of sentinel weight so sharded
+        runs get equal per-device shapes without recompilation.
+        """
+        m = self.num_edges
+        n2 = 2 * m
+        wd = self.device_weight_dtype()
+        sentinel = np.iinfo(wd).max if wd.kind == "i" else np.inf
+        size = n2 if pad_to is None else int(pad_to)
+        if size < n2:
+            raise ValueError(f"pad_to={pad_to} < 2*m={n2}")
+        src = np.zeros(size, dtype=np.int32)
+        dst = np.zeros(size, dtype=np.int32)
+        w = np.full(size, sentinel, dtype=wd)
+        src[0:n2:2] = self.u
+        dst[0:n2:2] = self.v
+        src[1:n2:2] = self.v
+        dst[1:n2:2] = self.u
+        w[0:n2:2] = self.w.astype(wd)
+        w[1:n2:2] = self.w.astype(wd)
+        # Padding rows are self-edges (src == dst == 0): never outgoing, inert.
+        return src, dst, w
+
+    def csr(self) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+        """CSR adjacency over directed slots: ``(indptr, dst, w)`` sorted by src."""
+        src, dst, w = self.directed_arrays()
+        order = np.argsort(src, kind="stable")
+        src, dst, w = src[order], dst[order], w[order]
+        indptr = np.zeros(self.num_nodes + 1, dtype=np.int64)
+        np.add.at(indptr, src + 1, 1)
+        np.cumsum(indptr, out=indptr)
+        return indptr, dst, w
+
+    def degrees(self) -> np.ndarray:
+        deg = np.zeros(self.num_nodes, dtype=np.int64)
+        np.add.at(deg, self.u, 1)
+        np.add.at(deg, self.v, 1)
+        return deg

@@ -1,0 +1,213 @@
+"""Graph generators: Erdős–Rényi, RMAT, fixtures.
+
+Covers the reference's generator component (C8,
+``/root/reference/create_graph_files.py:13-40`` and
+``ghs_implementation.py:702-721``) plus the large-scale RMAT generator needed
+for the benchmark configs in ``BASELINE.json`` (the reference has nothing at
+that scale — its envelope is ~10 vertices).
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import numpy as np
+
+from distributed_ghs_implementation_tpu.graphs.edgelist import Graph
+
+
+def _connect_components(u: np.ndarray, v: np.ndarray, num_nodes: int) -> Tuple[np.ndarray, np.ndarray]:
+    """Append edges linking connected components until the graph is connected."""
+    parent = np.arange(num_nodes, dtype=np.int64)
+
+    def find(x: int) -> int:
+        while parent[x] != x:
+            parent[x] = parent[parent[x]]
+            x = int(parent[x])
+        return x
+
+    for a, b in zip(u, v):
+        ra, rb = find(int(a)), find(int(b))
+        if ra != rb:
+            parent[ra] = rb
+    roots = sorted({find(i) for i in range(num_nodes)})
+    extra_u, extra_v = [], []
+    for a, b in zip(roots[:-1], roots[1:]):
+        extra_u.append(a)
+        extra_v.append(b)
+        parent[find(a)] = find(b)
+    if extra_u:
+        u = np.concatenate([u, np.asarray(extra_u, dtype=u.dtype)])
+        v = np.concatenate([v, np.asarray(extra_v, dtype=v.dtype)])
+    return u, v
+
+
+def erdos_renyi_graph(
+    num_nodes: int,
+    edge_probability: float,
+    *,
+    seed: int = 0,
+    weight_low: int = 1,
+    weight_high: int = 10,
+    ensure_connected: bool = True,
+) -> Graph:
+    """G(n, p) with integer weights in ``[weight_low, weight_high]``.
+
+    Vectorized NumPy sampling (the reference loops through NetworkX,
+    ``create_graph_files.py:18-34``); connectivity is guaranteed by linking
+    leftover components with a union-find sweep rather than resampling.
+    Deterministic for a given seed.
+    """
+    rng = np.random.default_rng(seed)
+    n = int(num_nodes)
+    if n < 1:
+        raise ValueError("num_nodes must be >= 1")
+    if n > 32768:
+        raise ValueError(
+            "erdos_renyi_graph materializes all n(n-1)/2 pairs; "
+            "use gnm_random_graph or rmat_graph for large n"
+        )
+    iu, iv = np.triu_indices(n, k=1)
+    mask = rng.random(iu.size) < edge_probability
+    u, v = iu[mask].astype(np.int64), iv[mask].astype(np.int64)
+    if ensure_connected and n > 1:
+        u, v = _connect_components(u, v, n)
+    w = rng.integers(weight_low, weight_high + 1, size=u.size, dtype=np.int64)
+    return Graph.from_arrays(n, u, v, w)
+
+
+def gnm_random_graph(
+    num_nodes: int,
+    num_edges: int,
+    *,
+    seed: int = 0,
+    weight_low: int = 1,
+    weight_high: int = 10,
+    ensure_connected: bool = True,
+) -> Graph:
+    """G(n, m): ``num_edges`` distinct edges sampled uniformly (BASELINE config 2)."""
+    rng = np.random.default_rng(seed)
+    n = int(num_nodes)
+    m = int(num_edges)
+    if m > n * (n - 1) // 2:
+        raise ValueError(f"num_edges={m} exceeds the {n*(n-1)//2} distinct pairs")
+    # Oversample pair codes then dedup; retry until we have m distinct pairs.
+    want = m
+    codes = np.zeros(0, dtype=np.int64)
+    while codes.size < want:
+        a = rng.integers(0, n, size=2 * (want - codes.size) + 16, dtype=np.int64)
+        b = rng.integers(0, n, size=a.size, dtype=np.int64)
+        keep = a != b
+        lo = np.minimum(a[keep], b[keep])
+        hi = np.maximum(a[keep], b[keep])
+        codes = np.unique(np.concatenate([codes, lo * n + hi]))
+    rng.shuffle(codes)
+    codes = codes[:want]
+    u, v = codes // n, codes % n
+    if ensure_connected and n > 1:
+        u, v = _connect_components(u, v, n)
+    w = rng.integers(weight_low, weight_high + 1, size=u.size, dtype=np.int64)
+    return Graph.from_arrays(n, u, v, w)
+
+
+def reference_random_graph(
+    num_nodes: int = 6, edge_probability: float = 0.5, seed: int = 42
+) -> Graph:
+    """Reproduce the reference generator's exact sampling behavior.
+
+    Same observable behavior as ``create_graph_files.py:13-40`` /
+    ``ghs_implementation.py:702-721``: NetworkX Erdős–Rényi seeded with
+    ``seed``, resample with ``random.randint``-derived seeds until connected,
+    then ``random.randint(1, 10)`` weights in edge-iteration order. Lets tests
+    compare against the reference's own experiment configs
+    (``ghs_implementation.py:787-794``) graph-for-graph.
+    """
+    import random
+
+    import networkx as nx
+
+    random.seed(seed)
+    g = nx.erdos_renyi_graph(num_nodes, edge_probability, seed=seed)
+    attempts = 0
+    while not nx.is_connected(g) and attempts < 100:
+        g = nx.erdos_renyi_graph(num_nodes, edge_probability, seed=random.randint(0, 10000))
+        attempts += 1
+    if not nx.is_connected(g):
+        comps = list(nx.connected_components(g))
+        for i in range(len(comps) - 1):
+            g.add_edge(list(comps[i])[0], list(comps[i + 1])[0])
+    for a, b in g.edges():
+        g[a][b]["weight"] = random.randint(1, 10)
+    return Graph.from_networkx(g)
+
+
+def rmat_graph(
+    scale: int,
+    edge_factor: int = 16,
+    *,
+    seed: int = 1,
+    a: float = 0.57,
+    b: float = 0.19,
+    c: float = 0.19,
+    weight_low: int = 1,
+    weight_high: int = 255,
+    dedup: bool = True,
+) -> Graph:
+    """Graph500-style RMAT: ``2**scale`` vertices, ``edge_factor * 2**scale`` edges.
+
+    Fully vectorized recursive quadrant sampling — one ``(scale, m)`` random
+    block per bit level. RMAT-20 (~16M directed samples) generates in seconds
+    on the host; the C++ ingestion path covers RMAT-24 (see
+    ``distributed_ghs_implementation_tpu/graphs/native.py``).
+    """
+    rng = np.random.default_rng(seed)
+    n = 1 << scale
+    m = int(edge_factor) << scale
+    u = np.zeros(m, dtype=np.int64)
+    v = np.zeros(m, dtype=np.int64)
+    for level in range(scale):
+        r1 = rng.random(m)
+        r2 = rng.random(m)
+        # First choose src bit with P(a+b of top) etc. Standard RMAT:
+        # quadrant probabilities (a, b, c, d) over (src_bit, dst_bit).
+        src_bit = r1 >= (a + b)
+        # dst bit conditional on src bit: P(dst|src=0) = b/(a+b), P(dst|src=1) = d/(c+d)
+        d = 1.0 - a - b - c
+        p_dst = np.where(src_bit, d / max(c + d, 1e-12), b / max(a + b, 1e-12))
+        dst_bit = r2 < p_dst
+        u = (u << 1) | src_bit
+        v = (v << 1) | dst_bit
+    w = rng.integers(weight_low, weight_high + 1, size=m, dtype=np.int64)
+    return Graph.from_arrays(n, u, v, w, dedup=dedup)
+
+
+def line_graph(num_nodes: int, *, weight: int = 1) -> Graph:
+    """Path 0-1-...-(n-1): the high-diameter worst case for level count."""
+    n = int(num_nodes)
+    u = np.arange(n - 1, dtype=np.int64)
+    v = u + 1
+    w = np.full(n - 1, weight, dtype=np.int64)
+    return Graph.from_arrays(n, u, v, w)
+
+
+def simple_test_graph() -> Graph:
+    """The reference's hand-written fixture: 3-node line, MST weight 3.
+
+    Mirrors ``create_simple_test.py:9-50`` (0-1 weight 1, 1-2 weight 2,
+    0-2 weight 3; MST = {(0,1), (1,2)}, total 3).
+    """
+    return Graph.from_edges(3, [(0, 1, 1), (1, 2, 2), (0, 2, 3)])
+
+
+def readme_sample_graph() -> Graph:
+    """The 6-node/9-edge sample from the reference README (MST weight 20).
+
+    Edges per ``README.md:43-49``; the documented MST is weight 20 with 5
+    edges (``README.md:52-61``) — the canonical end-to-end parity fixture.
+    """
+    edges = [
+        (0, 1, 1), (0, 2, 4), (1, 2, 2),
+        (1, 3, 5), (2, 3, 3), (2, 4, 7),
+        (3, 4, 6), (3, 5, 8), (4, 5, 9),
+    ]
+    return Graph.from_edges(6, edges)

@@ -1,0 +1,16 @@
+"""MST solver models.
+
+``boruvka`` is the flagship: the GHS protocol recast as batched Borůvka
+graph contraction, fully on-device. ``ghs_protocol`` (see
+``distributed_ghs_implementation_tpu/protocol``) is the message-level state
+machine for protocol-parity testing against the reference.
+"""
+
+from distributed_ghs_implementation_tpu.models.boruvka import (
+    BoruvkaState,
+    boruvka_level,
+    boruvka_solve,
+    make_solver,
+)
+
+__all__ = ["BoruvkaState", "boruvka_level", "boruvka_solve", "make_solver"]

@@ -1,0 +1,153 @@
+"""Batched Borůvka/GHS MST solver — the flagship model.
+
+The whole GHS protocol (``/root/reference/ghs_implementation.py:118-413``)
+runs here as one on-device loop. One *level* (the reference's round shape,
+SURVEY.md §3.4) is:
+
+  1. candidate filter — intra-fragment edges die (TEST -> REJECT analog),
+  2. ``fragment_moe`` — per-fragment minimum outgoing edge via two segment
+     minima (TEST/ACCEPT + REPORT convergecast analog),
+  3. ``hook_and_compress`` — symmetric-hook resolution + pointer jumping
+     (CONNECT/INITIATE/CHANGEROOT analog),
+  4. chosen slots are recorded as MST edges (BRANCH marking analog,
+     ``ghs_implementation.py:130-131``).
+
+Levels iterate in a ``lax.while_loop`` until no fragment has an outgoing edge
+— the multi-component-safe analog of root termination on ``best_weight ==
+inf`` (``ghs_implementation.py:316-320``). At most ``ceil(log2 n)`` levels run
+because every active fragment merges each level. Unlike the reference's
+thread/MPI races (wrong MSTs at 20+ vertices, SURVEY.md preamble), every step
+is deterministic: same graph in, identical MST out.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+from typing import NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from distributed_ghs_implementation_tpu.graphs.edgelist import Graph
+from distributed_ghs_implementation_tpu.ops.segment_ops import INT32_MAX, fragment_moe
+from distributed_ghs_implementation_tpu.ops.union_find import hook_and_compress
+
+
+class BoruvkaState(NamedTuple):
+    """Carried through the level loop (the analog of all per-node protocol
+    state — ``NodeState``/``level``/``fragment_id``/``best_edge`` at
+    ``ghs_implementation.py:55-66`` — flattened into three arrays)."""
+
+    fragment: jax.Array  # [n] int32: fragment (root) id per vertex
+    mst_slots: jax.Array  # [e2] bool: directed slots chosen as MST edges
+    level: jax.Array  # scalar int32: levels completed
+    progress: jax.Array  # scalar bool: did the last level merge anything
+
+
+def boruvka_level(
+    state: BoruvkaState,
+    src: jax.Array,
+    dst: jax.Array,
+    w: jax.Array,
+    *,
+    axis_name: str | None = None,
+) -> BoruvkaState:
+    """One GHS/Borůvka level over (optionally sharded) directed edge slots."""
+    fragment = state.fragment
+    has_moe, _, moe_slot, moe_dst_frag = fragment_moe(
+        fragment, src, dst, w, axis_name=axis_name
+    )
+    new_fragment = hook_and_compress(has_moe, moe_dst_frag, fragment)
+
+    # Record chosen slots. Sharded: each shard owns a contiguous global slot
+    # range and marks only winners that fall inside it.
+    e = src.shape[0]
+    if axis_name is None:
+        safe = jnp.where(has_moe, moe_slot, 0)
+        mst_slots = state.mst_slots.at[safe].max(has_moe)
+    else:
+        shard = jax.lax.axis_index(axis_name).astype(jnp.int32)
+        local = moe_slot - shard * e
+        mine = has_moe & (local >= 0) & (local < e)
+        safe = jnp.where(mine, local, 0)
+        mst_slots = state.mst_slots.at[safe].max(mine)
+
+    return BoruvkaState(
+        fragment=new_fragment,
+        mst_slots=mst_slots,
+        level=state.level + 1,
+        progress=jnp.any(has_moe),
+    )
+
+
+def _max_levels(num_nodes: int) -> int:
+    return max(1, math.ceil(math.log2(max(num_nodes, 2)))) + 1
+
+
+def boruvka_solve(
+    fragment0: jax.Array,
+    src: jax.Array,
+    dst: jax.Array,
+    w: jax.Array,
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Full single-device solve: ``(mst_slots[e2], fragment[n], levels)``.
+
+    Jit-friendly: fixed shapes, on-device ``while_loop``, no host sync inside.
+    """
+    n = fragment0.shape[0]
+    e2 = src.shape[0]
+    state = BoruvkaState(
+        fragment=fragment0,
+        mst_slots=jnp.zeros(e2, dtype=bool),
+        level=jnp.zeros((), jnp.int32),
+        progress=jnp.ones((), bool),
+    )
+    max_levels = _max_levels(n)
+
+    def cond(s: BoruvkaState):
+        return s.progress & (s.level < max_levels)
+
+    def body(s: BoruvkaState):
+        return boruvka_level(s, src, dst, w)
+
+    final = jax.lax.while_loop(cond, body, state)
+    return final.mst_slots, final.fragment, final.level
+
+
+@functools.lru_cache(maxsize=32)
+def make_solver(num_nodes: int, num_slots: int, weight_dtype: str):
+    """Compiled solver for a given shape; cached across same-shape graphs."""
+    del num_nodes, num_slots, weight_dtype  # cache key only; shapes come from args
+    return jax.jit(boruvka_solve)
+
+
+def _next_pow2(x: int) -> int:
+    return 1 << max(0, (x - 1)).bit_length()
+
+
+def solve_graph(graph: Graph, *, bucket_shapes: bool = True) -> Tuple[np.ndarray, np.ndarray, int]:
+    """Host entry: run the solver on a ``Graph``.
+
+    Returns ``(mst_edge_ids, fragment, levels)`` where ``mst_edge_ids`` are
+    indices into ``graph.u/v/w`` (undirected), sorted ascending.
+
+    ``bucket_shapes`` pads edge slots and the vertex array to powers of two so
+    graphs in the same size bucket share one compiled kernel (padding vertices
+    are isolated self-fragments; padding slots are inert self-edges).
+    """
+    n = graph.num_nodes
+    if n == 0 or graph.num_edges == 0:
+        return np.zeros(0, dtype=np.int64), np.arange(n, dtype=np.int32), 0
+    n_pad = _next_pow2(n) if bucket_shapes else n
+    e_pad = _next_pow2(2 * graph.num_edges) if bucket_shapes else None
+    src_np, dst_np, w_np = graph.directed_arrays(pad_to=e_pad)
+    solver = make_solver(n_pad, src_np.shape[0], str(w_np.dtype))
+    fragment0 = jnp.arange(n_pad, dtype=jnp.int32)
+    mst_slots, fragment, levels = solver(
+        fragment0, jnp.asarray(src_np), jnp.asarray(dst_np), jnp.asarray(w_np)
+    )
+    slots = np.nonzero(np.asarray(mst_slots))[0]
+    edge_ids = np.unique(slots >> 1)
+    return edge_ids, np.asarray(fragment)[:n], int(levels)

@@ -1,0 +1,26 @@
+"""Device kernels: segment reductions and union-find primitives.
+
+These are the TPU-native replacements for the reference's per-message handlers
+(``/root/reference/ghs_implementation.py:118-413``): the TEST/ACCEPT/REJECT +
+REPORT minimum-outgoing-edge search collapses into segment minima
+(``segment_ops``), and CONNECT/INITIATE/CHANGEROOT fragment merging collapses
+into hook-and-compress union-find (``union_find``).
+"""
+
+from distributed_ghs_implementation_tpu.ops.segment_ops import (
+    fragment_moe,
+    segment_min,
+)
+from distributed_ghs_implementation_tpu.ops.union_find import (
+    break_symmetric_hooks,
+    hook_and_compress,
+    pointer_jump,
+)
+
+__all__ = [
+    "break_symmetric_hooks",
+    "fragment_moe",
+    "hook_and_compress",
+    "pointer_jump",
+    "segment_min",
+]

@@ -1,0 +1,67 @@
+"""Hook-and-compress union-find: fragment merging as parallel pointer ops.
+
+The reference merges fragments with a CONNECT/INITIATE/CHANGEROOT message walk
+(``/root/reference/ghs_implementation.py:155-199,355-387``) and fights
+symmetric-merge races with dedup lists and sleeps
+(``ghs_implementation_mpi.py:217-230``). In the batched formulation each
+fragment *hooks* onto the fragment across its minimum outgoing edge; because
+every fragment picks its MOE by a shared total order (weight, then undirected
+edge id — see ``segment_ops``), the hook graph's only cycles are mutual pairs,
+which are broken deterministically (smaller id becomes the root). Pointer
+jumping then compresses every tree to a star in ``O(log depth)`` parallel
+steps — the reference's sequential CHANGEROOT root walk, made log-depth (the
+high-diameter answer demanded by SURVEY.md §5's long-context analog).
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+
+def break_symmetric_hooks(parent: jax.Array) -> jax.Array:
+    """Resolve mutual hooks ``f <-> g``: the smaller id becomes a self-root.
+
+    This is the deterministic replacement for the reference's symmetric-CONNECT
+    merge negotiation (``ghs_implementation_mpi.py:232-287``, where a
+    ``(fragment_id, rank)`` priority decides the initiator).
+    """
+    ids = jnp.arange(parent.shape[0], dtype=parent.dtype)
+    mutual = parent[parent] == ids
+    return jnp.where(mutual & (ids < parent), ids, parent)
+
+
+def pointer_jump(parent: jax.Array, *, num_iters: int | None = None) -> jax.Array:
+    """Compress a hook forest to stars: ``parent[f]`` becomes f's root.
+
+    ``num_iters`` defaults to ``ceil(log2 n) + 1`` — enough for any forest on
+    ``n`` vertices since each jump doubles pointer reach.
+    """
+    n = parent.shape[0]
+    if num_iters is None:
+        num_iters = max(1, math.ceil(math.log2(max(n, 2)))) + 1
+
+    def body(_, p):
+        return p[p]
+
+    return jax.lax.fori_loop(0, num_iters, body, parent)
+
+
+def hook_and_compress(
+    has_moe: jax.Array, moe_dst_frag: jax.Array, fragment: jax.Array
+) -> jax.Array:
+    """One merge round: hook every active fragment, compress, relabel vertices.
+
+    Returns the new ``fragment`` array where every vertex points at its merged
+    fragment's root id. Fragments with no outgoing edge (isolated components —
+    the root-termination case, ``ghs_implementation.py:316-320``) self-hook and
+    are left untouched.
+    """
+    n = fragment.shape[0]
+    ids = jnp.arange(n, dtype=fragment.dtype)
+    parent = jnp.where(has_moe, moe_dst_frag, ids)
+    parent = break_symmetric_hooks(parent)
+    parent = pointer_jump(parent)
+    return parent[fragment]

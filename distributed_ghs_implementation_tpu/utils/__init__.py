@@ -1,0 +1,9 @@
+"""Utilities: verification oracles, visualization, reporting, metrics."""
+
+from distributed_ghs_implementation_tpu.utils.verify import (
+    networkx_mst_weight,
+    scipy_mst_weight,
+    verify_result,
+)
+
+__all__ = ["networkx_mst_weight", "scipy_mst_weight", "verify_result"]

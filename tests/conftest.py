@@ -1,0 +1,23 @@
+"""Test environment: force CPU JAX with 8 virtual devices.
+
+Multi-chip sharding is validated on a virtual device mesh (the driver
+separately dry-runs ``__graft_entry__.dryrun_multichip``); the real TPU chip
+is exercised by ``bench.py``, not the unit suite.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def rng():
+    return np.random.default_rng(0)

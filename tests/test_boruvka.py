@@ -1,0 +1,169 @@
+"""Core solver correctness: the NetworkX oracle gate, upgraded to pytest.
+
+The reference verifies by ad-hoc comparison in its experiment loop
+(``/root/reference/ghs_implementation.py:746-756``); here the same oracle is an
+automated gate across fixtures, the reference's own 6 experiment configs, seed
+sweeps, determinism, and structural edge cases the reference cannot handle
+(disconnected graphs, single vertices, ties).
+"""
+
+import numpy as np
+import pytest
+
+from distributed_ghs_implementation_tpu.api import (
+    GHSAlgorithm,
+    minimum_spanning_forest,
+    minimum_spanning_tree,
+)
+from distributed_ghs_implementation_tpu.graphs.edgelist import Graph
+from distributed_ghs_implementation_tpu.graphs.generators import (
+    erdos_renyi_graph,
+    gnm_random_graph,
+    line_graph,
+    readme_sample_graph,
+    reference_random_graph,
+    rmat_graph,
+    simple_test_graph,
+)
+from distributed_ghs_implementation_tpu.utils.verify import (
+    networkx_mst_edges,
+    verify_result,
+)
+
+
+def test_readme_sample_exact_edges():
+    """The documented 6-node sample (README.md:43-64): unique MST, exact match."""
+    r = minimum_spanning_tree(readme_sample_graph())
+    assert r.total_weight == 20
+    assert sorted(r.edges) == [(0, 1), (1, 2), (2, 3), (3, 4), (3, 5)]
+    assert r.is_spanning_tree
+
+
+def test_simple_fixture():
+    """The reference's 3-node fixture (create_simple_test.py:9-50)."""
+    r = minimum_spanning_tree(simple_test_graph())
+    assert r.total_weight == 3
+    assert sorted(r.edges) == [(0, 1), (1, 2)]
+
+
+@pytest.mark.parametrize(
+    "num_nodes,edge_probability,seed",
+    [
+        (5, 0.5, 42),
+        (6, 0.4, 100),
+        (7, 0.6, 200),
+        (6, 0.7, 300),
+        (10, 0.8, 400),
+        (20, 0.3, 500),
+    ],
+)
+def test_reference_experiment_configs(num_nodes, edge_probability, seed):
+    """The reference's own 6 configs (ghs_implementation.py:787-794), on the
+    *same graphs* it generates — including the 20-node one it gets wrong."""
+    g = reference_random_graph(num_nodes, edge_probability, seed)
+    r = minimum_spanning_tree(g)
+    assert verify_result(r, oracle="networkx").ok
+
+
+@pytest.mark.parametrize("seed", range(10))
+@pytest.mark.parametrize("n,p", [(30, 0.15), (100, 0.08), (300, 0.03)])
+def test_er_sweep_weight_parity(n, p, seed):
+    g = erdos_renyi_graph(n, p, seed=seed)
+    r = minimum_spanning_forest(g)
+    assert verify_result(r, oracle="networkx").ok
+
+
+def test_gnm_baseline_config():
+    """BASELINE config 2: gnm_random_graph(1024, 8192)."""
+    g = gnm_random_graph(1024, 8192, seed=7)
+    r = minimum_spanning_forest(g)
+    assert verify_result(r).ok
+
+
+def test_unique_mst_exact_edge_set():
+    """With distinct weights the MST is unique: require exact edge equality."""
+    rng = np.random.default_rng(3)
+    n = 40
+    iu, iv = np.triu_indices(n, k=1)
+    keep = rng.random(iu.size) < 0.3
+    u, v = iu[keep], iv[keep]
+    w = rng.permutation(u.size) + 1  # all-distinct weights
+    g = Graph.from_arrays(n, u, v, w)
+    r = minimum_spanning_forest(g)
+    assert {tuple(e) for e in r.edges} == networkx_mst_edges(g)
+
+
+def test_heavy_ties():
+    """All-equal weights: any spanning tree is minimal; check count + weight."""
+    g = erdos_renyi_graph(60, 0.2, seed=9, weight_low=5, weight_high=5)
+    r = minimum_spanning_forest(g)
+    assert verify_result(r).ok
+
+
+def test_determinism():
+    """Same graph -> byte-identical MST (the reference is nondeterministic;
+    SURVEY.md measured 2/3 wrong runs at 20 nodes)."""
+    g = erdos_renyi_graph(80, 0.1, seed=12)
+    r1 = minimum_spanning_forest(g)
+    r2 = minimum_spanning_forest(g)
+    assert np.array_equal(r1.edge_ids, r2.edge_ids)
+
+
+def test_high_diameter_line():
+    """Path graph: worst-case diameter, still <= ceil(log2 n)+1 levels."""
+    n = 513
+    r = minimum_spanning_tree(line_graph(n))
+    assert r.num_edges == n - 1
+    assert r.num_levels <= 11
+
+
+def test_disconnected_forest():
+    """Two components: the reference deadlocks; we return a spanning forest."""
+    edges = [(0, 1, 1), (1, 2, 2), (3, 4, 1), (4, 5, 5), (3, 5, 2)]
+    g = Graph.from_edges(6, edges)
+    r = minimum_spanning_forest(g)
+    assert r.num_components == 2
+    assert r.num_edges == 4
+    assert r.total_weight == 1 + 2 + 1 + 2
+    with pytest.raises(ValueError):
+        minimum_spanning_tree(g)
+
+
+def test_trivial_graphs():
+    r = minimum_spanning_forest(Graph.from_edges(1, []))
+    assert r.num_edges == 0 and r.num_components == 1
+    r = minimum_spanning_forest(Graph.from_edges(2, [(0, 1, 7)]))
+    assert r.total_weight == 7
+
+
+def test_parallel_edges_and_self_loops():
+    g = Graph.from_edges(3, [(0, 1, 5), (1, 0, 2), (1, 2, 3), (2, 2, 1)])
+    assert g.num_edges == 2  # dedup kept min weight, loop dropped
+    r = minimum_spanning_forest(g)
+    assert r.total_weight == 5
+
+
+def test_float_weights():
+    rng = np.random.default_rng(5)
+    n = 50
+    iu, iv = np.triu_indices(n, k=1)
+    keep = rng.random(iu.size) < 0.2
+    g = Graph.from_arrays(n, iu[keep], iv[keep], rng.random(int(keep.sum())))
+    r = minimum_spanning_forest(g)
+    assert verify_result(r, atol=1e-4).ok
+
+
+def test_rmat_small_scipy_parity():
+    """RMAT scale-10 against the SciPy oracle (big-graph verification path)."""
+    g = rmat_graph(10, 8, seed=2)
+    r = minimum_spanning_forest(g)
+    assert verify_result(r, oracle="scipy").ok
+
+
+def test_ghs_algorithm_api():
+    """The reference driver surface: GHSAlgorithm(n, edges).run() -> pairs."""
+    edges = [(0, 1, 1), (0, 2, 4), (1, 2, 2), (1, 3, 5), (2, 3, 3)]
+    ghs = GHSAlgorithm(4, edges)
+    mst = ghs.run(timeout=15)  # timeout accepted for parity, unused
+    assert sorted(mst) == [(0, 1), (1, 2), (2, 3)]
+    assert ghs.get_mst_weight() == 6

@@ -1,0 +1,134 @@
+"""Data layer: generators, partition-dir interop with the reference format."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from distributed_ghs_implementation_tpu.graphs.edgelist import Graph
+from distributed_ghs_implementation_tpu.graphs.generators import (
+    erdos_renyi_graph,
+    gnm_random_graph,
+    line_graph,
+    rmat_graph,
+    simple_test_graph,
+)
+from distributed_ghs_implementation_tpu.graphs.io import (
+    read_dimacs,
+    read_npz,
+    read_partition_dir,
+    write_npz,
+    write_partition_dir,
+)
+
+
+def test_er_connected_deterministic():
+    g1 = erdos_renyi_graph(100, 0.05, seed=4)
+    g2 = erdos_renyi_graph(100, 0.05, seed=4)
+    assert np.array_equal(g1.u, g2.u) and np.array_equal(g1.w, g2.w)
+    import networkx as nx
+
+    assert nx.is_connected(g1.to_networkx())
+
+
+def test_gnm_edge_count():
+    g = gnm_random_graph(256, 1024, seed=1, ensure_connected=False)
+    assert g.num_edges == 1024
+    assert g.num_nodes == 256
+
+
+def test_rmat_shapes():
+    g = rmat_graph(8, 4, seed=3, dedup=False)
+    assert g.num_nodes == 256
+    # Dedup and loop-dropping shrink the raw 1024 samples.
+    assert 0 < g.num_edges <= 1024
+
+
+def test_partition_roundtrip(tmp_path):
+    g = erdos_renyi_graph(12, 0.4, seed=8)
+    d = write_partition_dir(g, str(tmp_path / "gdir"))
+    g2 = read_partition_dir(d)
+    assert g2.num_nodes == g.num_nodes
+    assert g2.edge_triples() == g.edge_triples()
+
+
+def test_partition_file_format_matches_reference(tmp_path):
+    """Field-for-field compatibility with create_graph_files.py:57-88."""
+    g = simple_test_graph()
+    d = write_partition_dir(g, str(tmp_path / "gdir"))
+    with open(os.path.join(d, "node_1.json")) as f:
+        node1 = json.load(f)
+    assert node1 == {
+        "node_id": 1,
+        "neighbors": {"0": 1, "2": 2},
+        "num_neighbors": 2,
+    }
+    with open(os.path.join(d, "graph_metadata.json")) as f:
+        meta = json.load(f)
+    assert meta["num_nodes"] == 3
+    assert meta["num_edges"] == 3
+    assert [0, 1, 1] in meta["edges"]
+
+
+def test_read_partition_from_node_files_only(tmp_path):
+    """MPINode-style reconstruction when metadata is absent
+    (ghs_implementation_mpi.py:74-92 reads only node files)."""
+    g = erdos_renyi_graph(8, 0.5, seed=2)
+    d = write_partition_dir(g, str(tmp_path / "gdir"))
+    os.remove(os.path.join(d, "graph_metadata.json"))
+    g2 = read_partition_dir(d)
+    assert g2.edge_triples() == g.edge_triples()
+
+
+def test_dimacs_reader(tmp_path):
+    p = tmp_path / "toy.gr"
+    p.write_text(
+        "c toy\np sp 4 10\n"
+        "a 1 2 5\na 2 1 5\na 2 3 2\na 3 2 2\na 3 4 7\na 4 3 7\na 1 4 1\na 4 1 1\n"
+        "a 1 3 9\na 3 1 9\n"
+    )
+    g = read_dimacs(str(p))
+    assert g.num_nodes == 4
+    assert g.num_edges == 5  # both-direction arcs collapsed
+    assert g.total_weight == 5 + 2 + 7 + 1 + 9
+
+
+def test_npz_roundtrip(tmp_path):
+    g = rmat_graph(6, 8, seed=5)
+    p = write_npz(g, str(tmp_path / "g.npz"))
+    g2 = read_npz(p)
+    assert g2.num_nodes == g.num_nodes
+    assert np.array_equal(g2.w, g.w)
+
+
+def test_directed_arrays_interleaving():
+    g = simple_test_graph()
+    src, dst, w = g.directed_arrays()
+    assert src.shape[0] == 2 * g.num_edges
+    # Slot 2e is u->v, slot 2e+1 is v->u.
+    assert src[0] == g.u[0] and dst[0] == g.v[0]
+    assert src[1] == g.v[0] and dst[1] == g.u[0]
+    assert w[0] == w[1] == g.w[0]
+
+
+def test_directed_arrays_padding():
+    g = simple_test_graph()
+    src, dst, w = g.directed_arrays(pad_to=16)
+    assert src.shape[0] == 16
+    # Pads are inert self-edges with sentinel weight.
+    assert np.all(src[6:] == dst[6:])
+
+
+def test_csr():
+    g = simple_test_graph()
+    indptr, dst, w = g.csr()
+    assert indptr.tolist() == [0, 2, 4, 6]
+    assert sorted(dst[0:2].tolist()) == [1, 2]
+
+
+def test_degree_and_weight_helpers():
+    g = line_graph(5, weight=3)
+    assert g.degrees().tolist() == [1, 2, 2, 2, 1]
+    assert g.total_weight == 12
+    assert g.is_integer_weighted
