@@ -1,0 +1,107 @@
+"""Benchmark: MST throughput on RMAT graphs (BASELINE.json metric).
+
+Prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": "edges/s", "vs_baseline": N}
+
+Baseline: the reference's best measured *correct* run — the 10-node/28-edge
+thread-backend experiment at 0.41 s (BASELINE.md) ≈ 68 edges/s. Its 20-node
+config is already wrong 2/3 of the time, so this is the fastest throughput the
+reference demonstrably sustains.
+
+Default config: RMAT scale-20 (1M vertices, ~15M undirected edges after
+dedup), solved on the real TPU chip, verified for weight parity against the
+SciPy MSF oracle. ``--scale`` adjusts size; ``--backend sharded`` exercises
+the mesh path.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+BASELINE_EDGES_PER_SEC = 68.0  # reference: 28 edges / 0.41 s (BASELINE.md)
+
+
+def main() -> int:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--scale", type=int, default=20, help="RMAT scale (2^scale vertices)")
+    p.add_argument("--edge-factor", type=int, default=16)
+    p.add_argument("--repeats", type=int, default=3)
+    p.add_argument("--backend", default="device", choices=["device", "sharded"])
+    p.add_argument("--no-verify", action="store_true")
+    args = p.parse_args()
+
+    from distributed_ghs_implementation_tpu.api import minimum_spanning_forest
+    from distributed_ghs_implementation_tpu.graphs.generators import rmat_graph
+    from distributed_ghs_implementation_tpu.utils.verify import verify_result
+
+    t0 = time.perf_counter()
+    g = rmat_graph(args.scale, args.edge_factor, seed=24)
+    print(
+        f"generated RMAT-{args.scale}: {g.num_nodes:,} nodes, {g.num_edges:,} edges "
+        f"in {time.perf_counter() - t0:.1f}s",
+        file=sys.stderr,
+    )
+
+    # Full-pipeline result once (for verification), then device-resident
+    # timing: arrays staged on device, each repeat is solve + scalar sync.
+    result = minimum_spanning_forest(g, backend=args.backend)
+
+    times = []
+    if args.backend == "device":
+        from distributed_ghs_implementation_tpu.models.boruvka import (
+            _solve_from_iota,
+            prepare_device_arrays,
+        )
+
+        dev_args = prepare_device_arrays(g)
+        n_pad = dev_args[0].shape[0]
+        out = _solve_from_iota(*dev_args[1:], num_nodes=n_pad)
+        _ = int(out[2])  # warm + sync
+        for _ in range(args.repeats):
+            t0 = time.perf_counter()
+            out = _solve_from_iota(*dev_args[1:], num_nodes=n_pad)
+            _ = int(out[2])
+            times.append(time.perf_counter() - t0)
+    else:
+        for _ in range(args.repeats):
+            r = minimum_spanning_forest(g, backend=args.backend)
+            times.append(r.wall_time_s)
+    best = min(times)
+    print(f"solve times: {[f'{t:.3f}' for t in times]}", file=sys.stderr)
+
+    if not args.no_verify:
+        v = verify_result(result, oracle="scipy")
+        if not v.ok:
+            print(f"VERIFICATION FAILED: {v}", file=sys.stderr)
+            print(
+                json.dumps(
+                    {
+                        "metric": f"MST edges/sec on RMAT-{args.scale} (VERIFY FAILED)",
+                        "value": 0.0,
+                        "unit": "edges/s",
+                        "vs_baseline": 0.0,
+                    }
+                )
+            )
+            return 1
+        print(f"verified: weight {v.actual_weight} = scipy oracle", file=sys.stderr)
+
+    edges_per_sec = g.num_edges / best
+    print(
+        json.dumps(
+            {
+                "metric": f"MST edges/sec on RMAT-{args.scale} ({g.num_nodes} nodes, {g.num_edges} edges, weight-verified)",
+                "value": round(edges_per_sec, 1),
+                "unit": "edges/s",
+                "vs_baseline": round(edges_per_sec / BASELINE_EDGES_PER_SEC, 1),
+            }
+        )
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

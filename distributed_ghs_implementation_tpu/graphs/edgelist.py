@@ -16,6 +16,7 @@ symmetric-CONNECT dedup workarounds at ``ghs_implementation_mpi.py:217-230``).
 from __future__ import annotations
 
 import dataclasses
+import functools
 from typing import Iterable, Sequence, Tuple
 
 import numpy as np
@@ -181,6 +182,54 @@ class Graph:
         w[1:n2:2] = self.w.astype(wd)
         # Padding rows are self-edges (src == dst == 0): never outgoing, inert.
         return src, dst, w
+
+    def rank_arrays(
+        self, *, pad_edges_to: int | None = None, pad_ranks_to: int | None = None
+    ) -> Tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray, np.ndarray]:
+        """Rank-based device layout: ``(src, dst, rank, ra, rb)``.
+
+        ``rank[e]`` (over undirected edges) is the position of edge ``e`` in
+        the total order ``(weight, edge id)`` — ascending, all-distinct. The
+        device kernel selects each fragment's minimum outgoing edge with ONE
+        ``segment_min`` over ranks (weights never reach the device; any weight
+        dtype collapses to int32 ranks on the host). ``ra[r], rb[r]`` are the
+        endpoints of the rank-``r`` edge, for recovering the far-side fragment
+        with n-sized gathers. ``src/dst`` are directed slots carrying
+        ``rank[slot >> 1]`` in ``rank``; pads are inert (self-edges, sentinel
+        rank). Use :meth:`edge_id_of_rank` to map chosen ranks back to edges.
+        """
+        m = self.num_edges
+        order = self._rank_order  # sort by (w, edge id)
+        rank_of_edge = np.empty(m, dtype=np.int64)
+        rank_of_edge[order] = np.arange(m)
+        e2 = 2 * m
+        e_size = e2 if pad_edges_to is None else int(pad_edges_to)
+        m_size = m if pad_ranks_to is None else int(pad_ranks_to)
+        if e_size < e2 or m_size < m:
+            raise ValueError("pad sizes smaller than graph")
+        src = np.zeros(e_size, dtype=np.int32)
+        dst = np.zeros(e_size, dtype=np.int32)
+        rank = np.full(e_size, np.iinfo(np.int32).max, dtype=np.int32)
+        src[0:e2:2] = self.u
+        dst[0:e2:2] = self.v
+        src[1:e2:2] = self.v
+        dst[1:e2:2] = self.u
+        rank[0:e2:2] = rank_of_edge
+        rank[1:e2:2] = rank_of_edge
+        ra = np.zeros(m_size, dtype=np.int32)
+        rb = np.zeros(m_size, dtype=np.int32)
+        ra[:m] = self.u[order]
+        rb[:m] = self.v[order]
+        return src, dst, rank, ra, rb
+
+    @functools.cached_property
+    def _rank_order(self) -> np.ndarray:
+        """Edge ids sorted by ``(weight, edge id)`` — computed once per graph."""
+        return np.lexsort((np.arange(self.num_edges), self.w))
+
+    def edge_id_of_rank(self, ranks: np.ndarray) -> np.ndarray:
+        """Map ranks (as produced by :meth:`rank_arrays`) back to edge indices."""
+        return self._rank_order[ranks]
 
     def csr(self) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
         """CSR adjacency over directed slots: ``(indptr, dst, w)`` sorted by src."""

@@ -10,7 +10,7 @@ from distributed_ghs_implementation_tpu.models.boruvka import (
     BoruvkaState,
     boruvka_level,
     boruvka_solve,
-    make_solver,
+    solve_graph,
 )
 
-__all__ = ["BoruvkaState", "boruvka_level", "boruvka_solve", "make_solver"]
+__all__ = ["BoruvkaState", "boruvka_level", "boruvka_solve", "solve_graph"]

@@ -5,11 +5,11 @@ runs here as one on-device loop. One *level* (the reference's round shape,
 SURVEY.md §3.4) is:
 
   1. candidate filter — intra-fragment edges die (TEST -> REJECT analog),
-  2. ``fragment_moe`` — per-fragment minimum outgoing edge via two segment
-     minima (TEST/ACCEPT + REPORT convergecast analog),
+  2. ``fragment_moe`` — per-fragment minimum outgoing edge via one rank-keyed
+     segment minimum (TEST/ACCEPT + REPORT convergecast analog),
   3. ``hook_and_compress`` — symmetric-hook resolution + pointer jumping
      (CONNECT/INITIATE/CHANGEROOT analog),
-  4. chosen slots are recorded as MST edges (BRANCH marking analog,
+  4. winning ranks are recorded as MST edges (BRANCH marking analog,
      ``ghs_implementation.py:130-131``).
 
 Levels iterate in a ``lax.while_loop`` until no fragment has an outgoing edge
@@ -18,6 +18,10 @@ inf`` (``ghs_implementation.py:316-320``). At most ``ceil(log2 n)`` levels run
 because every active fragment merges each level. Unlike the reference's
 thread/MPI races (wrong MSTs at 20+ vertices, SURVEY.md preamble), every step
 is deterministic: same graph in, identical MST out.
+
+Edges are compared by precomputed int32 *rank* (host-side sort by ``(weight,
+edge id)`` — ``Graph.rank_arrays``), so weights never reach the device and a
+level costs two e-sized gathers, one e-sized select, and one segment_min.
 """
 
 from __future__ import annotations
@@ -41,7 +45,7 @@ class BoruvkaState(NamedTuple):
     ``ghs_implementation.py:55-66`` — flattened into three arrays)."""
 
     fragment: jax.Array  # [n] int32: fragment (root) id per vertex
-    mst_slots: jax.Array  # [e2] bool: directed slots chosen as MST edges
+    mst_ranks: jax.Array  # [m] bool: edge ranks chosen for the MST
     level: jax.Array  # scalar int32: levels completed
     progress: jax.Array  # scalar bool: did the last level merge anything
 
@@ -50,33 +54,37 @@ def boruvka_level(
     state: BoruvkaState,
     src: jax.Array,
     dst: jax.Array,
-    w: jax.Array,
+    rank: jax.Array,
+    ra: jax.Array,
+    rb: jax.Array,
     *,
     axis_name: str | None = None,
+    identity_fragment: bool = False,
 ) -> BoruvkaState:
     """One GHS/Borůvka level over (optionally sharded) directed edge slots."""
     fragment = state.fragment
-    has_moe, _, moe_slot, moe_dst_frag = fragment_moe(
-        fragment, src, dst, w, axis_name=axis_name
+    has_moe, moe_rank, moe_dst_frag = fragment_moe(
+        fragment, src, dst, rank, ra, rb,
+        axis_name=axis_name, identity_fragment=identity_fragment,
     )
-    new_fragment = hook_and_compress(has_moe, moe_dst_frag, fragment)
+    new_fragment, _ = hook_and_compress(has_moe, moe_dst_frag, fragment)
 
-    # Record chosen slots. Sharded: each shard owns a contiguous global slot
-    # range and marks only winners that fall inside it.
-    e = src.shape[0]
+    # Record winning ranks. Sharded: each shard owns a contiguous rank block
+    # and marks only winners inside it.
     if axis_name is None:
-        safe = jnp.where(has_moe, moe_slot, 0)
-        mst_slots = state.mst_slots.at[safe].max(has_moe)
+        safe = jnp.where(has_moe, moe_rank, 0)
+        mst_ranks = state.mst_ranks.at[safe].max(has_moe)
     else:
+        m_local = state.mst_ranks.shape[0]
         shard = jax.lax.axis_index(axis_name).astype(jnp.int32)
-        local = moe_slot - shard * e
-        mine = has_moe & (local >= 0) & (local < e)
+        local = moe_rank - shard * m_local
+        mine = has_moe & (local >= 0) & (local < m_local)
         safe = jnp.where(mine, local, 0)
-        mst_slots = state.mst_slots.at[safe].max(mine)
+        mst_ranks = state.mst_ranks.at[safe].max(mine)
 
     return BoruvkaState(
         fragment=new_fragment,
-        mst_slots=mst_slots,
+        mst_ranks=mst_ranks,
         level=state.level + 1,
         progress=jnp.any(has_moe),
     )
@@ -90,17 +98,21 @@ def boruvka_solve(
     fragment0: jax.Array,
     src: jax.Array,
     dst: jax.Array,
-    w: jax.Array,
+    rank: jax.Array,
+    ra: jax.Array,
+    rb: jax.Array,
 ) -> Tuple[jax.Array, jax.Array, jax.Array]:
-    """Full single-device solve: ``(mst_slots[e2], fragment[n], levels)``.
+    """Full single-device solve from an arbitrary starting partition.
 
-    Jit-friendly: fixed shapes, on-device ``while_loop``, no host sync inside.
+    Correct for any ``fragment0`` whose entries are root ids (vertices may be
+    pre-merged — e.g. resuming from a checkpoint). Returns
+    ``(mst_ranks[m], fragment[n], levels)``. Jit-friendly: fixed shapes,
+    on-device ``while_loop``, no host sync inside.
     """
     n = fragment0.shape[0]
-    e2 = src.shape[0]
     state = BoruvkaState(
         fragment=fragment0,
-        mst_slots=jnp.zeros(e2, dtype=bool),
+        mst_ranks=jnp.zeros(ra.shape[0], dtype=bool),
         level=jnp.zeros((), jnp.int32),
         progress=jnp.ones((), bool),
     )
@@ -110,44 +122,195 @@ def boruvka_solve(
         return s.progress & (s.level < max_levels)
 
     def body(s: BoruvkaState):
-        return boruvka_level(s, src, dst, w)
+        return boruvka_level(s, src, dst, rank, ra, rb)
 
     final = jax.lax.while_loop(cond, body, state)
-    return final.mst_slots, final.fragment, final.level
+    return final.mst_ranks, final.fragment, final.level
 
 
-@functools.lru_cache(maxsize=32)
-def make_solver(num_nodes: int, num_slots: int, weight_dtype: str):
-    """Compiled solver for a given shape; cached across same-shape graphs."""
-    del num_nodes, num_slots, weight_dtype  # cache key only; shapes come from args
-    return jax.jit(boruvka_solve)
+@functools.partial(jax.jit, static_argnames=("num_nodes",))
+def _solve_from_iota(src, dst, rank, ra, rb, *, num_nodes: int):
+    """Solve from the identity partition, with the level-0 fast path (the
+    relabel gathers on the biggest level are skipped because fragment == iota;
+    only safe when the partition really is the identity)."""
+    state = BoruvkaState(
+        fragment=jnp.arange(num_nodes, dtype=jnp.int32),
+        mst_ranks=jnp.zeros(ra.shape[0], dtype=bool),
+        level=jnp.zeros((), jnp.int32),
+        progress=jnp.ones((), bool),
+    )
+    max_levels = _max_levels(num_nodes)
+    state = boruvka_level(state, src, dst, rank, ra, rb, identity_fragment=True)
+
+    def cond(s: BoruvkaState):
+        return s.progress & (s.level < max_levels)
+
+    def body(s: BoruvkaState):
+        return boruvka_level(s, src, dst, rank, ra, rb)
+
+    final = jax.lax.while_loop(cond, body, state)
+    return final.mst_ranks, final.fragment, final.level
+
+
+_jit_solve = jax.jit(boruvka_solve)
+
+
+# ---------------------------------------------------------------------------
+# Host-stepped variant with level-wise edge compaction.
+#
+# On real graphs most edges become intra-fragment after the first level; the
+# on-device while_loop keeps paying full-size gathers regardless. The
+# host-stepped path relabels src/dst to fragment ids each level (so the next
+# level's "gather fragment of endpoint" is the relabel itself), counts
+# surviving edges, and compacts the slot arrays into the next power-of-two
+# bucket when they shrink >= 2x. Each bucket size compiles once (cached).
+# Cost: one tiny host sync per level — worth it for the 8-64x shrink levels.
+# ---------------------------------------------------------------------------
+
+
+@jax.jit
+def _level_kernel(fragment, mst_ranks, src_f, dst_f, rank, ra, rb):
+    """One level over fragment-relabeled slots; returns relabeled survivors.
+
+    ``src_f/dst_f`` hold *current fragment ids* (relabeled each level), so the
+    MOE search takes the identity fast path; ``fragment`` still maps original
+    vertices (for the rank-indexed far-side lookup and the final result).
+    """
+    has, moe_rank, dst_frag = fragment_moe(
+        fragment, src_f, dst_f, rank, ra, rb, identity_fragment=True
+    )
+    fragment2, parent = hook_and_compress(has, dst_frag, fragment)
+    safe = jnp.where(has, moe_rank, 0)
+    mst2 = mst_ranks.at[safe].max(has)
+    src2 = parent[src_f]
+    dst2 = parent[dst_f]
+    count2 = jnp.sum((src2 != dst2).astype(jnp.int32))
+    return fragment2, mst2, src2, dst2, jnp.any(has), count2
+
+
+@functools.partial(jax.jit, static_argnums=(3,))
+def _compact_kernel(src_f, dst_f, rank, out_size: int):
+    """Stream-compact alive slots into an ``out_size`` buffer (pads inert)."""
+    alive = src_f != dst_f
+    pos = jnp.cumsum(alive.astype(jnp.int32)) - 1
+    idx = jnp.where(alive, pos, out_size)  # dead slots scatter out of bounds
+    new_src = jnp.zeros(out_size, jnp.int32).at[idx].set(src_f, mode="drop")
+    new_dst = jnp.zeros(out_size, jnp.int32).at[idx].set(dst_f, mode="drop")
+    new_rank = jnp.full(out_size, INT32_MAX, jnp.int32).at[idx].set(rank, mode="drop")
+    return new_src, new_dst, new_rank
+
+
+_COMPACT_MIN_SLOTS = 2048
+
+
+@jax.jit
+def _continue_solve(fragment, mst_ranks, level, src_f, dst_f, rank, ra, rb):
+    """Finish the solve on-device from a mid-run state (post-compaction)."""
+    n = fragment.shape[0]
+    state = BoruvkaState(
+        fragment=fragment,
+        mst_ranks=mst_ranks,
+        level=level,
+        progress=jnp.ones((), bool),
+    )
+    max_levels = _max_levels(n)
+
+    def cond(s: BoruvkaState):
+        return s.progress & (s.level < max_levels)
+
+    def body(s: BoruvkaState):
+        return boruvka_level(s, src_f, dst_f, rank, ra, rb)
+
+    final = jax.lax.while_loop(cond, body, state)
+    return final.mst_ranks, final.fragment, final.level
+
+
+def solve_arrays_stepped(
+    fragment0, src, dst, rank, ra, rb, *, compact: bool = True, stepped_levels: int = 2
+):
+    """Hybrid solve: up to ``stepped_levels`` host-stepped levels with edge
+    compaction (one tiny sync each), then the fused on-device while_loop over
+    the compacted survivors. Returns ``(mst_ranks, fragment, levels)``."""
+    n = fragment0.shape[0]
+    fragment = fragment0
+    mst_ranks = jnp.zeros(ra.shape[0], dtype=bool)
+    src_f, dst_f = src, dst  # fragment ids == vertex ids at level 0
+    max_levels = _max_levels(n)
+    levels = 0
+    while levels < min(stepped_levels, max_levels):
+        fragment, mst_ranks, src_f, dst_f, has, count = _level_kernel(
+            fragment, mst_ranks, src_f, dst_f, rank, ra, rb
+        )
+        levels += 1
+        has_np, count_np = jax.device_get((has, count))  # one round trip
+        if not bool(has_np):
+            return mst_ranks, fragment, levels
+        count_np = int(count_np)
+        if compact:
+            cur = src_f.shape[0]
+            tgt = max(_next_pow2(count_np), _COMPACT_MIN_SLOTS)
+            if 2 * tgt <= cur:
+                src_f, dst_f, rank = _compact_kernel(src_f, dst_f, rank, tgt)
+    mst_ranks, fragment, level = _continue_solve(
+        fragment, mst_ranks, jnp.asarray(levels, jnp.int32), src_f, dst_f, rank, ra, rb
+    )
+    return mst_ranks, fragment, int(level)
 
 
 def _next_pow2(x: int) -> int:
     return 1 << max(0, (x - 1)).bit_length()
 
 
-def solve_graph(graph: Graph, *, bucket_shapes: bool = True) -> Tuple[np.ndarray, np.ndarray, int]:
+def prepare_device_arrays(graph: Graph, *, bucket_shapes: bool = True):
+    """Host->device staging: ``(fragment0, src, dst, rank, ra, rb)`` jnp arrays.
+
+    With ``bucket_shapes``, slots/ranks/vertices pad to powers of two so
+    same-bucket graphs share one compiled kernel (padding vertices are
+    isolated self-fragments; padding slots/ranks are inert).
+    """
+    n = graph.num_nodes
+    n_pad = _next_pow2(n) if bucket_shapes else n
+    e_pad = _next_pow2(2 * graph.num_edges) if bucket_shapes else None
+    m_pad = e_pad // 2 if e_pad is not None else None
+    src, dst, rank, ra, rb = graph.rank_arrays(pad_edges_to=e_pad, pad_ranks_to=m_pad)
+    return (
+        jnp.arange(n_pad, dtype=jnp.int32),
+        jnp.asarray(src),
+        jnp.asarray(dst),
+        jnp.asarray(rank),
+        jnp.asarray(ra),
+        jnp.asarray(rb),
+    )
+
+
+def solve_graph(
+    graph: Graph, *, bucket_shapes: bool = True, strategy: str = "auto"
+) -> Tuple[np.ndarray, np.ndarray, int]:
     """Host entry: run the solver on a ``Graph``.
 
     Returns ``(mst_edge_ids, fragment, levels)`` where ``mst_edge_ids`` are
     indices into ``graph.u/v/w`` (undirected), sorted ascending.
 
-    ``bucket_shapes`` pads edge slots and the vertex array to powers of two so
-    graphs in the same size bucket share one compiled kernel (padding vertices
-    are isolated self-fragments; padding slots are inert self-edges).
+    ``strategy``: ``"fused"`` = single on-device while_loop (default; no host
+    round-trips); ``"stepped"`` = host-stepped levels with edge compaction —
+    measured slower on the current single-chip setup (per-level host syncs
+    outweigh the shrink; RMAT kills only ~18% of edges at level 1), kept for
+    graphs whose early levels do shrink sharply.
     """
     n = graph.num_nodes
     if n == 0 or graph.num_edges == 0:
         return np.zeros(0, dtype=np.int64), np.arange(n, dtype=np.int32), 0
-    n_pad = _next_pow2(n) if bucket_shapes else n
-    e_pad = _next_pow2(2 * graph.num_edges) if bucket_shapes else None
-    src_np, dst_np, w_np = graph.directed_arrays(pad_to=e_pad)
-    solver = make_solver(n_pad, src_np.shape[0], str(w_np.dtype))
-    fragment0 = jnp.arange(n_pad, dtype=jnp.int32)
-    mst_slots, fragment, levels = solver(
-        fragment0, jnp.asarray(src_np), jnp.asarray(dst_np), jnp.asarray(w_np)
-    )
-    slots = np.nonzero(np.asarray(mst_slots))[0]
-    edge_ids = np.unique(slots >> 1)
+    args = prepare_device_arrays(graph, bucket_shapes=bucket_shapes)
+    if strategy == "auto":
+        strategy = "fused"
+    if strategy == "stepped":
+        mst_ranks, fragment, levels = solve_arrays_stepped(*args)
+    elif strategy == "fused":
+        mst_ranks, fragment, levels = _solve_from_iota(
+            *args[1:], num_nodes=args[0].shape[0]
+        )
+    else:
+        raise ValueError(f"unknown strategy {strategy!r}")
+    ranks = np.nonzero(np.asarray(mst_ranks))[0]
+    edge_ids = np.sort(graph.edge_id_of_rank(ranks))
     return edge_ids, np.asarray(fragment)[:n], int(levels)

@@ -3,12 +3,13 @@
 One GHS level's TEST/ACCEPT/REJECT probing plus the REPORT convergecast
 (``/root/reference/ghs_implementation.py:235-353``) is, in batched form, a
 single question per fragment: *what is the minimum-weight edge leaving me?*
-That is two ``segment_min`` passes over the directed edge list keyed by the
-source endpoint's fragment id — pass 1 finds the minimum weight, pass 2
-tie-breaks among weight-achieving edges by global directed slot id. Because
-slots are interleaved (``graphs/edgelist.py``), slot order is a total order on
-*undirected* edges, which makes the per-fragment choice globally consistent —
-the property that confines union-find hook cycles to mutual pairs.
+That is ONE ``segment_min`` over the directed edge list keyed by the source
+endpoint's fragment id, comparing edges by a precomputed global *rank* — the
+position in the host-side sort by ``(weight, edge id)`` (``Graph.rank_arrays``).
+Rank is a total order on undirected edges, which makes the per-fragment choice
+globally consistent — the property that confines union-find hook cycles to
+mutual pairs — and it collapses weight comparison, tie-breaking, and edge
+identification into a single int32 reduction.
 """
 
 from __future__ import annotations
@@ -39,64 +40,67 @@ def fragment_moe(
     fragment: jax.Array,
     src: jax.Array,
     dst: jax.Array,
-    w: jax.Array,
+    rank: jax.Array,
+    ra: jax.Array,
+    rb: jax.Array,
     *,
     axis_name: str | None = None,
-) -> Tuple[jax.Array, jax.Array, jax.Array, jax.Array]:
+    identity_fragment: bool = False,
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
     """Per-fragment minimum outgoing edge over (optionally sharded) edge slots.
+
+    Edges are compared by their precomputed global rank (total order on
+    ``(weight, edge id)``, built host-side in ``Graph.rank_arrays``), so the
+    whole MOE search is ONE ``segment_min`` plus cheap n-sized lookups —
+    weights never reach the device.
 
     Args:
       fragment: ``[n]`` int32, fragment id per vertex (always a root id).
-      src, dst: ``[e]`` int32 directed slot endpoints (a local shard when
+      src, dst: ``[e]`` int32 directed slot endpoints (the local shard when
         ``axis_name`` is set).
-      w: ``[e]`` weights (int32 or float32; sentinel = dtype max / +inf).
-      axis_name: if set, combine per-fragment minima across this mesh axis with
-        ``lax.pmin`` — the ICI replacement for the reference's MPI
+      rank: ``[e]`` int32 global rank of each slot's undirected edge
+        (INT32_MAX on padding slots).
+      ra, rb: endpoints of the rank-``r`` undirected edge, indexed by rank
+        (sharded by contiguous rank blocks when ``axis_name`` is set).
+      axis_name: if set, combine per-fragment minima across this mesh axis
+        with ``lax.pmin`` — the ICI replacement for the reference's MPI
         point-to-point REPORT convergecast.
 
     Returns:
-      ``(has_moe[n], moe_w[n], moe_slot[n], moe_dst_frag[n])`` — whether each
-      fragment has an outgoing edge, its weight, the *global* directed slot id
-      chosen (INT32_MAX when none), and the fragment on the other end.
+      ``(has_moe[n], moe_rank[n], moe_dst_frag[n])`` — whether each fragment
+      has an outgoing edge, the winning edge's rank (INT32_MAX when none), and
+      the fragment on the far side.
     """
     n = fragment.shape[0]
-    e = src.shape[0]
-    wmax = weight_sentinel(w.dtype)
+    ids = jnp.arange(n, dtype=jnp.int32)
 
-    f_src = fragment[src]
-    f_dst = fragment[dst]
+    if identity_fragment:
+        # Level 0: fragment == iota, so the relabel gathers are identity.
+        f_src, f_dst = src, dst
+    else:
+        f_src = fragment[src]
+        f_dst = fragment[dst]
     alive = f_src != f_dst
-
-    # Pass 1: minimum outgoing weight per fragment.
-    w_masked = jnp.where(alive, w, wmax)
-    moe_w = segment_min(w_masked, f_src, n)
+    key = jnp.where(alive, rank, INT32_MAX)
+    moe_rank = segment_min(key, f_src, n)
     if axis_name is not None:
-        moe_w = jax.lax.pmin(moe_w, axis_name)
+        moe_rank = jax.lax.pmin(moe_rank, axis_name)
+    has_moe = moe_rank < INT32_MAX
 
-    # Pass 2: among weight-achieving edges, minimum global slot id.
-    slot_ids = jnp.arange(e, dtype=jnp.int32)
-    if axis_name is not None:
-        shard = jax.lax.axis_index(axis_name).astype(jnp.int32)
-        slot_ids = slot_ids + shard * e
-    cand = alive & (w == moe_w[f_src])
-    slot_masked = jnp.where(cand, slot_ids, INT32_MAX)
-    local_moe_slot = segment_min(slot_masked, f_src, n)
-    if axis_name is not None:
-        moe_slot = jax.lax.pmin(local_moe_slot, axis_name)
-    else:
-        moe_slot = local_moe_slot
-    has_moe = moe_slot < INT32_MAX
-
-    # Pass 3: destination fragment of the winning slot. Single device: a plain
-    # gather. Sharded: only the owner shard knows dst, so each shard proposes
-    # its local winner's destination (or INT32_MAX) and a pmin selects it.
+    # Far-side fragment of the winning edge via its endpoints. Single device:
+    # direct n-sized gathers through (ra, rb). Sharded: the shard owning the
+    # winning rank block proposes both endpoint fragments; pmin selects them.
     if axis_name is None:
-        safe = jnp.where(has_moe, moe_slot, 0)
-        moe_dst_frag = jnp.where(has_moe, f_dst[safe], jnp.arange(n, dtype=jnp.int32))
+        safe = jnp.where(has_moe, moe_rank, 0)
+        fa = fragment[ra[safe]]
+        fb = fragment[rb[safe]]
     else:
-        i_won = has_moe & (local_moe_slot == moe_slot)
-        safe = jnp.where(i_won, local_moe_slot - slot_ids[0], 0)
-        proposal = jnp.where(i_won, f_dst[safe], INT32_MAX)
-        moe_dst_frag = jax.lax.pmin(proposal, axis_name)
-        moe_dst_frag = jnp.where(has_moe, moe_dst_frag, jnp.arange(n, dtype=jnp.int32))
-    return has_moe, moe_w, moe_slot, moe_dst_frag
+        m_local = ra.shape[0]
+        shard = jax.lax.axis_index(axis_name).astype(jnp.int32)
+        local = moe_rank - shard * m_local
+        mine = has_moe & (local >= 0) & (local < m_local)
+        safe = jnp.where(mine, local, 0)
+        fa = jax.lax.pmin(jnp.where(mine, fragment[ra[safe]], INT32_MAX), axis_name)
+        fb = jax.lax.pmin(jnp.where(mine, fragment[rb[safe]], INT32_MAX), axis_name)
+    moe_dst_frag = jnp.where(has_moe, jnp.where(fa == ids, fb, fa), ids)
+    return has_moe, moe_rank, moe_dst_frag

@@ -15,8 +15,6 @@ high-diameter answer demanded by SURVEY.md §5's long-context analog).
 
 from __future__ import annotations
 
-import math
-
 import jax
 import jax.numpy as jnp
 
@@ -36,32 +34,45 @@ def break_symmetric_hooks(parent: jax.Array) -> jax.Array:
 def pointer_jump(parent: jax.Array, *, num_iters: int | None = None) -> jax.Array:
     """Compress a hook forest to stars: ``parent[f]`` becomes f's root.
 
-    ``num_iters`` defaults to ``ceil(log2 n) + 1`` — enough for any forest on
-    ``n`` vertices since each jump doubles pointer reach.
+    Runs to fixpoint with early exit — hook chains are usually O(1) deep, so
+    this typically costs 2-4 n-sized gathers instead of the worst-case
+    ``ceil(log2 n)`` (each jump doubles pointer reach, so the bound holds for
+    any forest). Pass ``num_iters`` to force a fixed-trip loop instead.
     """
-    n = parent.shape[0]
-    if num_iters is None:
-        num_iters = max(1, math.ceil(math.log2(max(n, 2)))) + 1
+    if num_iters is not None:
 
-    def body(_, p):
-        return p[p]
+        def body(_, p):
+            return p[p]
 
-    return jax.lax.fori_loop(0, num_iters, body, parent)
+        return jax.lax.fori_loop(0, num_iters, body, parent)
+
+    def cond(state):
+        p, changed = state
+        return changed
+
+    def step(state):
+        p, _ = state
+        p2 = p[p]
+        return p2, jnp.any(p2 != p)
+
+    out, _ = jax.lax.while_loop(cond, step, (parent, jnp.ones((), bool)))
+    return out
 
 
 def hook_and_compress(
     has_moe: jax.Array, moe_dst_frag: jax.Array, fragment: jax.Array
-) -> jax.Array:
+) -> tuple[jax.Array, jax.Array]:
     """One merge round: hook every active fragment, compress, relabel vertices.
 
-    Returns the new ``fragment`` array where every vertex points at its merged
-    fragment's root id. Fragments with no outgoing edge (isolated components —
-    the root-termination case, ``ghs_implementation.py:316-320``) self-hook and
-    are left untouched.
+    Returns ``(new_fragment, parent_star)``: the relabeled per-vertex fragment
+    array, and the compressed old-root -> new-root map (useful for relabeling
+    other root-id-valued arrays). Fragments with no outgoing edge (isolated
+    components — the root-termination case, ``ghs_implementation.py:316-320``)
+    self-hook and are left untouched.
     """
     n = fragment.shape[0]
     ids = jnp.arange(n, dtype=fragment.dtype)
     parent = jnp.where(has_moe, moe_dst_frag, ids)
     parent = break_symmetric_hooks(parent)
     parent = pointer_jump(parent)
-    return parent[fragment]
+    return parent[fragment], parent

@@ -1,0 +1,48 @@
+"""Mesh construction helpers.
+
+One mesh axis (``"edges"``) carries the edge partition. Multi-host runs reuse
+the same axis: ``jax.distributed.initialize`` + the full device list makes the
+combines ride ICI within a slice and DCN across hosts, replacing the
+reference's mpiexec/SLURM rank layout (``README_MPI.md:78-92``).
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+EDGE_AXIS = "edges"
+
+
+def edge_mesh(devices: Sequence | None = None, num_devices: int | None = None) -> Mesh:
+    """A 1-D mesh over ``devices`` (default: all) with the edge axis."""
+    if devices is None:
+        devices = jax.devices()
+        if num_devices is not None:
+            devices = devices[:num_devices]
+    return Mesh(np.asarray(devices), (EDGE_AXIS,))
+
+
+def shard_map_compat(fn, mesh, in_specs, out_specs):
+    """``shard_map`` across JAX versions (moved out of experimental in 0.6+).
+
+    Replication of the pmin-combined outputs isn't provable by the static
+    checker through ``while_loop``, so the check is disabled.
+    """
+    try:
+        from jax import shard_map as _sm  # jax >= 0.6
+
+        return _sm(fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs, check_vma=False)
+    except (ImportError, TypeError):
+        pass
+    try:
+        from jax.experimental.shard_map import shard_map as _sm_exp
+
+        return _sm_exp(fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs, check_rep=False)
+    except TypeError:
+        from jax.experimental.shard_map import shard_map as _sm_exp2
+
+        return _sm_exp2(fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs)

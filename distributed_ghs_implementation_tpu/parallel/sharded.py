@@ -1,0 +1,116 @@
+"""Edge-sharded MST solve: the multi-chip replacement for the MPI backend.
+
+Layout: directed slots are block-sharded over the mesh's ``edges`` axis (shard
+``k`` owns global slots ``[k*e_local, (k+1)*e_local)`` — the contiguity the
+global tie-break ids in ``ops.segment_ops`` rely on); ``fragment`` is
+replicated and every device runs the identical hook-and-compress update, so no
+collective is needed for the merge itself. Per level the only cross-chip
+traffic is three n-sized ``lax.pmin``s (min weight, winning slot, winner's
+destination fragment) — the ICI analog of the reference's REPORT convergecast
++ CHANGEROOT walk (``/root/reference/ghs_implementation_mpi.py:493-647``).
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from distributed_ghs_implementation_tpu.graphs.edgelist import Graph
+from distributed_ghs_implementation_tpu.models.boruvka import (
+    BoruvkaState,
+    _max_levels,
+    _next_pow2,
+    boruvka_level,
+)
+from distributed_ghs_implementation_tpu.parallel.mesh import (
+    EDGE_AXIS,
+    edge_mesh,
+    shard_map_compat,
+)
+
+
+@functools.lru_cache(maxsize=32)
+def make_sharded_solver(mesh: Mesh, num_nodes: int):
+    """Build a jitted sharded solver ``(src, dst, rank, ra, rb) ->
+    (mst_ranks, fragment, levels)`` for ``mesh``, starting from the identity
+    partition over ``num_nodes`` vertices. Slot and rank counts must divide
+    evenly by mesh size (pad with inert entries first)."""
+
+    def shard_fn(src, dst, rank, ra, rb):
+        m_local = ra.shape[0]
+        state = BoruvkaState(
+            fragment=jnp.arange(num_nodes, dtype=jnp.int32),
+            mst_ranks=jnp.zeros(m_local, dtype=bool),
+            level=jnp.zeros((), jnp.int32),
+            progress=jnp.ones((), bool),
+        )
+        max_levels = _max_levels(num_nodes)
+
+        # Unrolled level 0: fragment == iota, skip the relabel gathers.
+        state = boruvka_level(
+            state, src, dst, rank, ra, rb, axis_name=EDGE_AXIS, identity_fragment=True
+        )
+
+        def cond(s):
+            return s.progress & (s.level < max_levels)
+
+        def body(s):
+            return boruvka_level(s, src, dst, rank, ra, rb, axis_name=EDGE_AXIS)
+
+        final = jax.lax.while_loop(cond, body, state)
+        return final.mst_ranks, final.fragment, final.level
+
+    mapped = shard_map_compat(
+        shard_fn,
+        mesh,
+        in_specs=(
+            P(EDGE_AXIS),
+            P(EDGE_AXIS),
+            P(EDGE_AXIS),
+            P(EDGE_AXIS),
+            P(EDGE_AXIS),
+        ),
+        out_specs=(P(EDGE_AXIS), P(), P()),
+    )
+    return jax.jit(mapped)
+
+
+def solve_graph_sharded(
+    graph: Graph,
+    *,
+    mesh: Mesh | None = None,
+    bucket_shapes: bool = True,
+) -> Tuple[np.ndarray, np.ndarray, int]:
+    """Host entry mirroring ``models.boruvka.solve_graph`` on a device mesh."""
+    if mesh is None:
+        mesh = edge_mesh()
+    n_dev = mesh.devices.size
+    n = graph.num_nodes
+    if n == 0 or graph.num_edges == 0:
+        return np.zeros(0, dtype=np.int64), np.arange(n, dtype=np.int32), 0
+    n_pad = _next_pow2(n) if bucket_shapes else n
+    e2 = 2 * graph.num_edges
+    e_pad = _next_pow2(e2) if bucket_shapes else e2
+    # Both the slot axis and the rank axis (e_pad // 2) must divide by mesh size.
+    e_pad = int(math.ceil(e_pad / (2 * n_dev)) * 2 * n_dev)
+    src_np, dst_np, rank_np, ra_np, rb_np = graph.rank_arrays(
+        pad_edges_to=e_pad, pad_ranks_to=e_pad // 2
+    )
+
+    solver = make_sharded_solver(mesh, n_pad)
+    edge_sharding = NamedSharding(mesh, P(EDGE_AXIS))
+    src = jax.device_put(jnp.asarray(src_np), edge_sharding)
+    dst = jax.device_put(jnp.asarray(dst_np), edge_sharding)
+    rank = jax.device_put(jnp.asarray(rank_np), edge_sharding)
+    ra = jax.device_put(jnp.asarray(ra_np), edge_sharding)
+    rb = jax.device_put(jnp.asarray(rb_np), edge_sharding)
+    mst_ranks, fragment, levels = solver(src, dst, rank, ra, rb)
+    ranks = np.nonzero(np.asarray(mst_ranks))[0]
+    edge_ids = np.sort(graph.edge_id_of_rank(ranks))
+    return edge_ids, np.asarray(fragment)[:n], int(levels)

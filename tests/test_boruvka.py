@@ -160,6 +160,36 @@ def test_rmat_small_scipy_parity():
     assert verify_result(r, oracle="scipy").ok
 
 
+def test_solve_from_arbitrary_partition():
+    """boruvka_solve must be correct for non-identity starting partitions
+    (checkpoint-resume path): pre-merging vertices may not produce extra
+    MST edges."""
+    import jax.numpy as jnp
+
+    from distributed_ghs_implementation_tpu.models.boruvka import (
+        boruvka_solve,
+        prepare_device_arrays,
+    )
+
+    g = erdos_renyi_graph(30, 0.2, seed=17)
+    frag0, src, dst, rank, ra, rb = prepare_device_arrays(g, bucket_shapes=False)
+    # Pre-merge vertex 1 into fragment 0.
+    frag0 = frag0.at[1].set(0)
+    mst_ranks, fragment, _ = boruvka_solve(frag0, src, dst, rank, ra, rb)
+    num_components = int(np.unique(np.asarray(fragment)[: g.num_nodes]).size)
+    # 29 fragments to merge -> at most 28 edges chosen.
+    assert int(np.asarray(mst_ranks).sum()) == g.num_nodes - 1 - num_components
+
+
+def test_stepped_strategy_matches_fused():
+    from distributed_ghs_implementation_tpu.models.boruvka import solve_graph
+
+    g = erdos_renyi_graph(120, 0.08, seed=21)
+    a = solve_graph(g, strategy="stepped")
+    b = solve_graph(g, strategy="fused")
+    assert np.array_equal(a[0], b[0])
+
+
 def test_ghs_algorithm_api():
     """The reference driver surface: GHSAlgorithm(n, edges).run() -> pairs."""
     edges = [(0, 1, 1), (0, 2, 4), (1, 2, 2), (1, 3, 5), (2, 3, 3)]
