@@ -1,0 +1,7 @@
+"""``python -m distributed_ghs_implementation_tpu`` — see cli.py."""
+
+import sys
+
+from distributed_ghs_implementation_tpu.cli import main
+
+sys.exit(main())

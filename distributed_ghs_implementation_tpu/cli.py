@@ -1,0 +1,217 @@
+"""Command-line interface: generate / run / verify / experiments / bench.
+
+Flag-compatible supersets of the reference's two CLIs:
+
+* ``generate`` mirrors ``create_graph_files.py``'s argparse surface
+  (``--nodes --edge-prob --seed --output-dir``,
+  ``/root/reference/create_graph_files.py:151-170``) and adds G(n,m)/RMAT
+  generators and npz output for large graphs.
+* ``run --graph-dir`` mirrors the MPI runner's flag
+  (``ghs_implementation_mpi.py:894-901``); instead of ``mpiexec -n N`` the
+  backend flag picks device/sharded/protocol execution.
+* ``verify`` is ``check_mst.py`` as a real subcommand (the reference's has a
+  hard-coded directory, ``check_mst.py:4``).
+* ``experiments`` is the suite of ``ghs_implementation.py:779-835``.
+
+Usage: ``python -m distributed_ghs_implementation_tpu <subcommand> ...``
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+
+def _cmd_generate(args) -> int:
+    from distributed_ghs_implementation_tpu.graphs import generators, io
+
+    t0 = time.perf_counter()
+    if args.kind == "er":
+        g = generators.erdos_renyi_graph(
+            args.nodes, args.edge_prob, seed=args.seed
+        )
+    elif args.kind == "reference":
+        g = generators.reference_random_graph(args.nodes, args.edge_prob, args.seed)
+    elif args.kind == "gnm":
+        g = generators.gnm_random_graph(args.nodes, args.edges, seed=args.seed)
+    elif args.kind == "rmat":
+        g = generators.rmat_graph(args.rmat_scale, args.rmat_edge_factor, seed=args.seed)
+    elif args.kind == "simple-test":
+        g = generators.simple_test_graph()
+    else:
+        raise ValueError(args.kind)
+    print(
+        f"generated {args.kind}: {g.num_nodes:,} nodes, {g.num_edges:,} edges "
+        f"in {time.perf_counter() - t0:.2f}s",
+        file=sys.stderr,
+    )
+    if args.npz:
+        os.makedirs(args.output_dir, exist_ok=True)
+        path = io.write_npz(g, os.path.join(args.output_dir, "graph.npz"))
+        print(path)
+    else:
+        io.write_partition_dir(g, args.output_dir)
+        print(args.output_dir)
+    if args.visualize:
+        from distributed_ghs_implementation_tpu.utils.viz import visualize_graph
+
+        visualize_graph(g, os.path.join(args.output_dir, "input_graph.png"))
+    return 0
+
+
+def _load_graph(args):
+    from distributed_ghs_implementation_tpu.graphs import io
+
+    if args.graph_dir.endswith(".npz"):
+        return io.read_npz(args.graph_dir)
+    return io.read_partition_dir(args.graph_dir)
+
+
+def _cmd_run(args) -> int:
+    from distributed_ghs_implementation_tpu.api import minimum_spanning_forest
+    from distributed_ghs_implementation_tpu.utils.reporting import (
+        result_to_dict,
+        write_result_json,
+    )
+
+    g = _load_graph(args)
+    result = minimum_spanning_forest(g, backend=args.backend)
+    print(json.dumps(result_to_dict(result), indent=2))
+    if args.output:
+        write_result_json(result, args.output)
+    if args.visualize:
+        from distributed_ghs_implementation_tpu.utils.viz import visualize_mst
+
+        out = args.output or "mst_result.json"
+        visualize_mst(result, os.path.splitext(out)[0] + ".png")
+    if args.verify:
+        from distributed_ghs_implementation_tpu.utils.verify import verify_result
+
+        v = verify_result(result)
+        print(
+            f"verify[{v.oracle}]: {'OK' if v.ok else 'FAIL'} "
+            f"(weight {v.actual_weight} vs {v.expected_weight}, "
+            f"edges {v.actual_edges} vs {v.expected_edges})",
+            file=sys.stderr,
+        )
+        return 0 if v.ok else 1
+    return 0
+
+
+def _cmd_verify(args) -> int:
+    """check_mst.py parity: print the oracle MST for a graph dir."""
+    from distributed_ghs_implementation_tpu.utils.verify import (
+        networkx_mst_edges,
+        networkx_mst_weight,
+        scipy_mst_weight,
+    )
+
+    g = _load_graph(args)
+    if g.num_edges <= 200_000:
+        weight = networkx_mst_weight(g)
+        edges = sorted(networkx_mst_edges(g))
+        print(f"expected MST weight: {weight}")
+        for a, b in edges:
+            print(f"  ({a}, {b})")
+    else:
+        weight = scipy_mst_weight(g)
+        print(f"expected MSF weight: {weight}")
+    if args.result:
+        with open(args.result) as f:
+            res = json.load(f)
+        ok = abs(float(res["total_weight"]) - float(weight)) < 1e-6
+        print(f"result file {args.result}: {'OK' if ok else 'MISMATCH'}")
+        return 0 if ok else 1
+    return 0
+
+
+def _cmd_experiments(args) -> int:
+    from distributed_ghs_implementation_tpu.experiments import run_suite
+
+    records = run_suite(
+        backend=args.backend,
+        extended=args.extended,
+        output_json=args.output,
+        visualize_dir=args.visualize_dir,
+    )
+    return 0 if all(r["is_correct"] for r in records) else 1
+
+
+def _cmd_bench(args) -> int:
+    import bench as bench_mod  # repo-root bench.py
+
+    argv = ["--scale", str(args.scale),
+            "--edge-factor", str(args.edge_factor),
+            "--repeats", str(args.repeats), "--backend", args.backend]
+    if args.no_verify:
+        argv.append("--no-verify")
+    return bench_mod.main(argv)
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="distributed_ghs_implementation_tpu", description=__doc__
+    )
+    sub = p.add_subparsers(dest="command", required=True)
+
+    g = sub.add_parser("generate", help="generate a graph + partition files")
+    g.add_argument("--nodes", type=int, default=6)  # create_graph_files.py default
+    g.add_argument("--edge-prob", type=float, default=0.5)
+    g.add_argument("--seed", type=int, default=42)
+    g.add_argument("--output-dir", default="graph_data")
+    g.add_argument(
+        "--kind",
+        default="reference",
+        choices=["reference", "er", "gnm", "rmat", "simple-test"],
+    )
+    g.add_argument("--edges", type=int, default=8192, help="for --kind gnm")
+    g.add_argument("--rmat-scale", type=int, default=16)
+    g.add_argument("--rmat-edge-factor", type=int, default=16)
+    g.add_argument("--npz", action="store_true", help="write graph.npz instead of JSON")
+    g.add_argument("--visualize", action="store_true")
+    g.set_defaults(fn=_cmd_generate)
+
+    r = sub.add_parser("run", help="compute the MST of a graph dir / npz")
+    r.add_argument("--graph-dir", default="graph_data")
+    r.add_argument(
+        "--backend", default="device", choices=["device", "sharded", "protocol"]
+    )
+    r.add_argument("--output", help="write mst_result.json here")
+    r.add_argument("--visualize", action="store_true")
+    r.add_argument("--verify", action="store_true")
+    r.set_defaults(fn=_cmd_run)
+
+    v = sub.add_parser("verify", help="print the oracle MST for a graph dir")
+    v.add_argument("--graph-dir", default="graph_data")
+    v.add_argument("--result", help="optionally check a result JSON against it")
+    v.set_defaults(fn=_cmd_verify)
+
+    e = sub.add_parser("experiments", help="run the reference experiment suite")
+    e.add_argument(
+        "--backend", default="device", choices=["device", "sharded", "protocol"]
+    )
+    e.add_argument("--extended", action="store_true")
+    e.add_argument("--output", default="ghs_experiments.json")
+    e.add_argument("--visualize-dir")
+    e.set_defaults(fn=_cmd_experiments)
+
+    b = sub.add_parser("bench", help="run the benchmark (see bench.py)")
+    b.add_argument("--scale", type=int, default=20)
+    b.add_argument("--edge-factor", type=int, default=16)
+    b.add_argument("--repeats", type=int, default=3)
+    b.add_argument("--backend", default="device", choices=["device", "sharded"])
+    b.add_argument("--no-verify", action="store_true")
+    b.set_defaults(fn=_cmd_bench)
+    return p
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

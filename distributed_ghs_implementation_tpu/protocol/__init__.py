@@ -1,0 +1,42 @@
+"""The GHS message protocol, as one state machine with pluggable transport.
+
+This is the message-level view of the algorithm the reference implements twice
+— once per backend (``/root/reference/ghs_implementation.py:118-413`` for
+threads, ``ghs_implementation_mpi.py:117-757`` for MPI), a duplication
+SURVEY.md §2 flags as the design smell to fix. Here the protocol lives in
+:class:`~distributed_ghs_implementation_tpu.protocol.node.GHSNode` once, and
+transports deliver messages. The bundled
+:class:`~distributed_ghs_implementation_tpu.protocol.transport.SimTransport`
+is a deterministic discrete-event queue: unlike the reference's thread/MPI
+runtimes, identical runs deliver identical message orders, so protocol
+behavior is testable and the liveness heuristics the reference needs (requeue
+caps, idle termination, stuck-root retries — its source of wrong MSTs) do not
+exist.
+
+The batched Borůvka kernel (``models/boruvka.py``) is the production path;
+this backend exists for protocol parity, testing, and teaching.
+"""
+
+from distributed_ghs_implementation_tpu.protocol.messages import (
+    EdgeState,
+    Message,
+    MessageType,
+    NodeState,
+)
+from distributed_ghs_implementation_tpu.protocol.node import GHSNode
+from distributed_ghs_implementation_tpu.protocol.runner import (
+    run_protocol,
+    solve_graph_protocol,
+)
+from distributed_ghs_implementation_tpu.protocol.transport import SimTransport
+
+__all__ = [
+    "EdgeState",
+    "GHSNode",
+    "Message",
+    "MessageType",
+    "NodeState",
+    "SimTransport",
+    "run_protocol",
+    "solve_graph_protocol",
+]

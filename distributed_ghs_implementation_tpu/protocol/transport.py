@@ -1,0 +1,66 @@
+"""Deterministic discrete-event transport for the GHS protocol.
+
+The reference's transports — per-thread ``queue.Queue`` with requeue caps
+(``/root/reference/ghs_implementation.py:82-116``) and MPI ``iprobe``/``recv``
+with deferred lists (``ghs_implementation_mpi.py:94-115,696-701``) — are both
+sources of nondeterminism and the reason its liveness heuristics exist. This
+transport is a single priority queue keyed ``(deliver_time, sequence)``:
+identical runs deliver identical orders, deferred messages are redelivered at
+a strictly later time, and quiescence (empty queue) is *exact* termination
+detection — no idle counters, no polling (contrast
+``ghs_implementation.py:442-526``).
+"""
+
+from __future__ import annotations
+
+import heapq
+import itertools
+from typing import Callable, Dict, Optional
+
+from distributed_ghs_implementation_tpu.protocol.messages import Message
+
+
+class SimTransport:
+    """Event-queue message delivery with per-hop latency.
+
+    ``latency`` may be a constant or a ``(src, dst) -> int`` callable, letting
+    tests model asymmetric links and delivery races deterministically.
+    """
+
+    def __init__(self, latency=1, *, defer_delay: int = 1, max_events: int = 50_000_000):
+        self._queue: list = []
+        self._seq = itertools.count()
+        self._latency = latency if callable(latency) else (lambda s, d: latency)
+        self._defer_delay = defer_delay
+        self._max_events = max_events
+        self.now = 0
+        self.messages_sent = 0
+        self.messages_deferred = 0
+
+    def send(self, src: int, dst: int, msg: Message) -> None:
+        self.messages_sent += 1
+        when = self.now + max(1, self._latency(src, dst))
+        heapq.heappush(self._queue, (when, next(self._seq), dst, msg))
+
+    def run(self, nodes: Dict[int, "GHSNode"]) -> int:
+        """Drain the queue to quiescence; returns events processed."""
+        processed = 0
+        iterations = 0
+        while self._queue:
+            iterations += 1  # counts deferrals too, so livelock still trips the guard
+            if iterations >= self._max_events:
+                raise RuntimeError(
+                    f"protocol did not quiesce within {self._max_events} events"
+                )
+            when, _, dst, msg = heapq.heappop(self._queue)
+            self.now = max(self.now, when)
+            if nodes[dst].handle(msg):
+                processed += 1
+            else:
+                # Protocol-mandated deferral: redeliver strictly later.
+                self.messages_deferred += 1
+                heapq.heappush(
+                    self._queue,
+                    (self.now + self._defer_delay, next(self._seq), dst, msg),
+                )
+        return processed

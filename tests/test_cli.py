@@ -1,0 +1,80 @@
+"""CLI surface: generate / run / verify / experiments round-trips."""
+
+import json
+import os
+
+import pytest
+
+from distributed_ghs_implementation_tpu.cli import main
+
+
+def test_generate_run_verify_roundtrip(tmp_path):
+    gdir = str(tmp_path / "g")
+    assert main(["generate", "--nodes", "9", "--edge-prob", "0.5",
+                 "--seed", "3", "--output-dir", gdir]) == 0
+    assert os.path.exists(os.path.join(gdir, "graph_metadata.json"))
+    assert os.path.exists(os.path.join(gdir, "node_0.json"))
+
+    out = str(tmp_path / "res.json")
+    assert main(["run", "--graph-dir", gdir, "--output", out, "--verify"]) == 0
+    with open(out) as f:
+        res = json.load(f)
+    assert res["num_edges_in_mst"] == res["num_nodes"] - 1
+    assert res["num_components"] == 1
+
+    assert main(["verify", "--graph-dir", gdir, "--result", out]) == 0
+
+
+def test_generate_npz_and_run(tmp_path):
+    gdir = str(tmp_path)
+    assert main(["generate", "--kind", "gnm", "--nodes", "128", "--edges", "512",
+                 "--seed", "1", "--output-dir", gdir, "--npz"]) == 0
+    npz = os.path.join(gdir, "graph.npz")
+    assert os.path.exists(npz)
+    assert main(["run", "--graph-dir", npz, "--verify"]) == 0
+
+
+def test_run_all_backends_agree(tmp_path):
+    gdir = str(tmp_path / "g")
+    main(["generate", "--nodes", "12", "--edge-prob", "0.4",
+          "--seed", "8", "--output-dir", gdir])
+    weights = {}
+    for backend in ["device", "sharded", "protocol"]:
+        out = str(tmp_path / f"{backend}.json")
+        assert main(["run", "--graph-dir", gdir, "--backend", backend,
+                     "--output", out, "--verify"]) == 0
+        with open(out) as f:
+            weights[backend] = json.load(f)["total_weight"]
+    assert len(set(weights.values())) == 1
+
+
+def test_simple_test_fixture_generation(tmp_path):
+    """create_simple_test.py parity (C14)."""
+    gdir = str(tmp_path / "t")
+    assert main(["generate", "--kind", "simple-test", "--output-dir", gdir]) == 0
+    out = str(tmp_path / "res.json")
+    assert main(["run", "--graph-dir", gdir, "--output", out]) == 0
+    with open(out) as f:
+        assert json.load(f)["total_weight"] == 3
+
+
+def test_experiments_suite(tmp_path):
+    out = str(tmp_path / "exp.json")
+    assert main(["experiments", "--output", out]) == 0
+    with open(out) as f:
+        records = json.load(f)
+    assert len(records) == 6
+    assert all(r["is_correct"] for r in records)
+    # The reference's own problem config (20 nodes, seed 500) must pass.
+    r6 = records[-1]
+    assert r6["num_nodes"] == 20 and r6["is_correct"]
+
+
+def test_visualization(tmp_path):
+    gdir = str(tmp_path / "g")
+    main(["generate", "--nodes", "7", "--edge-prob", "0.6",
+          "--seed", "2", "--output-dir", gdir, "--visualize"])
+    assert os.path.exists(os.path.join(gdir, "input_graph.png"))
+    out = str(tmp_path / "res.json")
+    main(["run", "--graph-dir", gdir, "--output", out, "--visualize"])
+    assert os.path.exists(str(tmp_path / "res.png"))
