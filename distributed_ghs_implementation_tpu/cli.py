@@ -77,8 +77,17 @@ def _cmd_run(args) -> int:
         write_result_json,
     )
 
+    primary = True
+    if args.multihost:
+        from distributed_ghs_implementation_tpu.parallel import multihost
+
+        multihost.initialize()
+        primary = multihost.is_primary()
+
     g = _load_graph(args)
     result = minimum_spanning_forest(g, backend=args.backend)
+    if not primary:
+        return 0  # artifacts are written by process 0 only
     print(json.dumps(result_to_dict(result), indent=2))
     if args.output:
         write_result_json(result, args.output)
@@ -182,6 +191,11 @@ def build_parser() -> argparse.ArgumentParser:
     r.add_argument("--output", help="write mst_result.json here")
     r.add_argument("--visualize", action="store_true")
     r.add_argument("--verify", action="store_true")
+    r.add_argument(
+        "--multihost",
+        action="store_true",
+        help="initialize jax.distributed first (see launcher/run_ghs.slurm)",
+    )
     r.set_defaults(fn=_cmd_run)
 
     v = sub.add_parser("verify", help="print the oracle MST for a graph dir")

@@ -152,14 +152,41 @@ def rmat_graph(
     weight_low: int = 1,
     weight_high: int = 255,
     dedup: bool = True,
+    use_native: str | bool = "auto",
 ) -> Graph:
     """Graph500-style RMAT: ``2**scale`` vertices, ``edge_factor * 2**scale`` edges.
 
-    Fully vectorized recursive quadrant sampling — one ``(scale, m)`` random
-    block per bit level. RMAT-20 (~16M directed samples) generates in seconds
-    on the host; the C++ ingestion path covers RMAT-24 (see
-    ``distributed_ghs_implementation_tpu/graphs/native.py``).
+    ``use_native="auto"`` routes through the C++ ingestion library when it is
+    available and the graph is big enough to care (RMAT-20 drops from ~60 s of
+    NumPy to ~1 s); ``False`` forces the vectorized NumPy sampler, ``True``
+    requires native. The two paths use different RNG streams, so graphs match
+    within a path (per seed) but not across paths.
     """
+    native_required = use_native is True
+    if native_required and not dedup:
+        raise ValueError("native RMAT always dedups; use use_native=False with dedup=False")
+    if use_native == "auto":
+        use_native = scale >= 16 and dedup
+    if use_native:
+        from distributed_ghs_implementation_tpu.graphs import native
+
+        if native.native_available():
+            u, v, w, n = native.rmat_edges(
+                scale,
+                edge_factor,
+                seed=seed,
+                a=a,
+                b=b,
+                c=c,
+                weight_low=weight_low,
+                weight_high=weight_high,
+            )
+            # Already canonical + deduped; skip Graph.from_arrays re-dedup.
+            return Graph(n, u, v, w)
+        if native_required:
+            raise RuntimeError("native RMAT requested but library unavailable")
+        # auto + no native toolchain: fall through to the NumPy sampler.
+
     rng = np.random.default_rng(seed)
     n = 1 << scale
     m = int(edge_factor) << scale

@@ -1,0 +1,154 @@
+"""ctypes bindings for the native ingestion library, with NumPy fallback.
+
+Compiles ``native/graph_native.cpp`` on first use (g++ -O3 -fopenmp) into the
+repo-local ``native/`` dir and caches the handle. Every entry point has a pure
+NumPy fallback, so the framework works without a toolchain — native just makes
+RMAT-24-scale ingestion fast enough that data prep doesn't dwarf the solve
+(SURVEY.md §7 hard part 6).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import sys
+import threading
+from typing import Optional, Tuple
+
+import numpy as np
+
+_NATIVE_DIR = os.path.join(os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__)))), "native")
+_SRC = os.path.join(_NATIVE_DIR, "graph_native.cpp")
+_SO = os.path.join(_NATIVE_DIR, "libgraph_native.so")
+
+_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+_lib_failed = False
+
+_I64 = ctypes.POINTER(ctypes.c_int64)
+
+
+def _build() -> bool:
+    cmd = [
+        "g++", "-O3", "-march=native", "-fopenmp", "-shared", "-fPIC",
+        _SRC, "-o", _SO,
+    ]
+    try:
+        subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+        return True
+    except (OSError, subprocess.SubprocessError) as e:
+        print(f"native build failed ({e}); using NumPy fallback", file=sys.stderr)
+        return False
+
+
+def get_lib() -> Optional[ctypes.CDLL]:
+    """Load (building if needed) the native library; None if unavailable."""
+    global _lib, _lib_failed
+    with _lock:
+        if _lib is not None or _lib_failed:
+            return _lib
+        if not os.path.exists(_SO) or (
+            os.path.exists(_SRC)
+            and os.path.getmtime(_SRC) > os.path.getmtime(_SO)
+        ):
+            if not os.path.exists(_SRC) or not _build():
+                _lib_failed = True
+                return None
+        try:
+            lib = ctypes.CDLL(_SO)
+        except OSError as e:
+            print(f"native load failed ({e}); using NumPy fallback", file=sys.stderr)
+            _lib_failed = True
+            return None
+        lib.rmat_generate.argtypes = [
+            ctypes.c_int, ctypes.c_int64, ctypes.c_uint64,
+            ctypes.c_double, ctypes.c_double, ctypes.c_double,
+            ctypes.c_int64, ctypes.c_int64, _I64, _I64, _I64,
+        ]
+        lib.rmat_generate.restype = None
+        lib.dedup_edges.argtypes = [ctypes.c_int64, ctypes.c_int64, _I64, _I64, _I64]
+        lib.dedup_edges.restype = ctypes.c_int64
+        lib.dimacs_parse.argtypes = [
+            ctypes.c_char_p, _I64, _I64, _I64, _I64, ctypes.c_int64,
+        ]
+        lib.dimacs_parse.restype = ctypes.c_int64
+        lib.build_csr.argtypes = [
+            ctypes.c_int64, ctypes.c_int64, _I64, _I64, _I64, _I64, _I64, _I64,
+        ]
+        lib.build_csr.restype = None
+        _lib = lib
+        return _lib
+
+
+def _ptr(a: np.ndarray):
+    return a.ctypes.data_as(_I64)
+
+
+def native_available() -> bool:
+    return get_lib() is not None
+
+
+def rmat_edges(
+    scale: int,
+    edge_factor: int,
+    *,
+    seed: int = 1,
+    a: float = 0.57,
+    b: float = 0.19,
+    c: float = 0.19,
+    weight_low: int = 1,
+    weight_high: int = 255,
+) -> Tuple[np.ndarray, np.ndarray, np.ndarray, int]:
+    """Raw RMAT samples + canonical dedup, natively; ``(u, v, w, n)``."""
+    lib = get_lib()
+    if lib is None:
+        raise RuntimeError("native library unavailable")
+    n = 1 << scale
+    m = int(edge_factor) << scale
+    u = np.empty(m, dtype=np.int64)
+    v = np.empty(m, dtype=np.int64)
+    w = np.empty(m, dtype=np.int64)
+    lib.rmat_generate(
+        scale, m, seed, a, b, c, weight_low, weight_high, _ptr(u), _ptr(v), _ptr(w)
+    )
+    kept = int(lib.dedup_edges(m, n, _ptr(u), _ptr(v), _ptr(w)))
+    return u[:kept].copy(), v[:kept].copy(), w[:kept].copy(), n
+
+
+def read_dimacs_native(path: str) -> Tuple[np.ndarray, np.ndarray, np.ndarray, int]:
+    """DIMACS .gr arcs via the native parser; ``(u, v, w, n)`` (raw arcs)."""
+    lib = get_lib()
+    if lib is None:
+        raise RuntimeError("native library unavailable")
+    n_out = np.zeros(1, dtype=np.int64)
+    count = int(lib.dimacs_parse(path.encode(), _ptr(n_out), None, None, None, 0))
+    if count < 0:
+        raise FileNotFoundError(path)
+    u = np.empty(count, dtype=np.int64)
+    v = np.empty(count, dtype=np.int64)
+    w = np.empty(count, dtype=np.int64)
+    wrote = int(
+        lib.dimacs_parse(path.encode(), _ptr(n_out), _ptr(u), _ptr(v), _ptr(w), count)
+    )
+    return u[:wrote], v[:wrote], w[:wrote], int(n_out[0])
+
+
+def build_csr_native(
+    num_nodes: int, u: np.ndarray, v: np.ndarray, w: np.ndarray
+) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """CSR over directed slots, natively; ``(indptr, adj_dst, adj_w)``."""
+    lib = get_lib()
+    if lib is None:
+        raise RuntimeError("native library unavailable")
+    m = u.shape[0]
+    u = np.ascontiguousarray(u, dtype=np.int64)
+    v = np.ascontiguousarray(v, dtype=np.int64)
+    w = np.ascontiguousarray(w, dtype=np.int64)
+    indptr = np.empty(num_nodes + 1, dtype=np.int64)
+    adj_dst = np.empty(2 * m, dtype=np.int64)
+    adj_w = np.empty(2 * m, dtype=np.int64)
+    lib.build_csr(num_nodes, m, _ptr(u), _ptr(v), _ptr(w),
+                  _ptr(indptr), _ptr(adj_dst), _ptr(adj_w))
+    return indptr, adj_dst, adj_w

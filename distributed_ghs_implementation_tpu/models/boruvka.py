@@ -226,31 +226,70 @@ def _continue_solve(fragment, mst_ranks, level, src_f, dst_f, rank, ra, rb):
 
 
 def solve_arrays_stepped(
-    fragment0, src, dst, rank, ra, rb, *, compact: bool = True, stepped_levels: int = 2
+    fragment0,
+    src,
+    dst,
+    rank,
+    ra,
+    rb,
+    *,
+    compact: bool = True,
+    stepped_levels: int | None = 2,
+    initial_state: tuple | None = None,
+    on_level=None,
 ):
-    """Hybrid solve: up to ``stepped_levels`` host-stepped levels with edge
-    compaction (one tiny sync each), then the fused on-device while_loop over
-    the compacted survivors. Returns ``(mst_ranks, fragment, levels)``."""
+    """Host-stepped solve — the single driver behind the hybrid strategy,
+    instrumentation, and checkpointing (each was once its own loop copy).
+
+    Runs ``stepped_levels`` levels host-side with edge compaction (one tiny
+    sync each), then finishes in the fused on-device while_loop; pass
+    ``stepped_levels=None`` to step every level (required when ``on_level``
+    must observe each one). ``initial_state`` is ``(fragment, mst_ranks,
+    level)`` to resume mid-solve (slots are relabeled to the restored
+    partition first). ``on_level(level, fragment, mst_ranks, has_np, count_np,
+    wall_time_s)`` fires after each stepped level. Returns
+    ``(mst_ranks, fragment, levels)``.
+    """
+    import time
+
     n = fragment0.shape[0]
-    fragment = fragment0
-    mst_ranks = jnp.zeros(ra.shape[0], dtype=bool)
-    src_f, dst_f = src, dst  # fragment ids == vertex ids at level 0
+    if initial_state is not None:
+        fragment, mst_ranks, levels = initial_state
+        fragment = jnp.asarray(fragment)
+        mst_ranks = jnp.asarray(mst_ranks)
+        src_f = fragment[src]
+        dst_f = fragment[dst]
+    else:
+        fragment = fragment0
+        mst_ranks = jnp.zeros(ra.shape[0], dtype=bool)
+        src_f, dst_f = src, dst  # fragment ids == vertex ids at level 0
+        levels = 0
     max_levels = _max_levels(n)
-    levels = 0
-    while levels < min(stepped_levels, max_levels):
+    step_until = max_levels if stepped_levels is None else min(
+        levels + stepped_levels, max_levels
+    )
+    while levels < step_until:
+        t0 = time.perf_counter()
         fragment, mst_ranks, src_f, dst_f, has, count = _level_kernel(
             fragment, mst_ranks, src_f, dst_f, rank, ra, rb
         )
         levels += 1
         has_np, count_np = jax.device_get((has, count))  # one round trip
+        count_np = int(count_np)
+        if on_level is not None:
+            on_level(
+                levels, fragment, mst_ranks, bool(has_np), count_np,
+                time.perf_counter() - t0,
+            )
         if not bool(has_np):
             return mst_ranks, fragment, levels
-        count_np = int(count_np)
         if compact:
             cur = src_f.shape[0]
             tgt = max(_next_pow2(count_np), _COMPACT_MIN_SLOTS)
             if 2 * tgt <= cur:
                 src_f, dst_f, rank = _compact_kernel(src_f, dst_f, rank, tgt)
+    if levels >= max_levels:
+        return mst_ranks, fragment, levels
     mst_ranks, fragment, level = _continue_solve(
         fragment, mst_ranks, jnp.asarray(levels, jnp.int32), src_f, dst_f, rank, ra, rb
     )

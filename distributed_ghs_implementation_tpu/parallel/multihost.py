@@ -1,0 +1,59 @@
+"""Multi-host execution: JAX distributed runtime over a TPU pod.
+
+The reference scales out with ``mpiexec -n N`` / SLURM, one OS process per
+graph vertex (``/root/reference/README_MPI.md:78-92,156-167``). The TPU-native
+equivalent is one JAX process per host, all chips in one
+``jax.sharding.Mesh``: after :func:`initialize`, ``jax.devices()`` spans the
+pod, ``parallel.edge_mesh()`` covers every chip, and the same
+``solve_graph_sharded`` code runs unchanged — XLA routes the per-level pmin
+combines over ICI within a slice and DCN across hosts. Launch scripts live in
+``launcher/`` (the reference's ``run_ghs.slurm`` is referenced but missing
+from its repo — C17 in SURVEY.md §2; ours ships).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+
+def initialize(
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+) -> None:
+    """Bring up the JAX distributed runtime (idempotent).
+
+    With no arguments, reads the standard env (TPU pod metadata or
+    ``JAX_COORDINATOR_ADDRESS``/``JAX_NUM_PROCESSES``/``JAX_PROCESS_ID``, the
+    names our SLURM launcher exports). Call before any other JAX API on every
+    host, then build meshes as usual.
+    """
+    import jax
+
+    if getattr(initialize, "_done", False):
+        return
+    kwargs = {}
+    coordinator_address = coordinator_address or os.environ.get(
+        "JAX_COORDINATOR_ADDRESS"
+    )
+    if coordinator_address:
+        kwargs["coordinator_address"] = coordinator_address
+    if num_processes is None and "JAX_NUM_PROCESSES" in os.environ:
+        num_processes = int(os.environ["JAX_NUM_PROCESSES"])
+    if num_processes is not None:
+        kwargs["num_processes"] = num_processes
+    if process_id is None and "JAX_PROCESS_ID" in os.environ:
+        process_id = int(os.environ["JAX_PROCESS_ID"])
+    if process_id is not None:
+        kwargs["process_id"] = process_id
+    jax.distributed.initialize(**kwargs)
+    initialize._done = True
+
+
+def is_primary() -> bool:
+    """True on the host that should write artifacts (rank 0's role in the
+    reference's result aggregation, ``ghs_implementation_mpi.py:929-954``)."""
+    import jax
+
+    return jax.process_index() == 0

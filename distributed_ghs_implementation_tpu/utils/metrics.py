@@ -1,0 +1,98 @@
+"""Per-level structured metrics and profiler hooks.
+
+The reference's observability is print-narration (per-message logs at
+``/root/reference/ghs_implementation_mpi.py:100-113``, heartbeats ``:728-734``)
+— unusable at scale and absent on the thread backend. The TPU equivalent
+(SURVEY.md §5): structured per-level records (fragments remaining, edges
+alive, level latency) from the host-stepped solver, plus a context manager
+around ``jax.profiler`` for device traces viewable in TensorBoard/Perfetto.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import dataclasses
+import json
+import time
+from typing import List, Optional
+
+import numpy as np
+
+
+@dataclasses.dataclass
+class LevelMetrics:
+    level: int
+    fragments_before: int
+    fragments_after: int
+    edges_alive_after: int
+    wall_time_s: float
+
+
+@dataclasses.dataclass
+class SolveMetrics:
+    num_nodes: int
+    num_edges: int
+    levels: List[LevelMetrics]
+    total_wall_time_s: float
+
+    def to_json(self) -> str:
+        return json.dumps(dataclasses.asdict(self), indent=2)
+
+
+def solve_graph_instrumented(graph, *, compact: bool = True) -> tuple:
+    """Like ``models.boruvka.solve_graph`` but returns ``(result_tuple,
+    SolveMetrics)`` with one record per level (host-stepped execution via the
+    shared ``solve_arrays_stepped`` driver)."""
+    from distributed_ghs_implementation_tpu.models.boruvka import (
+        prepare_device_arrays,
+        solve_arrays_stepped,
+    )
+
+    n = graph.num_nodes
+    if n == 0 or graph.num_edges == 0:
+        empty = (np.zeros(0, dtype=np.int64), np.arange(n, dtype=np.int32), 0)
+        return empty, SolveMetrics(n, graph.num_edges, [], 0.0)
+
+    args = prepare_device_arrays(graph)
+    records: List[LevelMetrics] = []
+    frags_before = [n]
+
+    def on_level(level, fragment, mst_ranks, has, count, dt):
+        frags_after = int(np.unique(np.asarray(fragment)[:n]).size)
+        records.append(
+            LevelMetrics(
+                level=level,
+                fragments_before=frags_before[0],
+                fragments_after=frags_after,
+                edges_alive_after=count,
+                wall_time_s=dt,
+            )
+        )
+        frags_before[0] = frags_after
+
+    t_start = time.perf_counter()
+    mst_ranks, fragment, levels = solve_arrays_stepped(
+        *args, compact=compact, stepped_levels=None, on_level=on_level
+    )
+    total = time.perf_counter() - t_start
+
+    ranks_chosen = np.nonzero(np.asarray(mst_ranks))[0]
+    edge_ids = np.sort(graph.edge_id_of_rank(ranks_chosen))
+    result = (edge_ids, np.asarray(fragment)[:n], levels)
+    return result, SolveMetrics(n, graph.num_edges, records, total)
+
+
+@contextlib.contextmanager
+def profiler_trace(log_dir: str):
+    """Wrap a solve in a JAX device profile (TensorBoard/Perfetto trace).
+
+    >>> with profiler_trace("/tmp/ghs-trace"):
+    ...     minimum_spanning_forest(graph)
+    """
+    import jax
+
+    jax.profiler.start_trace(log_dir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()

@@ -1,0 +1,16 @@
+#!/bin/bash
+# Launch a multi-host solve across a Cloud TPU pod slice.
+#
+# Runs the same command on every host of the slice; JAX's TPU runtime
+# auto-discovers coordinator/process topology from pod metadata, so
+# `multihost.initialize()` needs no explicit addresses here.
+#
+# Usage:
+#   ./launcher/tpu_pod_run.sh <tpu-name> <zone> --graph-dir /shared/graph_data
+set -euo pipefail
+
+TPU_NAME="$1"; shift
+ZONE="$1"; shift
+
+gcloud compute tpus tpu-vm ssh "$TPU_NAME" --zone "$ZONE" --worker=all \
+  --command "cd $(pwd) && python -m distributed_ghs_implementation_tpu run --multihost --backend sharded $*"

@@ -1,0 +1,78 @@
+"""Native ingestion library: build, bindings, and NumPy-fallback parity."""
+
+import os
+
+import numpy as np
+import pytest
+
+from distributed_ghs_implementation_tpu.graphs import native
+from distributed_ghs_implementation_tpu.graphs.edgelist import Graph
+from distributed_ghs_implementation_tpu.graphs.generators import rmat_graph
+
+pytestmark = pytest.mark.skipif(
+    not native.native_available(), reason="native toolchain unavailable"
+)
+
+
+def test_rmat_canonical_and_deduped():
+    u, v, w, n = native.rmat_edges(10, 8, seed=3)
+    assert n == 1024
+    assert (u < v).all()
+    codes = u * n + v
+    assert np.unique(codes).size == codes.size
+    assert w.min() >= 1 and w.max() <= 255
+
+
+def test_rmat_deterministic():
+    a = native.rmat_edges(9, 8, seed=5)
+    b = native.rmat_edges(9, 8, seed=5)
+    assert all(np.array_equal(x, y) for x, y in zip(a[:3], b[:3]))
+    c = native.rmat_edges(9, 8, seed=6)
+    assert not np.array_equal(a[0], c[0])
+
+
+def test_rmat_graph_native_routing_solves():
+    from distributed_ghs_implementation_tpu.api import minimum_spanning_forest
+    from distributed_ghs_implementation_tpu.utils.verify import verify_result
+
+    g = rmat_graph(16, 4, seed=7, use_native=True)
+    assert g.num_nodes == 1 << 16
+    r = minimum_spanning_forest(g)
+    assert verify_result(r, oracle="scipy").ok
+
+
+def test_dedup_edges_keeps_min_weight():
+    lib = native.get_lib()
+    u = np.array([3, 1, 1, 2, 2], dtype=np.int64)
+    v = np.array([3, 2, 2, 1, 4], dtype=np.int64)  # (3,3) loop; (1,2) x3
+    w = np.array([9, 5, 2, 7, 4], dtype=np.int64)
+    kept = int(lib.dedup_edges(5, 5, native._ptr(u), native._ptr(v), native._ptr(w)))
+    assert kept == 2
+    assert u[:kept].tolist() == [1, 2]
+    assert v[:kept].tolist() == [2, 4]
+    assert w[:kept].tolist() == [2, 4]  # min weight of the (1,2) triplicate
+
+
+def test_dimacs_native_matches_python(tmp_path):
+    from distributed_ghs_implementation_tpu.graphs.io import read_dimacs
+
+    p = tmp_path / "toy.gr"
+    p.write_text(
+        "c toy\np sp 4 8\n"
+        "a 1 2 5\na 2 1 5\na 2 3 2\na 3 2 2\na 3 4 7\na 4 3 7\na 1 4 1\na 4 1 1\n"
+    )
+    u, v, w, n = native.read_dimacs_native(str(p))
+    assert n == 4 and u.size == 8
+    g_native = Graph.from_arrays(n, u, v, w)
+    g_py = read_dimacs(str(p))
+    assert g_native.edge_triples() == g_py.edge_triples()
+
+
+def test_csr_native():
+    u = np.array([0, 0, 1], dtype=np.int64)
+    v = np.array([1, 2, 2], dtype=np.int64)
+    w = np.array([5, 6, 7], dtype=np.int64)
+    indptr, adj, adjw = native.build_csr_native(3, u, v, w)
+    assert indptr.tolist() == [0, 2, 4, 6]
+    assert sorted(adj[0:2].tolist()) == [1, 2]
+    assert sorted(adjw[4:6].tolist()) == [6, 7]
