@@ -45,27 +45,40 @@ def main(argv=None) -> int:
         file=sys.stderr,
     )
 
-    # Full-pipeline result once (for verification), then device-resident
-    # timing: arrays staged on device, each repeat is solve + scalar sync.
-    result = minimum_spanning_forest(g, backend=args.backend)
-
+    # Device-resident timing of the kernel that is also the one verified:
+    # arrays staged once, each repeat is solve + scalar sync.
     times = []
     if args.backend == "device":
+        import numpy as np
+
+        from distributed_ghs_implementation_tpu.api import MSTResult
         from distributed_ghs_implementation_tpu.models.boruvka import (
-            _solve_from_iota,
-            prepare_device_arrays,
+            _solve_ell,
+            prepare_ell_arrays,
         )
 
-        dev_args = prepare_device_arrays(g)
-        n_pad = dev_args[0].shape[0]
-        out = _solve_from_iota(*dev_args[1:], num_nodes=n_pad)
+        buckets, ra, rb, n_pad = prepare_ell_arrays(g)
+        out = _solve_ell(buckets, ra, rb, num_nodes=n_pad)
         _ = int(out[2])  # warm + sync
         for _ in range(args.repeats):
             t0 = time.perf_counter()
-            out = _solve_from_iota(*dev_args[1:], num_nodes=n_pad)
+            out = _solve_ell(buckets, ra, rb, num_nodes=n_pad)
             _ = int(out[2])
             times.append(time.perf_counter() - t0)
+        # Wrap the timed kernel's own output for verification below.
+        ranks = np.nonzero(np.asarray(out[0]))[0]
+        edge_ids = np.sort(g.edge_id_of_rank(ranks))
+        fragment = np.asarray(out[1])[: g.num_nodes]
+        result = MSTResult(
+            graph=g,
+            edge_ids=edge_ids,
+            num_levels=int(out[2]),
+            wall_time_s=min(times),
+            backend="device/ell",
+            num_components=int(np.unique(fragment).size),
+        )
     else:
+        result = minimum_spanning_forest(g, backend=args.backend)
         for _ in range(args.repeats):
             r = minimum_spanning_forest(g, backend=args.backend)
             times.append(r.wall_time_s)

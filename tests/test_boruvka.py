@@ -181,13 +181,30 @@ def test_solve_from_arbitrary_partition():
     assert int(np.asarray(mst_ranks).sum()) == g.num_nodes - 1 - num_components
 
 
-def test_stepped_strategy_matches_fused():
+def test_all_strategies_agree():
     from distributed_ghs_implementation_tpu.models.boruvka import solve_graph
 
     g = erdos_renyi_graph(120, 0.08, seed=21)
-    a = solve_graph(g, strategy="stepped")
-    b = solve_graph(g, strategy="fused")
-    assert np.array_equal(a[0], b[0])
+    results = {
+        s: solve_graph(g, strategy=s)[0] for s in ["ell", "stepped", "fused"]
+    }
+    assert np.array_equal(results["ell"], results["fused"])
+    assert np.array_equal(results["stepped"], results["fused"])
+
+
+@pytest.mark.parametrize("seed", range(4))
+def test_ell_strategy_oracle(seed):
+    """The ELL strategy against the *external* oracle (not just the fused
+    kernel — a shared bug must not pass) on skewed-degree graphs."""
+    from distributed_ghs_implementation_tpu.models.boruvka import solve_graph
+    from distributed_ghs_implementation_tpu.utils.verify import scipy_mst_weight
+
+    g = rmat_graph(9, 8, seed=seed, use_native=False)  # power-law degrees
+    edge_ids, fragment, _ = solve_graph(g, strategy="ell")
+    assert float(g.w[edge_ids].sum()) == pytest.approx(scipy_mst_weight(g))
+    assert len(edge_ids) == g.num_nodes - np.unique(fragment).size
+    fused_ids, _, _ = solve_graph(g, strategy="fused")
+    assert np.array_equal(edge_ids, fused_ids)
 
 
 def test_ghs_algorithm_api():
