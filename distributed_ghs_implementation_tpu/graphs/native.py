@@ -78,6 +78,10 @@ def get_lib() -> Optional[ctypes.CDLL]:
             ctypes.c_int64, ctypes.c_int64, _I64, _I64, _I64, _I64, _I64, _I64,
         ]
         lib.build_csr.restype = None
+        lib.build_rank_csr.argtypes = [
+            ctypes.c_int64, ctypes.c_int64, _I64, _I64, _I64, _I64, _I64, _I64,
+        ]
+        lib.build_rank_csr.restype = None
         _lib = lib
         return _lib
 
@@ -133,6 +137,25 @@ def read_dimacs_native(path: str) -> Tuple[np.ndarray, np.ndarray, np.ndarray, i
         lib.dimacs_parse(path.encode(), _ptr(n_out), _ptr(u), _ptr(v), _ptr(w), count)
     )
     return u[:wrote], v[:wrote], w[:wrote], int(n_out[0])
+
+
+def build_rank_csr_native(
+    num_nodes: int, u: np.ndarray, v: np.ndarray, rank: np.ndarray
+) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """Rank-sorted CSR over directed slots; ``(indptr, adj_dst, adj_rank)``."""
+    lib = get_lib()
+    if lib is None:
+        raise RuntimeError("native library unavailable")
+    m = u.shape[0]
+    u = np.ascontiguousarray(u, dtype=np.int64)
+    v = np.ascontiguousarray(v, dtype=np.int64)
+    rank = np.ascontiguousarray(rank, dtype=np.int64)
+    indptr = np.empty(num_nodes + 1, dtype=np.int64)
+    adj_dst = np.empty(2 * m, dtype=np.int64)
+    adj_rank = np.empty(2 * m, dtype=np.int64)
+    lib.build_rank_csr(num_nodes, m, _ptr(u), _ptr(v), _ptr(rank),
+                       _ptr(indptr), _ptr(adj_dst), _ptr(adj_rank))
+    return indptr, adj_dst, adj_rank
 
 
 def build_csr_native(

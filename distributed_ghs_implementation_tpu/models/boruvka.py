@@ -94,6 +94,12 @@ def _max_levels(num_nodes: int) -> int:
     return max(1, math.ceil(math.log2(max(num_nodes, 2)))) + 1
 
 
+# Measured crossover: below this edge count the flat kernel's shared shape
+# buckets beat ELL's per-degree-signature compiles (single-device and sharded
+# auto strategies both use it).
+ELL_AUTO_EDGE_THRESHOLD = 1 << 17
+
+
 def boruvka_solve(
     fragment0: jax.Array,
     src: jax.Array,
@@ -167,8 +173,13 @@ _jit_solve = jax.jit(boruvka_solve)
 # ---------------------------------------------------------------------------
 
 
-def _ell_level(fragment, mst_ranks, buckets, ra, rb):
-    """One level over ELL buckets; returns (fragment2, mst2, has_any)."""
+def _ell_level(fragment, mst_ranks, buckets, ra, rb, *, axis_name=None):
+    """One level over ELL buckets; returns (fragment2, mst2, has_any).
+
+    With ``axis_name``, bucket rows are a shard and per-vertex minima are
+    merged across the mesh with one ``lax.pmin`` — the single collective per
+    level in the vertex-sharded layout.
+    """
     n = fragment.shape[0]
     ids = jnp.arange(n, dtype=jnp.int32)
     vmin = jnp.full(n, INT32_MAX, jnp.int32)
@@ -179,6 +190,8 @@ def _ell_level(fragment, mst_ranks, buckets, ra, rb):
         row_min = jnp.min(key, axis=1)
         # Pad rows alias vertex 0 with sentinel minima; scatter-min is inert.
         vmin = vmin.at[verts].min(row_min)
+    if axis_name is not None:
+        vmin = jax.lax.pmin(vmin, axis_name)
     moe = jnp.full(n, INT32_MAX, jnp.int32).at[fragment].min(vmin)
     has = moe < INT32_MAX
     safe = jnp.where(has, moe, 0)
@@ -190,12 +203,14 @@ def _ell_level(fragment, mst_ranks, buckets, ra, rb):
     return fragment2, mst2, jnp.any(has)
 
 
-@functools.partial(jax.jit, static_argnames=("num_nodes",))
-def _solve_ell(buckets, ra, rb, *, num_nodes: int):
-    """Full ELL solve from the identity partition."""
+def ell_solve_loop(buckets, ra, rb, *, num_nodes: int, axis_name=None):
+    """Full ELL solve from the identity partition (shared by the single-device
+    jit wrapper and the sharded shard_map body)."""
     fragment = jnp.arange(num_nodes, dtype=jnp.int32)
     mst_ranks = jnp.zeros(ra.shape[0], dtype=bool)
-    fragment, mst_ranks, has = _ell_level(fragment, mst_ranks, buckets, ra, rb)
+    fragment, mst_ranks, has = _ell_level(
+        fragment, mst_ranks, buckets, ra, rb, axis_name=axis_name
+    )
     max_levels = _max_levels(num_nodes)
 
     def cond(s):
@@ -203,13 +218,18 @@ def _solve_ell(buckets, ra, rb, *, num_nodes: int):
 
     def body(s):
         f, m, _, lv = s
-        f2, m2, h = _ell_level(f, m, buckets, ra, rb)
+        f2, m2, h = _ell_level(f, m, buckets, ra, rb, axis_name=axis_name)
         return (f2, m2, h, lv + 1)
 
     f, m, _, lv = jax.lax.while_loop(
         cond, body, (fragment, mst_ranks, has, jnp.ones((), jnp.int32))
     )
     return m, f, lv
+
+
+@functools.partial(jax.jit, static_argnames=("num_nodes",))
+def _solve_ell(buckets, ra, rb, *, num_nodes: int):
+    return ell_solve_loop(buckets, ra, rb, num_nodes=num_nodes)
 
 
 def prepare_ell_arrays(graph: Graph):
@@ -412,7 +432,7 @@ def solve_graph(
     if strategy == "auto":
         # ELL wins ~2x at scale but compiles per degree-distribution signature;
         # small graphs stay on the shape-bucketed flat kernel (shared compiles).
-        strategy = "ell" if graph.num_edges >= (1 << 17) else "fused"
+        strategy = "ell" if graph.num_edges >= ELL_AUTO_EDGE_THRESHOLD else "fused"
     if strategy == "ell":
         buckets, ra, rb, n_pad = prepare_ell_arrays(graph)
         mst_ranks, fragment, levels = _solve_ell(buckets, ra, rb, num_nodes=n_pad)

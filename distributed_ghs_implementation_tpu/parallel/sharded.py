@@ -81,13 +81,112 @@ def make_sharded_solver(mesh: Mesh, num_nodes: int):
     return jax.jit(mapped)
 
 
+@functools.lru_cache(maxsize=32)
+def make_sharded_ell_solver(mesh: Mesh, num_nodes: int):
+    """ELL kernel over a vertex-sharded mesh: each device owns a row slice of
+    every degree bucket (hubs spread evenly because buckets group by degree),
+    vertex state is replicated, and the only per-level communication is ONE
+    n-sized ``lax.pmin`` merging per-vertex minima — the flat edge-sharded
+    path needs three. Solver signature: ``(buckets, ra, rb) -> (mst_ranks,
+    fragment, levels)`` with ``buckets`` a tuple of ``(verts, dst, rank)``
+    whose leading axes divide by mesh size."""
+    from distributed_ghs_implementation_tpu.models.boruvka import ell_solve_loop
+
+    def shard_fn(buckets, ra, rb):
+        return ell_solve_loop(
+            buckets, ra, rb, num_nodes=num_nodes, axis_name=EDGE_AXIS
+        )
+
+    # shard_map needs the bucket tuple's specs spelled per leaf; wrap once per
+    # bucket count (jit then caches per array-shape signature as usual).
+    bucket_spec = (P(EDGE_AXIS), P(EDGE_AXIS, None), P(EDGE_AXIS, None))
+    wrapped = {}
+
+    def call(buckets, ra, rb):
+        k = len(buckets)
+        if k not in wrapped:
+            specs = tuple(bucket_spec for _ in range(k))
+            wrapped[k] = jax.jit(
+                shard_map_compat(
+                    shard_fn,
+                    mesh,
+                    in_specs=(specs, P(), P()),
+                    out_specs=(P(), P(), P()),
+                )
+            )
+        return wrapped[k](buckets, ra, rb)
+
+    return call
+
+
+def solve_graph_sharded_ell(
+    graph: Graph, *, mesh: Mesh | None = None
+) -> Tuple[np.ndarray, np.ndarray, int]:
+    """ELL strategy on a mesh; mirrors ``solve_graph_sharded``'s contract."""
+    if mesh is None:
+        mesh = edge_mesh()
+    n_dev = int(mesh.devices.size)
+    n = graph.num_nodes
+    if n == 0 or graph.num_edges == 0:
+        return np.zeros(0, dtype=np.int64), np.arange(n, dtype=np.int32), 0
+
+    n_pad = _next_pow2(n)
+    m_pad = _next_pow2(graph.num_edges)
+    ra_np, rb_np = graph.rank_endpoints(pad_to=m_pad)
+
+    int32_max = np.iinfo(np.int32).max
+    buckets = []
+    for verts, dstb, rankb in graph.ell_buckets:
+        vb, w = dstb.shape
+        vb_pad = int(math.ceil(vb / n_dev) * n_dev)
+        if vb_pad > vb:
+            pad = vb_pad - vb
+            verts = np.concatenate([verts, np.zeros(pad, dtype=np.int32)])
+            dstb = np.vstack([dstb, np.zeros((pad, w), dtype=np.int32)])
+            rankb = np.vstack([rankb, np.full((pad, w), int32_max, dtype=np.int32)])
+        row_sharding = NamedSharding(mesh, P(EDGE_AXIS, None))
+        vert_sharding = NamedSharding(mesh, P(EDGE_AXIS))
+        buckets.append(
+            (
+                jax.device_put(jnp.asarray(verts), vert_sharding),
+                jax.device_put(jnp.asarray(dstb), row_sharding),
+                jax.device_put(jnp.asarray(rankb), row_sharding),
+            )
+        )
+    rep = NamedSharding(mesh, P())
+    ra = jax.device_put(jnp.asarray(ra_np), rep)
+    rb = jax.device_put(jnp.asarray(rb_np), rep)
+
+    solver = make_sharded_ell_solver(mesh, n_pad)
+    mst_ranks, fragment, levels = solver(tuple(buckets), ra, rb)
+    ranks = np.nonzero(np.asarray(mst_ranks))[0]
+    edge_ids = np.sort(graph.edge_id_of_rank(ranks))
+    return edge_ids, np.asarray(fragment)[:n], int(levels)
+
+
 def solve_graph_sharded(
     graph: Graph,
     *,
     mesh: Mesh | None = None,
     bucket_shapes: bool = True,
+    strategy: str = "auto",
 ) -> Tuple[np.ndarray, np.ndarray, int]:
-    """Host entry mirroring ``models.boruvka.solve_graph`` on a device mesh."""
+    """Host entry mirroring ``models.boruvka.solve_graph`` on a device mesh.
+
+    ``strategy``: ``"flat"`` = edge-sharded flat kernel; ``"ell"`` =
+    vertex-sharded ELL kernel; ``"auto"`` mirrors the single-device choice
+    (ELL at scale, flat below it).
+    """
+    from distributed_ghs_implementation_tpu.models.boruvka import (
+        ELL_AUTO_EDGE_THRESHOLD,
+    )
+
+    if strategy == "auto":
+        strategy = "ell" if graph.num_edges >= ELL_AUTO_EDGE_THRESHOLD else "flat"
+    if strategy == "ell":
+        return solve_graph_sharded_ell(graph, mesh=mesh)
+    if strategy != "flat":
+        raise ValueError(f"unknown strategy {strategy!r}; expected auto|flat|ell")
     if mesh is None:
         mesh = edge_mesh()
     n_dev = mesh.devices.size

@@ -132,6 +132,47 @@ int64_t dimacs_parse(const char* path, int64_t* n_out, int64_t* u, int64_t* v,
   return count;
 }
 
+// Rank-sorted CSR over directed slots: like build_csr but each row is sorted
+// ascending by the per-edge rank (the kernel's total order), carrying the
+// rank instead of the weight. Counting sort by src then per-row std::sort —
+// O(E + sum_v d_v log d_v). Feeds Graph.ell_buckets at RMAT-22+ scale where
+// the NumPy lexsort path takes minutes.
+void build_rank_csr(int64_t n, int64_t m, const int64_t* u, const int64_t* v,
+                    const int64_t* rank, int64_t* indptr, int64_t* adj_dst,
+                    int64_t* adj_rank) {
+  std::memset(indptr, 0, sizeof(int64_t) * (size_t)(n + 1));
+  for (int64_t e = 0; e < m; ++e) {
+    ++indptr[u[e] + 1];
+    ++indptr[v[e] + 1];
+  }
+  for (int64_t i = 0; i < n; ++i) indptr[i + 1] += indptr[i];
+  std::vector<int64_t> cursor(indptr, indptr + n);
+  for (int64_t e = 0; e < m; ++e) {
+    int64_t cu = cursor[u[e]]++;
+    adj_dst[cu] = v[e];
+    adj_rank[cu] = rank[e];
+    int64_t cv = cursor[v[e]]++;
+    adj_dst[cv] = u[e];
+    adj_rank[cv] = rank[e];
+  }
+  struct Pair {
+    int64_t rank, dst;
+  };
+#pragma omp parallel for schedule(dynamic, 1024)
+  for (int64_t vtx = 0; vtx < n; ++vtx) {
+    const int64_t s = indptr[vtx], e = indptr[vtx + 1];
+    if (e - s < 2) continue;
+    std::vector<Pair> row((size_t)(e - s));
+    for (int64_t i = s; i < e; ++i) row[(size_t)(i - s)] = {adj_rank[i], adj_dst[i]};
+    std::sort(row.begin(), row.end(),
+              [](const Pair& a, const Pair& b) { return a.rank < b.rank; });
+    for (int64_t i = s; i < e; ++i) {
+      adj_rank[i] = row[(size_t)(i - s)].rank;
+      adj_dst[i] = row[(size_t)(i - s)].dst;
+    }
+  }
+}
+
 // CSR over directed slots from undirected edges: indptr has n+1 entries;
 // adj_dst/adj_w have 2m entries. Counting sort, O(n + m).
 void build_csr(int64_t n, int64_t m, const int64_t* u, const int64_t* v,
