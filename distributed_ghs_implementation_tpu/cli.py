@@ -85,7 +85,30 @@ def _cmd_run(args) -> int:
         primary = multihost.is_primary()
 
     g = _load_graph(args)
-    result = minimum_spanning_forest(g, backend=args.backend)
+    if args.checkpoint:
+        if args.backend != "device":
+            raise SystemExit("--checkpoint requires --backend device")
+        import numpy as np
+
+        from distributed_ghs_implementation_tpu.api import MSTResult
+        from distributed_ghs_implementation_tpu.utils.checkpoint import (
+            solve_graph_checkpointed,
+        )
+
+        t0 = time.perf_counter()
+        edge_ids, fragment, levels = solve_graph_checkpointed(
+            g, args.checkpoint, every=args.checkpoint_every
+        )
+        result = MSTResult(
+            graph=g,
+            edge_ids=edge_ids,
+            num_levels=levels,
+            wall_time_s=time.perf_counter() - t0,
+            backend="device/checkpointed",
+            num_components=int(np.unique(fragment).size),
+        )
+    else:
+        result = minimum_spanning_forest(g, backend=args.backend)
     if not primary:
         return 0  # artifacts are written by process 0 only
     print(json.dumps(result_to_dict(result), indent=2))
@@ -106,6 +129,19 @@ def _cmd_run(args) -> int:
             f"edges {v.actual_edges} vs {v.expected_edges})",
             file=sys.stderr,
         )
+        if not v.ok:
+            # Auto-dump diagnostics on failure, like the reference's debug
+            # dump trigger (ghs_implementation.py:735-737).
+            from distributed_ghs_implementation_tpu.utils.diagnostics import (
+                dump_failure_report,
+            )
+
+            path = dump_failure_report(
+                result, v,
+                path=os.path.splitext(args.output or "ghs_result")[0]
+                + "_failure_report.json",
+            )
+            print(f"diagnostics written to {path}", file=sys.stderr)
         return 0 if v.ok else 1
     return 0
 
@@ -195,6 +231,13 @@ def build_parser() -> argparse.ArgumentParser:
         "--multihost",
         action="store_true",
         help="initialize jax.distributed first (see launcher/run_ghs.slurm)",
+    )
+    r.add_argument(
+        "--checkpoint",
+        help="write per-level solver state here and resume from it if present",
+    )
+    r.add_argument(
+        "--checkpoint-every", type=int, default=1, help="levels between checkpoints"
     )
     r.set_defaults(fn=_cmd_run)
 

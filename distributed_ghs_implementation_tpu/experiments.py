@@ -63,6 +63,25 @@ def run_experiment(
         else scipy_mst_weight(graph)
     )
     record = experiment_record(result, oracle, index)
+    if not record["is_correct"]:
+        from distributed_ghs_implementation_tpu.utils.diagnostics import (
+            dump_failure_report,
+        )
+        from distributed_ghs_implementation_tpu.utils.verify import Verification
+
+        # Reuse the oracle weight computed above (recomputing it on a failed
+        # RMAT-scale run would cost minutes on the fail-fast path).
+        v = Verification(
+            ok=False,
+            expected_weight=float(oracle),
+            actual_weight=float(result.total_weight),
+            expected_edges=graph.num_nodes - result.num_components,
+            actual_edges=result.num_edges,
+            oracle="networkx" if graph.num_edges <= 200_000 else "scipy",
+        )
+        record["failure_report"] = dump_failure_report(
+            result, v, path=f"experiment_{index}_failure_report.json"
+        )
     if visualize_dir is not None:
         from distributed_ghs_implementation_tpu.utils.viz import visualize_mst
 

@@ -35,6 +35,21 @@ from distributed_ghs_implementation_tpu.parallel.mesh import (
 )
 
 
+def _stage(arr, sharding: NamedSharding) -> jax.Array:
+    """Host->mesh staging that also works across processes.
+
+    ``jax.device_put`` of host-local numpy onto a sharding that spans
+    non-addressable (cross-process) devices is not portable; in multi-process
+    runs each process instead contributes only its addressable shards via
+    ``make_array_from_callback`` (every host holds the full graph, so the
+    callback just slices it).
+    """
+    if jax.process_count() > 1:
+        arr = np.asarray(arr)
+        return jax.make_array_from_callback(arr.shape, sharding, lambda idx: arr[idx])
+    return jax.device_put(jnp.asarray(arr), sharding)
+
+
 @functools.lru_cache(maxsize=32)
 def make_sharded_solver(mesh: Mesh, num_nodes: int):
     """Build a jitted sharded solver ``(src, dst, rank, ra, rb) ->
@@ -148,14 +163,14 @@ def solve_graph_sharded_ell(
         vert_sharding = NamedSharding(mesh, P(EDGE_AXIS))
         buckets.append(
             (
-                jax.device_put(jnp.asarray(verts), vert_sharding),
-                jax.device_put(jnp.asarray(dstb), row_sharding),
-                jax.device_put(jnp.asarray(rankb), row_sharding),
+                _stage(verts, vert_sharding),
+                _stage(dstb, row_sharding),
+                _stage(rankb, row_sharding),
             )
         )
     rep = NamedSharding(mesh, P())
-    ra = jax.device_put(jnp.asarray(ra_np), rep)
-    rb = jax.device_put(jnp.asarray(rb_np), rep)
+    ra = _stage(ra_np, rep)
+    rb = _stage(rb_np, rep)
 
     solver = make_sharded_ell_solver(mesh, n_pad)
     mst_ranks, fragment, levels = solver(tuple(buckets), ra, rb)
@@ -181,12 +196,22 @@ def solve_graph_sharded(
         ELL_AUTO_EDGE_THRESHOLD,
     )
 
+    if strategy not in ("auto", "flat", "ell"):
+        raise ValueError(f"unknown strategy {strategy!r}; expected auto|flat|ell")
+    if jax.process_count() > 1:
+        # Flat outputs are slot-sharded (partially non-addressable per
+        # process); the ELL solver's outputs are replicated, so every process
+        # can harvest the MST locally.
+        if strategy == "flat":
+            raise ValueError(
+                "strategy='flat' is single-process only (slot-sharded outputs "
+                "are not harvestable across processes); use 'ell' or 'auto'"
+            )
+        strategy = "ell"
     if strategy == "auto":
         strategy = "ell" if graph.num_edges >= ELL_AUTO_EDGE_THRESHOLD else "flat"
     if strategy == "ell":
         return solve_graph_sharded_ell(graph, mesh=mesh)
-    if strategy != "flat":
-        raise ValueError(f"unknown strategy {strategy!r}; expected auto|flat|ell")
     if mesh is None:
         mesh = edge_mesh()
     n_dev = mesh.devices.size
@@ -204,11 +229,11 @@ def solve_graph_sharded(
 
     solver = make_sharded_solver(mesh, n_pad)
     edge_sharding = NamedSharding(mesh, P(EDGE_AXIS))
-    src = jax.device_put(jnp.asarray(src_np), edge_sharding)
-    dst = jax.device_put(jnp.asarray(dst_np), edge_sharding)
-    rank = jax.device_put(jnp.asarray(rank_np), edge_sharding)
-    ra = jax.device_put(jnp.asarray(ra_np), edge_sharding)
-    rb = jax.device_put(jnp.asarray(rb_np), edge_sharding)
+    src = _stage(src_np, edge_sharding)
+    dst = _stage(dst_np, edge_sharding)
+    rank = _stage(rank_np, edge_sharding)
+    ra = _stage(ra_np, edge_sharding)
+    rb = _stage(rb_np, edge_sharding)
     mst_ranks, fragment, levels = solver(src, dst, rank, ra, rb)
     ranks = np.nonzero(np.asarray(mst_ranks))[0]
     edge_ids = np.sort(graph.edge_id_of_rank(ranks))

@@ -19,7 +19,28 @@ import numpy as np
 from distributed_ghs_implementation_tpu.graphs.edgelist import Graph
 
 
-def save_checkpoint(path: str, fragment, mst_ranks, level: int) -> str:
+def graph_fingerprint(graph: Graph) -> np.ndarray:
+    """Cheap identity of a graph: ``[n, m, crc(u), crc(v), crc(w)]``.
+
+    Guards resume against a stale checkpoint from a *different* graph, which
+    would otherwise silently yield a wrong MST whenever the padded shapes
+    happen to collide (likely, since shapes are pow2-bucketed).
+    """
+    import zlib
+
+    return np.asarray(
+        [
+            graph.num_nodes,
+            graph.num_edges,
+            zlib.crc32(np.ascontiguousarray(graph.u)),
+            zlib.crc32(np.ascontiguousarray(graph.v)),
+            zlib.crc32(np.ascontiguousarray(graph.w)),
+        ],
+        dtype=np.int64,
+    )
+
+
+def save_checkpoint(path: str, fragment, mst_ranks, level: int, *, fingerprint=None) -> str:
     """Atomic npz write of the solver state (tmp file + rename)."""
     d = os.path.dirname(os.path.abspath(path)) or "."
     os.makedirs(d, exist_ok=True)
@@ -27,12 +48,14 @@ def save_checkpoint(path: str, fragment, mst_ranks, level: int) -> str:
     os.close(fd)
     try:
         with open(tmp, "wb") as f:
-            np.savez_compressed(
-                f,
+            arrays = dict(
                 fragment=np.asarray(fragment),
                 mst_ranks=np.asarray(mst_ranks),
                 level=np.asarray(level),
             )
+            if fingerprint is not None:
+                arrays["fingerprint"] = np.asarray(fingerprint)
+            np.savez_compressed(f, **arrays)
         os.replace(tmp, path)
     finally:
         if os.path.exists(tmp):
@@ -40,8 +63,19 @@ def save_checkpoint(path: str, fragment, mst_ranks, level: int) -> str:
     return path
 
 
-def load_checkpoint(path: str) -> Tuple[np.ndarray, np.ndarray, int]:
+def load_checkpoint(
+    path: str, *, expect_fingerprint=None
+) -> Tuple[np.ndarray, np.ndarray, int]:
+    """Load solver state; refuses a checkpoint whose fingerprint mismatches."""
     data = np.load(path)
+    if expect_fingerprint is not None:
+        stored = data.get("fingerprint")
+        if stored is None or not np.array_equal(stored, expect_fingerprint):
+            raise ValueError(
+                f"checkpoint {path} was written for a different graph "
+                f"(fingerprint {None if stored is None else stored.tolist()} "
+                f"!= expected {np.asarray(expect_fingerprint).tolist()})"
+            )
     return data["fragment"], data["mst_ranks"], int(data["level"])
 
 
@@ -65,18 +99,19 @@ def solve_graph_checkpointed(
         return np.zeros(0, dtype=np.int64), np.arange(n, dtype=np.int32), 0
 
     args = prepare_device_arrays(graph)
+    fp = graph_fingerprint(graph)
     initial_state = None
     if resume and os.path.exists(checkpoint_path):
-        initial_state = load_checkpoint(checkpoint_path)
+        initial_state = load_checkpoint(checkpoint_path, expect_fingerprint=fp)
 
     def on_level(level, fragment, mst_ranks, has, count, dt):
         if level % every == 0 or not has:
-            save_checkpoint(checkpoint_path, fragment, mst_ranks, level)
+            save_checkpoint(checkpoint_path, fragment, mst_ranks, level, fingerprint=fp)
 
     mst_ranks, fragment, levels = solve_arrays_stepped(
         *args, stepped_levels=None, initial_state=initial_state, on_level=on_level
     )
-    save_checkpoint(checkpoint_path, fragment, mst_ranks, levels)
+    save_checkpoint(checkpoint_path, fragment, mst_ranks, levels, fingerprint=fp)
 
     ranks_chosen = np.nonzero(np.asarray(mst_ranks))[0]
     edge_ids = np.sort(graph.edge_id_of_rank(ranks_chosen))

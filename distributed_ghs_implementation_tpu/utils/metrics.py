@@ -64,7 +64,9 @@ def solve_graph_instrumented(graph, *, compact: bool = True) -> tuple:
                 level=level,
                 fragments_before=frags_before[0],
                 fragments_after=frags_after,
-                edges_alive_after=count,
+                # The stepped kernel counts surviving *directed slots*; each
+                # undirected edge occupies two, so halve for the edge count.
+                edges_alive_after=count // 2,
                 wall_time_s=dt,
             )
         )

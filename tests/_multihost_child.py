@@ -1,0 +1,67 @@
+"""Child process for the 2-process jax.distributed test (see test_multihost.py).
+
+Usage: python _multihost_child.py <coordinator> <num_processes> <process_id> <outdir>
+
+Initializes the distributed runtime through ``parallel.multihost`` (the env-var
+names the SLURM launcher exports), builds a mesh spanning both processes, runs
+the sharded solve, and writes what it saw to ``<outdir>/proc<id>.json``.
+"""
+
+import json
+import os
+import sys
+
+
+def main() -> int:
+    coordinator, num_processes, process_id, outdir = sys.argv[1:5]
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=2"
+        ).strip()
+    # Exercise the launcher env-var path of multihost.initialize().
+    os.environ["JAX_COORDINATOR_ADDRESS"] = coordinator
+    os.environ["JAX_NUM_PROCESSES"] = num_processes
+    os.environ["JAX_PROCESS_ID"] = process_id
+
+    from distributed_ghs_implementation_tpu.parallel import multihost
+
+    multihost.initialize()
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    assert jax.process_count() == int(num_processes), jax.process_count()
+
+    from distributed_ghs_implementation_tpu.graphs.generators import (
+        erdos_renyi_graph,
+    )
+    from distributed_ghs_implementation_tpu.parallel.mesh import edge_mesh
+    from distributed_ghs_implementation_tpu.parallel.sharded import (
+        solve_graph_sharded,
+    )
+    from distributed_ghs_implementation_tpu.utils.verify import networkx_mst_weight
+
+    g = erdos_renyi_graph(120, 0.08, seed=33)
+    mesh = edge_mesh()  # spans all 4 devices across both processes
+    edge_ids, fragment, levels = solve_graph_sharded(g, mesh=mesh, strategy="ell")
+    weight = int(g.w[edge_ids].sum())
+    record = {
+        "process_id": int(process_id),
+        "process_count": jax.process_count(),
+        "local_devices": len(jax.local_devices()),
+        "global_devices": len(jax.devices()),
+        "is_primary": multihost.is_primary(),
+        "mst_weight": weight,
+        "mst_edges": len(edge_ids),
+        "levels": int(levels),
+        "expected_weight": float(networkx_mst_weight(g)),
+    }
+    with open(os.path.join(outdir, f"proc{process_id}.json"), "w") as f:
+        json.dump(record, f)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

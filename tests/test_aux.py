@@ -70,6 +70,8 @@ def test_checkpoint_resume_midway(tmp_path):
         prepare_device_arrays,
     )
 
+    from distributed_ghs_implementation_tpu.utils.checkpoint import graph_fingerprint
+
     g = line_graph(130)  # high diameter -> several levels
     frag0, src, dst, rank, ra, rb = prepare_device_arrays(g)
     mst = jnp.zeros(ra.shape[0], dtype=bool)
@@ -77,7 +79,7 @@ def test_checkpoint_resume_midway(tmp_path):
         frag0, mst, src, dst, rank, ra, rb
     )
     p = str(tmp_path / "mid.npz")
-    save_checkpoint(p, frag, mst, 1)
+    save_checkpoint(p, frag, mst, 1, fingerprint=graph_fingerprint(g))
 
     edge_ids, _, _ = solve_graph_checkpointed(g, p, resume=True)
     ref_ids, _, _ = solve_graph(g)
@@ -88,3 +90,121 @@ def test_multihost_helpers_single_process():
     from distributed_ghs_implementation_tpu.parallel import multihost
 
     assert multihost.is_primary()  # single-process run is its own primary
+
+
+def test_failure_report_schema(tmp_path):
+    """The diagnostics dump (reference print_debug_info analog) carries the
+    fragment histogram, alive-edge count, and unreachable-node detection."""
+    from distributed_ghs_implementation_tpu.api import minimum_spanning_forest
+    from distributed_ghs_implementation_tpu.utils.diagnostics import (
+        dump_failure_report,
+        failure_report,
+    )
+    from distributed_ghs_implementation_tpu.utils.verify import verify_result
+
+    g = erdos_renyi_graph(50, 0.15, seed=21)
+    result = minimum_spanning_forest(g)
+    # Simulate a failed run: drop two MST edges, splitting the tree in three.
+    import dataclasses
+
+    broken = dataclasses.replace(result, edge_ids=result.edge_ids[:-2])
+    v = verify_result(broken)
+    assert not v.ok
+    report = failure_report(broken, v)
+    assert report["schema"] == "ghs-failure-report-v1"
+    assert report["fragments"]["count"] == 3
+    assert sum(s * c for s, c in report["fragments"]["size_histogram"].items()) == 50
+    assert report["edges"]["alive_inter_fragment"] > 0
+    assert report["verification"]["ok"] is False
+    assert report["unreachable_from_node0"]["count"] > 0
+
+    p = str(tmp_path / "fail.json")
+    import json
+
+    assert dump_failure_report(broken, v, path=p) == p
+    with open(p) as f:
+        assert json.load(f)["schema"] == "ghs-failure-report-v1"
+
+
+def test_failure_report_protocol_nodes():
+    """Per-node protocol state tables ride along when the node map is given."""
+    from distributed_ghs_implementation_tpu.api import minimum_spanning_forest
+    from distributed_ghs_implementation_tpu.protocol.runner import run_protocol
+    from distributed_ghs_implementation_tpu.utils.diagnostics import failure_report
+
+    g = erdos_renyi_graph(12, 0.4, seed=22)
+    nodes, _ = run_protocol(g)
+    result = minimum_spanning_forest(g, backend="protocol")
+    report = failure_report(result, nodes=nodes)
+    assert report["protocol"]["edge_state_totals"]["BRANCH"] == 2 * (g.num_nodes - 1)
+    assert len(report["protocol"]["nodes"]) == 12
+    row = report["protocol"]["nodes"][0]
+    assert {"id", "state", "level", "fragment", "edge_states"} <= set(row)
+
+
+def test_midsolve_interrupt_resume(tmp_path):
+    """True mid-solve resume: interrupt after level 1, reload, finish —
+    byte-identical MST to the uninterrupted solve."""
+    from distributed_ghs_implementation_tpu.models.boruvka import (
+        prepare_device_arrays,
+        solve_arrays_stepped,
+    )
+    from distributed_ghs_implementation_tpu.utils.checkpoint import (
+        graph_fingerprint,
+        load_checkpoint,
+        save_checkpoint,
+        solve_graph_checkpointed,
+    )
+
+    g = erdos_renyi_graph(300, 0.04, seed=15)
+    args = prepare_device_arrays(g)
+    fp = graph_fingerprint(g)
+    p = str(tmp_path / "mid.npz")
+
+    # Run exactly one level, checkpoint, and abandon the run ("interrupt").
+    seen = []
+
+    def on_level(level, fragment, mst_ranks, has, count, dt):
+        save_checkpoint(p, fragment, mst_ranks, level, fingerprint=fp)
+        seen.append(level)
+
+    solve_arrays_stepped(*args, stepped_levels=1, on_level=on_level)
+    assert seen == [1]
+    _, _, lv = load_checkpoint(p, expect_fingerprint=fp)
+    assert lv == 1
+
+    # Resume from the level-1 state and compare to a clean solve.
+    edge_ids, fragment, levels = solve_graph_checkpointed(g, p, resume=True)
+    ref_ids, ref_frag, _ = solve_graph(g)
+    assert np.array_equal(edge_ids, ref_ids)
+    assert np.array_equal(fragment, ref_frag)
+
+
+def test_checkpoint_fingerprint_mismatch(tmp_path):
+    """A checkpoint from a different graph is refused, not silently resumed."""
+    from distributed_ghs_implementation_tpu.utils.checkpoint import (
+        solve_graph_checkpointed,
+    )
+
+    g1 = erdos_renyi_graph(100, 0.1, seed=16)
+    g2 = erdos_renyi_graph(100, 0.1, seed=17)  # same shapes, different graph
+    p = str(tmp_path / "fp.npz")
+    solve_graph_checkpointed(g1, p)
+    with pytest.raises(ValueError, match="different graph"):
+        solve_graph_checkpointed(g2, p, resume=True)
+
+
+def test_cli_run_checkpoint(tmp_path):
+    """`run --checkpoint` is reachable from the CLI and verifies green."""
+    from distributed_ghs_implementation_tpu.cli import main as cli_main
+    from distributed_ghs_implementation_tpu.graphs import io as gio
+
+    g = erdos_renyi_graph(80, 0.1, seed=18)
+    npz = str(tmp_path / "graph.npz")
+    gio.write_npz(g, npz)
+    ckpt = str(tmp_path / "run.npz")
+    rc = cli_main(
+        ["run", "--graph-dir", npz, "--checkpoint", ckpt, "--verify"]
+    )
+    assert rc == 0
+    assert os.path.exists(ckpt)
