@@ -82,6 +82,15 @@ def get_lib() -> Optional[ctypes.CDLL]:
             ctypes.c_int64, ctypes.c_int64, _I64, _I64, _I64, _I64, _I64, _I64,
         ]
         lib.build_rank_csr.restype = None
+        lib.first_rank.argtypes = [
+            ctypes.c_int64, ctypes.c_int64, _I64, _I64,
+            ctypes.POINTER(ctypes.c_int32),
+        ]
+        lib.first_rank.restype = None
+        lib.rank_order_counting.argtypes = [
+            ctypes.c_int64, _I64, ctypes.c_int64, ctypes.c_int64, _I64,
+        ]
+        lib.rank_order_counting.restype = ctypes.c_int
         _lib = lib
         return _lib
 
@@ -156,6 +165,35 @@ def build_rank_csr_native(
     lib.build_rank_csr(num_nodes, m, _ptr(u), _ptr(v), _ptr(rank),
                        _ptr(indptr), _ptr(adj_dst), _ptr(adj_rank))
     return indptr, adj_dst, adj_rank
+
+
+def first_rank_native(num_nodes: int, ra: np.ndarray, rb: np.ndarray) -> np.ndarray:
+    """Per-vertex min incident rank over rank-ordered endpoints (INT32_MAX if
+    isolated) — Boruvka level 1, computed host-side in one O(m) pass."""
+    lib = get_lib()
+    if lib is None:
+        raise RuntimeError("native library unavailable")
+    ra = np.ascontiguousarray(ra, dtype=np.int64)
+    rb = np.ascontiguousarray(rb, dtype=np.int64)
+    out = np.empty(num_nodes, dtype=np.int32)
+    lib.first_rank(
+        num_nodes, ra.shape[0], _ptr(ra), _ptr(rb),
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+    )
+    return out
+
+
+def rank_order_counting_native(w: np.ndarray) -> Optional[np.ndarray]:
+    """Stable counting-sort rank order by (weight, edge id); None when weights
+    are non-integer / too wide (caller falls back to lexsort)."""
+    lib = get_lib()
+    if lib is None or w.dtype.kind not in "iu" or w.size == 0:
+        return None
+    w = np.ascontiguousarray(w, dtype=np.int64)
+    wlow, whigh = int(w.min()), int(w.max())
+    order = np.empty(w.shape[0], dtype=np.int64)
+    ok = lib.rank_order_counting(w.shape[0], _ptr(w), wlow, whigh, _ptr(order))
+    return order if ok else None
 
 
 def build_csr_native(

@@ -173,30 +173,47 @@ _jit_solve = jax.jit(boruvka_solve)
 # ---------------------------------------------------------------------------
 
 
-def _ell_level(fragment, mst_ranks, buckets, ra, rb, *, axis_name=None):
+def _ell_level(
+    fragment, mst_ranks, buckets, ra, rb, *, axis_name=None, identity_fragment=False
+):
     """One level over ELL buckets; returns (fragment2, mst2, has_any).
 
     With ``axis_name``, bucket rows are a shard and per-vertex minima are
     merged across the mesh with one ``lax.pmin`` — the single collective per
-    level in the vertex-sharded layout.
+    level in the vertex-sharded layout. ``identity_fragment`` marks the
+    level-0 fast path: when ``fragment == iota`` the two bucket gathers are
+    the identity, and because rows hold no self-edges *every* row entry is
+    outgoing — the whole scan collapses to "first rank in each row" (rows are
+    rank-sorted), skipping the level's dominant cost (the ~2e-sized
+    ``fragment[dstb]`` random gather).
     """
     n = fragment.shape[0]
     ids = jnp.arange(n, dtype=jnp.int32)
     vmin = jnp.full(n, INT32_MAX, jnp.int32)
     for verts, dstb, rankb in buckets:
-        fv = fragment[verts]
-        fd = fragment[dstb]
-        key = jnp.where(fd != fv[:, None], rankb, INT32_MAX)
-        row_min = jnp.min(key, axis=1)
+        if identity_fragment:
+            row_min = rankb[:, 0]  # rank-sorted rows: first entry is the min
+        else:
+            fv = fragment[verts]
+            fd = fragment[dstb]
+            key = jnp.where(fd != fv[:, None], rankb, INT32_MAX)
+            row_min = jnp.min(key, axis=1)
         # Pad rows alias vertex 0 with sentinel minima; scatter-min is inert.
         vmin = vmin.at[verts].min(row_min)
     if axis_name is not None:
         vmin = jax.lax.pmin(vmin, axis_name)
-    moe = jnp.full(n, INT32_MAX, jnp.int32).at[fragment].min(vmin)
+    if identity_fragment:
+        moe = vmin  # per-vertex minima ARE per-fragment minima at level 0
+    else:
+        moe = jnp.full(n, INT32_MAX, jnp.int32).at[fragment].min(vmin)
     has = moe < INT32_MAX
     safe = jnp.where(has, moe, 0)
-    fa = fragment[ra[safe]]
-    fb = fragment[rb[safe]]
+    if identity_fragment:
+        fa = ra[safe]
+        fb = rb[safe]
+    else:
+        fa = fragment[ra[safe]]
+        fb = fragment[rb[safe]]
     dst_frag = jnp.where(has, jnp.where(fa == ids, fb, fa), ids)
     fragment2, _ = hook_and_compress(has, dst_frag, fragment)
     mst2 = mst_ranks.at[safe].max(has)
@@ -209,7 +226,8 @@ def ell_solve_loop(buckets, ra, rb, *, num_nodes: int, axis_name=None):
     fragment = jnp.arange(num_nodes, dtype=jnp.int32)
     mst_ranks = jnp.zeros(ra.shape[0], dtype=bool)
     fragment, mst_ranks, has = _ell_level(
-        fragment, mst_ranks, buckets, ra, rb, axis_name=axis_name
+        fragment, mst_ranks, buckets, ra, rb, axis_name=axis_name,
+        identity_fragment=True,
     )
     max_levels = _max_levels(num_nodes)
 
@@ -419,20 +437,28 @@ def solve_graph(
     Returns ``(mst_edge_ids, fragment, levels)`` where ``mst_edge_ids`` are
     indices into ``graph.u/v/w`` (undirected), sorted ascending.
 
-    ``strategy``: ``"ell"`` = degree-bucketed dense-reduction kernel (default;
-    ~2x the flat kernel on TPU — no e-sized scatters); ``"fused"`` = flat
-    single on-device while_loop; ``"stepped"`` = host-stepped levels with edge
-    compaction — measured slower on the current single-chip setup (per-level
-    host syncs outweigh the shrink; RMAT kills only ~18% of edges at level 1),
-    kept for graphs whose early levels do shrink sharply.
+    ``strategy``: ``"rank"`` = rank-space solver (default at scale: host-side
+    level 1, rank-space level 2, compacted finish — see
+    ``models/rank_solver.py``); ``"ell"`` = degree-bucketed dense-reduction
+    kernel; ``"fused"`` = flat single on-device while_loop (default for small
+    graphs: shared pow2-bucketed compiles, one dispatch); ``"stepped"`` =
+    host-stepped levels with edge compaction, kept for instrumentation and
+    checkpointing.
     """
     n = graph.num_nodes
     if n == 0 or graph.num_edges == 0:
         return np.zeros(0, dtype=np.int64), np.arange(n, dtype=np.int32), 0
     if strategy == "auto":
-        # ELL wins ~2x at scale but compiles per degree-distribution signature;
-        # small graphs stay on the shape-bucketed flat kernel (shared compiles).
-        strategy = "ell" if graph.num_edges >= ELL_AUTO_EDGE_THRESHOLD else "fused"
+        # Rank solver wins at scale (measured ~2.4x over ELL on RMAT-20 and
+        # far cheaper host prep); small graphs stay on the shape-bucketed flat
+        # kernel (shared compiles, single dispatch).
+        strategy = "rank" if graph.num_edges >= ELL_AUTO_EDGE_THRESHOLD else "fused"
+    if strategy == "rank":
+        from distributed_ghs_implementation_tpu.models.rank_solver import (
+            solve_graph_rank,
+        )
+
+        return solve_graph_rank(graph)
     if strategy == "ell":
         buckets, ra, rb, n_pad = prepare_ell_arrays(graph)
         mst_ranks, fragment, levels = _solve_ell(buckets, ra, rb, num_nodes=n_pad)

@@ -111,6 +111,14 @@ int64_t dimacs_parse(const char* path, int64_t* n_out, int64_t* u, int64_t* v,
   int64_t count = 0;
   *n_out = 0;
   while (std::fgets(line, sizeof line, f)) {
+    // A line longer than the buffer would leave its tail to be misread as a
+    // fresh record (desyncing the two-phase count/fill passes); consume the
+    // remainder so each physical line is parsed exactly once.
+    if (!std::strchr(line, '\n') && !std::feof(f)) {
+      int ch;
+      while ((ch = std::fgetc(f)) != EOF && ch != '\n') {
+      }
+    }
     if (line[0] == 'p') {
       long long n = 0, m = 0;
       std::sscanf(line, "p %*s %lld %lld", &n, &m);
@@ -171,6 +179,35 @@ void build_rank_csr(int64_t n, int64_t m, const int64_t* u, const int64_t* v,
       adj_dst[i] = row[(size_t)(i - s)].dst;
     }
   }
+}
+
+// Per-vertex minimum incident rank: one O(m) pass over rank-ordered endpoint
+// arrays (ra[r], rb[r] = endpoints of the rank-r edge). out has n entries,
+// INT32_MAX sentinel for isolated vertices. This IS Boruvka level 1 (every
+// incident edge is outgoing at level 0), done on the host for free.
+void first_rank(int64_t n, int64_t m, const int64_t* ra, const int64_t* rb,
+                int32_t* out) {
+  const int32_t kMax = 0x7fffffff;
+  for (int64_t v = 0; v < n; ++v) out[v] = kMax;
+  for (int64_t r = 0; r < m; ++r) {
+    if (out[ra[r]] == kMax) out[ra[r]] = (int32_t)r;
+    if (out[rb[r]] == kMax) out[rb[r]] = (int32_t)r;
+  }
+}
+
+// Stable counting sort of edge ids by integer weight (ranks ascending by
+// (weight, edge id)) for small weight ranges — the lexsort that dominates
+// host prep at RMAT-24 scale becomes O(m + range).
+// Returns 1 on success, 0 when the range is too large (caller falls back).
+int rank_order_counting(int64_t m, const int64_t* w, int64_t wlow,
+                        int64_t whigh, int64_t* order) {
+  const int64_t range = whigh - wlow + 1;
+  if (range <= 0 || range > (1 << 22)) return 0;
+  std::vector<int64_t> count((size_t)range + 1, 0);
+  for (int64_t e = 0; e < m; ++e) ++count[w[e] - wlow + 1];
+  for (int64_t i = 0; i < range; ++i) count[i + 1] += count[i];
+  for (int64_t e = 0; e < m; ++e) order[count[w[e] - wlow]++] = e;
+  return 1;
 }
 
 // CSR over directed slots from undirected edges: indptr has n+1 entries;

@@ -186,10 +186,11 @@ def test_all_strategies_agree():
 
     g = erdos_renyi_graph(120, 0.08, seed=21)
     results = {
-        s: solve_graph(g, strategy=s)[0] for s in ["ell", "stepped", "fused"]
+        s: solve_graph(g, strategy=s)[0] for s in ["ell", "stepped", "fused", "rank"]
     }
     assert np.array_equal(results["ell"], results["fused"])
     assert np.array_equal(results["stepped"], results["fused"])
+    assert np.array_equal(results["rank"], results["fused"])
 
 
 @pytest.mark.parametrize("seed", range(4))
@@ -214,3 +215,70 @@ def test_ghs_algorithm_api():
     mst = ghs.run(timeout=15)  # timeout accepted for parity, unused
     assert sorted(mst) == [(0, 1), (1, 2), (2, 3)]
     assert ghs.get_mst_weight() == 6
+
+
+@pytest.mark.parametrize("seed", range(4))
+def test_rank_strategy_oracle(seed):
+    """The rank-space solver against the external oracle on skewed-degree
+    graphs, plus byte-identical agreement with the fused kernel."""
+    from distributed_ghs_implementation_tpu.models.boruvka import solve_graph
+    from distributed_ghs_implementation_tpu.utils.verify import scipy_mst_weight
+
+    g = rmat_graph(9, 8, seed=seed, use_native=False)
+    edge_ids, fragment, _ = solve_graph(g, strategy="rank")
+    assert float(g.w[edge_ids].sum()) == pytest.approx(scipy_mst_weight(g))
+    assert len(edge_ids) == g.num_nodes - np.unique(fragment).size
+    fused_ids, fused_frag, _ = solve_graph(g, strategy="fused")
+    assert np.array_equal(edge_ids, fused_ids)
+    assert np.array_equal(fragment, fused_frag)
+
+
+def test_rank_strategy_edge_cases():
+    from distributed_ghs_implementation_tpu.models.boruvka import solve_graph
+    from distributed_ghs_implementation_tpu.models.rank_solver import (
+        solve_graph_rank,
+    )
+
+    # Disconnected forest, high diameter, floats, ties.
+    for g in [
+        line_graph(700),
+        Graph.from_edges(7, [(0, 1, 5), (2, 3, 1), (3, 4, 1), (5, 6, 2)]),
+        Graph.from_edges(4, [(0, 1, 0.5), (1, 2, 0.25), (2, 3, 0.75), (0, 3, 0.1)]),
+        Graph.from_edges(5, [(0, 1, 1), (1, 2, 1), (2, 3, 1), (3, 4, 1), (0, 4, 1)]),
+    ]:
+        ids_r, frag_r, _ = solve_graph_rank(g)
+        ids_f, frag_f, _ = solve_graph(g, strategy="fused")
+        assert np.array_equal(ids_r, ids_f)
+        assert np.array_equal(frag_r, frag_f)
+
+
+def test_first_ranks_native_matches_numpy():
+    """Graph.first_ranks: native O(m) pass == NumPy unique fallback."""
+    from distributed_ghs_implementation_tpu.graphs import native
+
+    g = rmat_graph(10, 8, seed=3, use_native=False)
+    got = g.first_ranks
+    m = g.num_edges
+    order = g._rank_order
+    ra, rb = g.u[order], g.v[order]
+    expect = np.full(g.num_nodes, np.iinfo(np.int32).max, dtype=np.int32)
+    for r in range(m - 1, -1, -1):
+        expect[ra[r]] = r
+        expect[rb[r]] = r
+    assert np.array_equal(got, expect)
+    if native.native_available():
+        assert np.array_equal(
+            native.first_rank_native(g.num_nodes, ra, rb), expect
+        )
+
+
+def test_rank_order_counting_matches_lexsort():
+    """The native counting-sort rank order is the exact lexsort order."""
+    from distributed_ghs_implementation_tpu.graphs import native
+
+    rng = np.random.default_rng(9)
+    w = rng.integers(1, 50, size=5000).astype(np.int64)
+    expect = np.lexsort((np.arange(w.size), w))
+    got = native.rank_order_counting_native(w)
+    if got is not None:
+        assert np.array_equal(got, expect)

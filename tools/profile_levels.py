@@ -1,0 +1,114 @@
+"""Per-level timing breakdown of the ELL kernel on a real chip.
+
+Answers VERDICT weak #1: where does the RMAT-20 solve time go? Times each
+level individually (jitted single-level call + device sync), reports alive
+fragment counts so the shrink profile is visible, then prints the fused
+while_loop time for comparison (per-level sync overhead is the difference).
+
+Usage: python tools/profile_levels.py [--scale 20] [--edge-factor 16]
+"""
+
+from __future__ import annotations
+
+import argparse
+import functools
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from distributed_ghs_implementation_tpu.graphs.generators import rmat_graph
+from distributed_ghs_implementation_tpu.models.boruvka import (
+    _ell_level,
+    _solve_ell,
+    prepare_ell_arrays,
+)
+
+
+@functools.partial(jax.jit, static_argnames=("nbuckets",))
+def _one_level(fragment, mst_ranks, *flat, nbuckets: int):
+    buckets = tuple(
+        (flat[3 * i], flat[3 * i + 1], flat[3 * i + 2]) for i in range(nbuckets)
+    )
+    ra, rb = flat[3 * nbuckets], flat[3 * nbuckets + 1]
+    f2, m2, has = _ell_level(fragment, mst_ranks, buckets, ra, rb)
+    # fragment entries are root ids and roots map to themselves, so the
+    # distinct count is the number of self-mapped vertices (no sort needed).
+    ids = jnp.arange(f2.shape[0], dtype=f2.dtype)
+    return f2, m2, has, jnp.sum(f2 == ids)
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--scale", type=int, default=20)
+    p.add_argument("--edge-factor", type=int, default=16)
+    p.add_argument("--trace-dir", default=None, help="write a jax profiler trace here")
+    args = p.parse_args()
+
+    t0 = time.perf_counter()
+    g = rmat_graph(args.scale, args.edge_factor, seed=24)
+    t_gen = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    buckets, ra, rb, n_pad = prepare_ell_arrays(g)
+    t_prep = time.perf_counter() - t0
+    slot_total = sum(int(b[1].size) for b in buckets)
+    print(
+        f"RMAT-{args.scale}: n={g.num_nodes:,} m={g.num_edges:,} "
+        f"gen={t_gen:.1f}s prep={t_prep:.1f}s "
+        f"buckets={len(buckets)} padded_slots={slot_total:,} "
+        f"(directed={2 * g.num_edges:,})"
+    )
+    for verts, dstb, rankb in buckets:
+        print(f"  bucket W={dstb.shape[1]:>6}  rows={dstb.shape[0]:>9,}  slots={dstb.size:>11,}")
+
+    flat = []
+    for b in buckets:
+        flat.extend(b)
+    flat.extend([ra, rb])
+    nb = len(buckets)
+
+    fragment = jnp.arange(n_pad, dtype=jnp.int32)
+    mst_ranks = jnp.zeros(ra.shape[0], dtype=bool)
+    # warm compile (int() forces a real sync; block_until_ready does not
+    # block on the axon remote backend)
+    f2, m2, has, nf = _one_level(fragment, mst_ranks, *flat, nbuckets=nb)
+    _ = int(nf)
+
+    fragment = jnp.arange(n_pad, dtype=jnp.int32)
+    mst_ranks = jnp.zeros(ra.shape[0], dtype=bool)
+    level = 0
+    total = 0.0
+    while True:
+        t0 = time.perf_counter()
+        fragment, mst_ranks, has, nfrag = _one_level(
+            fragment, mst_ranks, *flat, nbuckets=nb
+        )
+        nfrag_i = int(nfrag)  # syncs the whole level
+        dt = time.perf_counter() - t0
+        total += dt
+        level += 1
+        print(f"level {level:2d}: {dt * 1e3:8.2f} ms  fragments={nfrag_i:,}")
+        if not bool(has) or level > 40:
+            break
+    print(f"stepped total: {total:.3f} s")
+
+    out = _solve_ell(buckets_j := tuple(buckets), ra, rb, num_nodes=n_pad)
+    _ = int(out[2])
+    times = []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        out = _solve_ell(buckets_j, ra, rb, num_nodes=n_pad)
+        _ = int(out[2])
+        times.append(time.perf_counter() - t0)
+    print(f"fused while_loop: best {min(times):.3f} s, levels={int(out[2])}")
+
+    if args.trace_dir:
+        with jax.profiler.trace(args.trace_dir):
+            out = _solve_ell(buckets_j, ra, rb, num_nodes=n_pad)
+            jax.block_until_ready(out[0])
+        print(f"trace written to {args.trace_dir}")
+
+
+if __name__ == "__main__":
+    main()

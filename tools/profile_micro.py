@@ -1,0 +1,132 @@
+"""Micro-decomposition of one ELL level's cost on the real chip.
+
+Times, with full output sync and repeats: (a) a whole ``_ell_level`` at a
+realistic mid-solve fragment state, (b) the bucket scan alone, (c) the
+per-fragment scatter-min alone, (d) ``hook_and_compress`` alone, (e) the
+rank-endpoint lookups. Answers: where do the ~780 ms/level go?
+"""
+
+from __future__ import annotations
+
+import argparse
+import functools
+import time
+
+import jax
+import jax.numpy as jnp
+
+from distributed_ghs_implementation_tpu.graphs.generators import rmat_graph
+from distributed_ghs_implementation_tpu.models.boruvka import (
+    _ell_level,
+    prepare_ell_arrays,
+)
+from distributed_ghs_implementation_tpu.ops.segment_ops import INT32_MAX
+from distributed_ghs_implementation_tpu.ops.union_find import hook_and_compress
+
+
+def _sync(out):
+    """Force completion: fetch one element of every output buffer.
+
+    ``block_until_ready`` does not actually block on the axon remote backend,
+    so timings must be closed with a real device->host transfer.
+    """
+    import numpy as np
+
+    for leaf in jax.tree_util.tree_leaves(out):
+        if hasattr(leaf, "ravel") and getattr(leaf, "size", 0):
+            np.asarray(leaf.ravel()[0])
+
+
+def timeit(fn, *args, repeats=5, **kw):
+    out = fn(*args, **kw)
+    _sync(out)
+    best = float("inf")
+    for _ in range(repeats):
+        t0 = time.perf_counter()
+        out = fn(*args, **kw)
+        _sync(out)
+        best = min(best, time.perf_counter() - t0)
+    return best, out
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--scale", type=int, default=20)
+    args = p.parse_args()
+
+    g = rmat_graph(args.scale, 16, seed=24)
+    buckets, ra, rb, n_pad = prepare_ell_arrays(g)
+    nb = len(buckets)
+
+    def flatten(bs):
+        flat = []
+        for b in bs:
+            flat.extend(b)
+        return flat
+
+    flat = flatten(buckets) + [ra, rb]
+
+    @functools.partial(jax.jit, static_argnames=("nbuckets",))
+    def level(fragment, mst_ranks, *f, nbuckets):
+        bs = tuple((f[3 * i], f[3 * i + 1], f[3 * i + 2]) for i in range(nbuckets))
+        return _ell_level(fragment, mst_ranks, bs, f[3 * nbuckets], f[3 * nbuckets + 1])
+
+    @functools.partial(jax.jit, static_argnames=("nbuckets",))
+    def scan_only(fragment, *f, nbuckets):
+        n = fragment.shape[0]
+        vmin = jnp.full(n, INT32_MAX, jnp.int32)
+        for i in range(nbuckets):
+            verts, dstb, rankb = f[3 * i], f[3 * i + 1], f[3 * i + 2]
+            fv = fragment[verts]
+            fd = fragment[dstb]
+            key = jnp.where(fd != fv[:, None], rankb, INT32_MAX)
+            vmin = vmin.at[verts].min(jnp.min(key, axis=1))
+        return vmin
+
+    @functools.partial(jax.jit, static_argnames=("nbuckets",))
+    def scan_gathers_only(fragment, *f, nbuckets):
+        n = fragment.shape[0]
+        acc = jnp.zeros((), jnp.int32)
+        for i in range(nbuckets):
+            dstb = f[3 * i + 1]
+            fd = fragment[dstb]
+            acc += jnp.min(fd)
+        return acc
+
+    @jax.jit
+    def scatter_min(fragment, vmin):
+        n = fragment.shape[0]
+        return jnp.full(n, INT32_MAX, jnp.int32).at[fragment].min(vmin)
+
+    @jax.jit
+    def hook(has, dst_frag, fragment):
+        return hook_and_compress(has, dst_frag, fragment)
+
+    # Produce a realistic post-level-1 fragment state.
+    fragment0 = jnp.arange(n_pad, dtype=jnp.int32)
+    mst0 = jnp.zeros(ra.shape[0], dtype=bool)
+    f1, m1, _ = level(fragment0, mst0, *flat, nbuckets=nb)
+    jax.block_until_ready(f1)
+
+    t, _ = timeit(level, fragment0, mst0, *flat, nbuckets=nb)
+    print(f"full level @identity fragment : {t * 1e3:8.2f} ms")
+    t, _ = timeit(level, f1, m1, *flat, nbuckets=nb)
+    print(f"full level @post-L1 fragment  : {t * 1e3:8.2f} ms")
+    t, vmin = timeit(scan_only, f1, *flat, nbuckets=nb)
+    print(f"bucket scan only              : {t * 1e3:8.2f} ms")
+    t, _ = timeit(scan_gathers_only, f1, *flat, nbuckets=nb)
+    print(f"bucket fd-gathers only        : {t * 1e3:8.2f} ms")
+    t, moe = timeit(scatter_min, f1, vmin)
+    print(f"fragment scatter-min          : {t * 1e3:8.2f} ms")
+    has = moe < INT32_MAX
+    ids = jnp.arange(n_pad, dtype=jnp.int32)
+    safe = jnp.where(has, moe, 0)
+    fa = f1[ra[safe]]
+    fb = f1[rb[safe]]
+    dst_frag = jnp.where(has, jnp.where(fa == ids, fb, fa), ids)
+    t, _ = timeit(hook, has, dst_frag, f1)
+    print(f"hook_and_compress             : {t * 1e3:8.2f} ms")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,96 @@
+"""Raw op throughput on the chip: dispatch overhead, gather, scatter,
+segment_min, pointer_jump — the numbers the kernel design trades on."""
+
+from __future__ import annotations
+
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def _sync(out):
+    for leaf in jax.tree_util.tree_leaves(out):
+        if hasattr(leaf, "ravel") and getattr(leaf, "size", 0):
+            np.asarray(leaf.ravel()[0])
+
+
+def timeit(fn, *args, repeats=5, **kw):
+    out = fn(*args, **kw)
+    _sync(out)
+    best = float("inf")
+    for _ in range(repeats):
+        t0 = time.perf_counter()
+        out = fn(*args, **kw)
+        _sync(out)
+        best = min(best, time.perf_counter() - t0)
+    return best
+
+
+def main():
+    rng = np.random.default_rng(0)
+    n = 1 << 20
+
+    trivial = jax.jit(lambda x: x + 1)
+    t = timeit(trivial, jnp.zeros((), jnp.int32))
+    print(f"dispatch overhead (scalar +1)      : {t * 1e3:8.2f} ms")
+
+    table = jnp.asarray(rng.integers(0, n, n, dtype=np.int32))
+    for e in (20, 24, 26):
+        idx = jnp.asarray(rng.integers(0, n, 1 << e, dtype=np.int32))
+        gather = jax.jit(lambda t_, i_: t_[i_])
+        t = timeit(gather, table, idx)
+        print(f"gather  {1 << e:>11,} from 1M        : {t * 1e3:8.2f} ms  "
+              f"({t / (1 << e) * 1e9:5.2f} ns/elem)")
+
+    for e in (20, 24):
+        sz = 1 << e
+        idx = jnp.asarray(rng.integers(0, sz, sz, dtype=np.int32))
+        vals = jnp.asarray(rng.integers(0, 1 << 30, sz, dtype=np.int32))
+        sset = jax.jit(lambda i_, v_, s=sz: jnp.zeros(s, jnp.int32).at[i_].set(v_, mode="drop"))
+        t = timeit(sset, idx, vals)
+        print(f"scatter-set {sz:>11,} -> {sz:>11,}  : {t * 1e3:8.2f} ms  "
+              f"({t / sz * 1e9:5.2f} ns/elem)")
+        smin = jax.jit(lambda i_, v_, s=sz: jnp.full(s, 2**31 - 1, jnp.int32).at[i_].min(v_))
+        t = timeit(smin, idx, vals)
+        print(f"scatter-min {sz:>11,} -> {sz:>11,}  : {t * 1e3:8.2f} ms  "
+              f"({t / sz * 1e9:5.2f} ns/elem)")
+
+    # segment_min at edge scale into 1M segments (the flat kernel's core).
+    for e in (24, 25):
+        sz = 1 << e
+        seg = jnp.asarray(rng.integers(0, n, sz, dtype=np.int32))
+        vals = jnp.asarray(rng.integers(0, 1 << 30, sz, dtype=np.int32))
+        f = jax.jit(lambda v_, s_: jax.ops.segment_min(v_, s_, num_segments=n))
+        t = timeit(f, vals, seg)
+        print(f"segment_min {sz:>11,} -> 1M         : {t * 1e3:8.2f} ms  "
+              f"({t / sz * 1e9:5.2f} ns/elem)")
+    # sorted-segment variant (CSR order)
+    seg_sorted = jnp.sort(seg)
+    f2 = jax.jit(
+        lambda v_, s_: jax.ops.segment_min(
+            v_, s_, num_segments=n, indices_are_sorted=True
+        )
+    )
+    t = timeit(f2, vals, seg_sorted)
+    print(f"segment_min sorted {1 << 25:>11,} -> 1M  : {t * 1e3:8.2f} ms")
+
+    # pointer_jump fixed iteration counts on 1M
+    parent = jnp.asarray(rng.integers(0, n, n, dtype=np.int32))
+    for k in (1, 2, 4, 8):
+        f3 = jax.jit(
+            lambda p_, k=k: jax.lax.fori_loop(0, k, lambda _, q: q[q], p_)
+        )
+        t = timeit(f3, parent)
+        print(f"pointer jump x{k} on 1M             : {t * 1e3:8.2f} ms")
+
+    # cumsum + compare at 16M (compaction building blocks)
+    big = jnp.asarray(rng.integers(0, 2, 1 << 24, dtype=np.int32))
+    f4 = jax.jit(lambda b_: jnp.cumsum(b_))
+    t = timeit(f4, big)
+    print(f"cumsum 16M                         : {t * 1e3:8.2f} ms")
+
+
+if __name__ == "__main__":
+    main()
