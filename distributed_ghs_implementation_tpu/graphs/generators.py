@@ -208,6 +208,45 @@ def rmat_graph(
     return Graph.from_arrays(n, u, v, w, dedup=dedup)
 
 
+def road_grid_graph(
+    rows: int,
+    cols: int,
+    *,
+    seed: int = 0,
+    diag_prob: float = 0.05,
+    weight_low: int = 1,
+    weight_high: int = 10_000,
+) -> Graph:
+    """Synthetic road network: a rows x cols grid with random diagonal
+    shortcuts and wide integer weights.
+
+    The stand-in for BASELINE config 5 (USA-road, 23.9M nodes) in this
+    offline environment: bounded degree (~4), diameter ~rows+cols >> log n —
+    the regime where the reference's sequential CHANGEROOT walks blow up
+    (``/root/reference/README.md:77-80``) and pointer jumping is the answer.
+    """
+    rng = np.random.default_rng(seed)
+    r = np.arange(rows, dtype=np.int64)
+    c = np.arange(cols, dtype=np.int64)
+    vid = (r[:, None] * cols + c[None, :])
+    right_u = vid[:, :-1].ravel()
+    right_v = vid[:, 1:].ravel()
+    down_u = vid[:-1, :].ravel()
+    down_v = vid[1:, :].ravel()
+    parts_u = [right_u, down_u]
+    parts_v = [right_v, down_v]
+    if diag_prob > 0:
+        du = vid[:-1, :-1].ravel()
+        dv = vid[1:, 1:].ravel()
+        keep = rng.random(du.size) < diag_prob
+        parts_u.append(du[keep])
+        parts_v.append(dv[keep])
+    u = np.concatenate(parts_u)
+    v = np.concatenate(parts_v)
+    w = rng.integers(weight_low, weight_high + 1, size=u.size, dtype=np.int64)
+    return Graph.from_arrays(int(rows * cols), u, v, w)
+
+
 def line_graph(num_nodes: int, *, weight: int = 1) -> Graph:
     """Path 0-1-...-(n-1): the high-diameter worst case for level count."""
     n = int(num_nodes)

@@ -72,14 +72,13 @@ def _level_core(fragment, fa, fb, key_of_slot, n):
     return fragment2, parent, has, safe
 
 
-@functools.partial(jax.jit, static_argnames=("out_size", "compact_after"))
-def _rank_solve_fused(vmin0, ra, rb, *, out_size: int, compact_after: int = 2):
-    """The whole solve in one dispatch.
+@functools.partial(jax.jit, static_argnames=("compact_after",))
+def _rank_head(vmin0, ra, rb, *, compact_after: int = 2):
+    """Levels 1(+2) at full width, one dispatch.
 
-    Returns ``(mst, fragment, levels, alive_at_compact)``; the caller checks
-    ``alive_at_compact <= out_size`` and falls back to an exact-size re-run
-    on overflow (MST marks from dropped slots would be missing, so the
-    overflowing result is discarded).
+    Returns ``(fragment, mst, fa, fb, stats)`` with ``stats = [levels,
+    alive_count]`` — the host reads stats in a single fetch and sizes the
+    finish chunks exactly (no static budget, no overflow path).
     """
     n = vmin0.shape[0]
     mp = ra.shape[0]
@@ -118,48 +117,58 @@ def _rank_solve_fused(vmin0, ra, rb, *, out_size: int, compact_after: int = 2):
         mst = jnp.zeros(mp, dtype=bool).at[safe1].max(has1)
         lv = any1.astype(jnp.int32)
 
-    # ---- Order-preserving compaction of surviving ranks. The compact index
-    # is the new tie-break key (stable compaction keeps rank order). One
-    # scatter builds the compact->rank map; endpoints come by cheap gathers.
-    alive = fa != fb
-    count = jnp.sum(alive.astype(jnp.int32))
-    more = count > 0
-    pos = jnp.cumsum(alive.astype(jnp.int32)) - 1
-    idx = jnp.where(alive & (pos < out_size), pos, out_size)
-    crank = jnp.zeros(out_size, jnp.int32).at[idx].set(slot, mode="drop")
-    valid = jnp.arange(out_size, dtype=jnp.int32) < count
-    cfa = jnp.where(valid, fa[crank], 0)
-    cfb = jnp.where(valid, fb[crank], 0)
+    count = jnp.sum((fa != fb).astype(jnp.int32))
+    return fragment, mst, fa, fb, jnp.stack([lv, count])
 
-    # ---- Finish: fused while_loop over the compacted slots.
-    max_levels = _max_levels(n)
+
+@functools.partial(jax.jit, static_argnames=("out_size", "chunk_levels"))
+def _finish_chunk(
+    fragment, mst, fa, fb, rank_of_slot, *, out_size: int, chunk_levels: int = 3
+):
+    """Compact the surviving slots to ``out_size`` and run up to
+    ``chunk_levels`` more levels; one dispatch.
+
+    Chained across calls by the host, which re-sizes ``out_size`` from the
+    returned survivor count — so high-diameter graphs (12-14 levels on road
+    grids) shed width as they go instead of paying the first compaction's
+    width every remaining level. Order-preserving compaction keeps the local
+    slot index a valid tie-break total order; ``rank_of_slot`` carries the
+    original rank through the chain for MST marking.
+
+    Returns ``(fragment, mst, cfa, cfb, crank, stats)`` with ``stats =
+    [levels_run, alive_count]``.
+    """
+    # ---- Order-preserving compaction: one scatter of positions, then
+    # out_size-sized gathers for the slot payloads.
+    alive = fa != fb
+    pos = jnp.cumsum(alive.astype(jnp.int32)) - 1
+    idx = jnp.where(alive, pos, out_size)  # dead slots drop out of bounds
+    cpos = jnp.zeros(out_size, jnp.int32).at[idx].set(
+        jnp.arange(fa.shape[0], dtype=jnp.int32), mode="drop"
+    )
+    in_count = jnp.sum(alive.astype(jnp.int32))
+    valid = jnp.arange(out_size, dtype=jnp.int32) < in_count
+    cfa = jnp.where(valid, fa[cpos], 0)
+    cfb = jnp.where(valid, fb[cpos], 0)
+    crank = rank_of_slot[cpos]  # inert when invalid (cfa == cfb == 0)
+
+    n = fragment.shape[0]
     cslot = jnp.arange(out_size, dtype=jnp.int32)
 
     def cond(s):
-        return s[4] & (s[5] < max_levels)
+        return s[4] & (s[5] < chunk_levels)
 
     def body(s):
-        fragment, mst, cfa, cfb, _, lv = s
+        fragment, mst, cfa, cfb, _, k = s
         key = jnp.where(cfa != cfb, cslot, INT32_MAX)
         fragment, parent, has, safe = _level_core(fragment, cfa, cfb, key, n)
         mst = mst.at[crank[safe]].max(has)
-        return (fragment, mst, parent[cfa], parent[cfb], jnp.any(has), lv + 1)
+        return (fragment, mst, parent[cfa], parent[cfb], jnp.any(has), k + 1)
 
-    state = (fragment, mst, cfa, cfb, more, lv)
-    fragment, mst, _, _, _, lv = jax.lax.while_loop(cond, body, state)
-    # Stats packed into one array: the host syncs them in a single fetch
-    # (each device->host read is a ~114 ms round-trip on this setup).
-    return mst, fragment, jnp.stack([lv, count])
-
-
-# Static compaction budget: 1/8 of padded ranks covers the measured survivor
-# fraction (~6% on RMAT-20 after level 2, less for road grids after level 1)
-# with ~2x headroom; overflow falls back to an exact-size re-run.
-_COMPACT_FRACTION_LOG2 = 3
-
-
-def _compact_budget(m_pad: int) -> int:
-    return max(m_pad >> _COMPACT_FRACTION_LOG2, _COMPACT_MIN_SLOTS)
+    state = (fragment, mst, cfa, cfb, in_count > 0, jnp.zeros((), jnp.int32))
+    fragment, mst, cfa, cfb, _, k = jax.lax.while_loop(cond, body, state)
+    count = jnp.sum((cfa != cfb).astype(jnp.int32))
+    return fragment, mst, cfa, cfb, crank, jnp.stack([k, count])
 
 
 def prepare_rank_arrays(graph: Graph):
@@ -185,23 +194,33 @@ def _pick_compact_after(graph: Graph) -> int:
 
 
 def solve_rank_staged(
-    vmin0, ra, rb, *, compact_after: int = 2
+    vmin0, ra, rb, *, compact_after: int = 2, chunk_levels: int = 3
 ) -> Tuple[jax.Array, jax.Array, int]:
-    """Device-resident solve from staged arrays: one dispatch, one sync
-    (plus a rare exact-size re-run when the static compaction buffer
-    overflows). Returns ``(mst_rank_mask, fragment, levels)``."""
-    m_pad = ra.shape[0]
-    budget = _compact_budget(m_pad)
-    mst, fragment, stats = _rank_solve_fused(
-        vmin0, ra, rb, out_size=budget, compact_after=compact_after
+    """Device-resident solve from staged arrays.
+
+    One head dispatch (levels 1-2 at full width), then finish chunks of
+    ``chunk_levels`` levels, each re-compacted to the exact survivor count —
+    RMAT-like graphs finish in one chunk; high-diameter road grids shed
+    width every chunk instead of paying the first compaction's width for
+    all ~12+ remaining levels. Returns ``(mst_rank_mask, fragment, levels)``.
+    """
+    n_pad = vmin0.shape[0]
+    fragment, mst, fa, fb, stats = _rank_head(
+        vmin0, ra, rb, compact_after=compact_after
     )
     lv, count = (int(x) for x in jax.device_get(stats))
-    if count > budget:
-        exact = _next_pow2(count)
-        mst, fragment, stats = _rank_solve_fused(
-            vmin0, ra, rb, out_size=exact, compact_after=compact_after
+    rank_of_slot = jnp.arange(ra.shape[0], dtype=jnp.int32)
+    max_levels = _max_levels(n_pad)
+    while count > 0 and lv < max_levels:
+        out_size = max(_next_pow2(count), _COMPACT_MIN_SLOTS)
+        fragment, mst, fa, fb, rank_of_slot, stats = _finish_chunk(
+            fragment, mst, fa, fb, rank_of_slot,
+            out_size=out_size, chunk_levels=chunk_levels,
         )
-        lv = int(jax.device_get(stats)[0])
+        extra, count = (int(x) for x in jax.device_get(stats))
+        lv += extra
+        if extra < chunk_levels:  # ran out of progress inside the chunk
+            break
     return mst, fragment, lv
 
 
