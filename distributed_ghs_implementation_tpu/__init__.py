@@ -24,6 +24,28 @@ or the functional form:
     >>> from distributed_ghs_implementation_tpu import minimum_spanning_tree
 """
 
+import os as _os
+
+# Persistent XLA compilation cache. Kernel shapes here are data-dependent
+# (finish chunks compile per survivor-count bucket), and a cold compile costs
+# ~10 s per shape on a remote-tunnel TPU — across processes that dominated
+# end-to-end road-graph solves. Opt out / relocate with GHS_TPU_COMPILE_CACHE
+# (empty string disables). Must run before any JAX backend initialization.
+_cache_dir = _os.environ.get(
+    "GHS_TPU_COMPILE_CACHE",
+    _os.path.join(_os.path.expanduser("~"), ".cache", "ghs_tpu_xla"),
+)
+if _cache_dir:
+    try:
+        import jax as _jax
+
+        if _jax.config.jax_compilation_cache_dir is None:  # don't clobber
+            _jax.config.update("jax_compilation_cache_dir", _cache_dir)
+            _jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+            _jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    except Exception:  # noqa: BLE001 — cache is an optimization, never fatal
+        pass
+
 from distributed_ghs_implementation_tpu.api import (
     GHSAlgorithm,
     MSTResult,

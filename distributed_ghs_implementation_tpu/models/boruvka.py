@@ -407,6 +407,24 @@ def _next_pow2(x: int) -> int:
     return 1 << max(0, (x - 1)).bit_length()
 
 
+def _bucket_size(x: int) -> int:
+    """Next size in {1, 1.25, 1.5, 1.75} * 2^k >= x.
+
+    Pure pow2 padding wastes up to 2x on every edge-sized op (a 34.4M-edge
+    road graph pads to 67M slots); quarter steps cap the waste at 25% for 4x
+    the compiled-shape diversity — cheap now that compilations persist in the
+    on-disk XLA cache.
+    """
+    if x <= 4:
+        return max(1, x)
+    p = 1 << (x - 1).bit_length()  # pow2 >= x
+    for num in (5, 6, 7):  # 1.25, 1.5, 1.75 times p/2
+        cand = num * (p >> 3)
+        if cand >= x:
+            return cand
+    return p
+
+
 def prepare_device_arrays(graph: Graph, *, bucket_shapes: bool = True):
     """Host->device staging: ``(fragment0, src, dst, rank, ra, rb)`` jnp arrays.
 

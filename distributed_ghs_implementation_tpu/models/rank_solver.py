@@ -45,6 +45,7 @@ import numpy as np
 from distributed_ghs_implementation_tpu.graphs.edgelist import Graph
 from distributed_ghs_implementation_tpu.models.boruvka import (
     _COMPACT_MIN_SLOTS,
+    _bucket_size,
     _max_levels,
     _next_pow2,
 )
@@ -121,6 +122,27 @@ def _rank_head(vmin0, ra, rb, *, compact_after: int = 2):
     return fragment, mst, fa, fb, jnp.stack([lv, count])
 
 
+def _compact_slots(fa, fb, rank_of_slot, out_size: int):
+    """Order-preserving compaction of alive slots into ``out_size``: one
+    scatter of positions, then out_size-sized gathers of the payloads. Dead
+    slots scatter out of bounds (dropped); trailing pad slots come out with
+    ``cfa == cfb == 0`` (inert). Order preservation keeps the local slot
+    index a valid tie-break total order; ``crank`` carries the original rank
+    for MST marking. Returns ``(cfa, cfb, crank, valid)``."""
+    alive = fa != fb
+    pos = jnp.cumsum(alive.astype(jnp.int32)) - 1
+    idx = jnp.where(alive, pos, out_size)
+    cpos = jnp.zeros(out_size, jnp.int32).at[idx].set(
+        jnp.arange(fa.shape[0], dtype=jnp.int32), mode="drop"
+    )
+    in_count = jnp.sum(alive.astype(jnp.int32))
+    valid = jnp.arange(out_size, dtype=jnp.int32) < in_count
+    cfa = jnp.where(valid, fa[cpos], 0)
+    cfb = jnp.where(valid, fb[cpos], 0)
+    crank = rank_of_slot[cpos]
+    return cfa, cfb, crank, valid
+
+
 @functools.partial(jax.jit, static_argnames=("out_size", "chunk_levels"))
 def _finish_chunk(
     fragment, mst, fa, fb, rank_of_slot, *, out_size: int, chunk_levels: int = 3
@@ -138,22 +160,84 @@ def _finish_chunk(
     Returns ``(fragment, mst, cfa, cfb, crank, stats)`` with ``stats =
     [levels_run, alive_count]``.
     """
-    # ---- Order-preserving compaction: one scatter of positions, then
-    # out_size-sized gathers for the slot payloads.
-    alive = fa != fb
-    pos = jnp.cumsum(alive.astype(jnp.int32)) - 1
-    idx = jnp.where(alive, pos, out_size)  # dead slots drop out of bounds
-    cpos = jnp.zeros(out_size, jnp.int32).at[idx].set(
-        jnp.arange(fa.shape[0], dtype=jnp.int32), mode="drop"
+    cfa, cfb, crank, valid = _compact_slots(fa, fb, rank_of_slot, out_size)
+    fragment, mst, cfa, cfb, stats = _levels_loop(
+        fragment, mst, cfa, cfb, crank, chunk_levels=chunk_levels
     )
-    in_count = jnp.sum(alive.astype(jnp.int32))
-    valid = jnp.arange(out_size, dtype=jnp.int32) < in_count
-    cfa = jnp.where(valid, fa[cpos], 0)
-    cfb = jnp.where(valid, fb[cpos], 0)
-    crank = rank_of_slot[cpos]  # inert when invalid (cfa == cfb == 0)
+    return fragment, mst, cfa, cfb, crank, stats
 
+
+# ---------------------------------------------------------------------------
+# Compact fragment space — the high-diameter fix.
+#
+# After the head, a 4096^2 road grid still has ~13 levels to run, and in the
+# original space each costs O(n_pad) — pointer jumps over 33M-entry parent
+# arrays and segment-min outputs with 33M segments — even when only a few
+# hundred thousand fragments are still merging (measured 84-108 s end to end).
+# The fix: number the live roots densely once (census + cumsum), run every
+# finish level in that F-sized space, and expand the vertex labels back in one
+# n-sized pass at the end. Per-level cost drops from O(n_pad + alive) to
+# O(F + alive).
+# ---------------------------------------------------------------------------
+
+
+@functools.partial(jax.jit, static_argnames=("n", "out_size"))
+def _compact_and_mark(fa, fb, rank_of_slot, *, n: int, out_size: int):
+    """Slot compaction plus live-root census, one dispatch.
+
+    Beyond ``_finish_chunk``'s order-preserving slot compaction this marks
+    every root appearing on an alive slot and numbers the marked roots densely
+    (``newid`` = cumsum of marks). The host reads ``F`` (live-root count) from
+    ``stats`` and decides whether a compact-space finish is worth it. Returns
+    ``(cfa_o, cfb_o, crank, mark, newid, stats)`` with endpoints still in the
+    original root space.
+    """
+    cfa_o, cfb_o, crank, valid = _compact_slots(fa, fb, rank_of_slot, out_size)
+    mark = (
+        jnp.zeros(n, bool)
+        .at[jnp.where(valid, cfa_o, n)].set(True, mode="drop")
+        .at[jnp.where(valid, cfb_o, n)].set(True, mode="drop")
+    )
+    cums = jnp.cumsum(mark.astype(jnp.int32))
+    newid = cums - 1
+    stats = jnp.stack([cums[-1], jnp.sum(valid.astype(jnp.int32))])
+    return cfa_o, cfb_o, crank, mark, newid, stats
+
+
+@functools.partial(jax.jit, static_argnames=("f_size", "chunk_levels"))
+def _shrink_and_run(
+    mark, newid, rep_prev, mst, cfa_o, cfb_o, crank, *, f_size: int, chunk_levels: int
+):
+    """Relabel alive slots into the dense root space and run the next
+    ``chunk_levels`` finish levels there; one dispatch.
+
+    ``rep[f] ->`` ORIGINAL root id of compact id ``f``: the shrink-local
+    back-map composed through ``rep_prev`` (the previous space's rep; the
+    identity iota at the first shrink). The compact fragment state starts at
+    the identity — every compact id is its own root.
+    """
+    space = mark.shape[0]
+    iota_s = jnp.arange(space, dtype=jnp.int32)
+    back = jnp.zeros(f_size, jnp.int32).at[jnp.where(mark, newid, f_size)].set(
+        iota_s, mode="drop"
+    )
+    rep = rep_prev[back]
+    cfa = newid[cfa_o]
+    cfb = newid[cfb_o]
+    # Padding slots have cfa_o == cfb_o == 0, so cfa == cfb: inert.
+    cfrag = jnp.arange(f_size, dtype=jnp.int32)
+    cfrag, mst, cfa, cfb, stats = _levels_loop(
+        cfrag, mst, cfa, cfb, crank, chunk_levels=chunk_levels
+    )
+    return rep, cfrag, mst, cfa, cfb, stats
+
+
+def _levels_loop(fragment, mst, cfa, cfb, crank, *, chunk_levels: int):
+    """Up to ``chunk_levels`` levels over already-compacted slots (traced
+    helper shared by ``_finish_chunk`` and ``_shrink_and_run``)."""
     n = fragment.shape[0]
-    cslot = jnp.arange(out_size, dtype=jnp.int32)
+    cslot = jnp.arange(cfa.shape[0], dtype=jnp.int32)
+    in_count = jnp.sum((cfa != cfb).astype(jnp.int32))
 
     def cond(s):
         return s[4] & (s[5] < chunk_levels)
@@ -168,18 +252,54 @@ def _finish_chunk(
     state = (fragment, mst, cfa, cfb, in_count > 0, jnp.zeros((), jnp.int32))
     fragment, mst, cfa, cfb, _, k = jax.lax.while_loop(cond, body, state)
     count = jnp.sum((cfa != cfb).astype(jnp.int32))
-    return fragment, mst, cfa, cfb, crank, jnp.stack([k, count])
+    return fragment, mst, cfa, cfb, jnp.stack([k, count])
+
+
+@functools.partial(jax.jit, static_argnames=("chunk_levels",))
+def _run_levels(fragment, mst, cfa, cfb, crank, *, chunk_levels: int):
+    """Levels over already-compacted slots, no re-compaction; one dispatch."""
+    return _levels_loop(fragment, mst, cfa, cfb, crank, chunk_levels=chunk_levels)
+
+
+def _replay_stages(fragment, stages):
+    """Final vertex relabel after a shrink chain.
+
+    ``stages`` is one tuple per shrink event, in order:
+    ``(mark_k, newid_k, rep_k, cfrag_k_final)`` — the census over the previous
+    space, the composed compact->original map, and the compact fragment state
+    as of the NEXT shrink (or loop end). The walk runs in the FIRST compact
+    space (f1-sized gathers per stage, shrinking), with a single n-sized
+    expansion at the end; a root that goes dead at stage k keeps the original
+    label it had there. Dispatch count is O(#shrinks), run once per solve.
+    """
+    if not stages:
+        return fragment
+    mark1, newid1, rep1, cfrag1 = stages[0]
+    cur = cfrag1  # S1 id -> its root in S1 after stage-1 levels
+    res = rep1[cur]  # original-space label if it dies here
+    alive = jnp.ones(cur.shape[0], bool)
+    for mark_k, newid_k, rep_k, cfrag_k in stages[1:]:
+        # `alive` guards dead entries whose stale old-space ids could alias a
+        # marked id in the newer (denser) space.
+        live = alive & mark_k[cur]
+        j = cfrag_k[jnp.where(live, newid_k[cur], 0)]
+        res = jnp.where(live, rep_k[j], res)
+        cur = jnp.where(live, j, cur)
+        alive = live
+    live1 = mark1[fragment]
+    return jnp.where(live1, res[jnp.where(live1, newid1[fragment], 0)], fragment)
 
 
 def prepare_rank_arrays(graph: Graph):
-    """Host->device staging: ``(vmin0, ra, rb)`` jnp arrays, pow2-padded.
+    """Host->device staging: ``(vmin0, ra, rb)`` jnp arrays, padded to
+    quarter-step bucket sizes (``_bucket_size``).
 
     Cheap by construction: one native counting sort for ranks plus one O(m)
     native pass for ``first_ranks`` — no CSR, no ELL buckets (this path
     exists to kill that ~14 s of host prep at RMAT-20).
     """
-    n_pad = _next_pow2(graph.num_nodes)
-    m_pad = _next_pow2(graph.num_edges)
+    n_pad = _bucket_size(graph.num_nodes)
+    m_pad = _bucket_size(graph.num_edges)
     vmin0 = np.full(n_pad, np.iinfo(np.int32).max, dtype=np.int32)
     vmin0[: graph.num_nodes] = graph.first_ranks
     ra, rb = graph.rank_endpoints(pad_to=m_pad)
@@ -193,8 +313,19 @@ def _pick_compact_after(graph: Graph) -> int:
     return 1 if avg_degree <= 6.0 else 2
 
 
+# Below this fragment-space size a shrink buys nothing (level cost is all
+# fixed overhead); also the floor for census-worthiness.
+_SHRINK_MIN_SPACE = 1 << 15
+
+
 def solve_rank_staged(
-    vmin0, ra, rb, *, compact_after: int = 2, chunk_levels: int = 3
+    vmin0,
+    ra,
+    rb,
+    *,
+    compact_after: int = 2,
+    chunk_levels: int = 3,
+    compact_space: bool | None = None,
 ) -> Tuple[jax.Array, jax.Array, int]:
     """Device-resident solve from staged arrays.
 
@@ -202,7 +333,14 @@ def solve_rank_staged(
     ``chunk_levels`` levels, each re-compacted to the exact survivor count —
     RMAT-like graphs finish in one chunk; high-diameter road grids shed
     width every chunk instead of paying the first compaction's width for
-    all ~12+ remaining levels. Returns ``(mst_rank_mask, fragment, levels)``.
+    all ~12+ remaining levels.
+
+    With ``compact_space`` (default: on for road-like graphs, where
+    ``compact_after <= 1``), each chunk boundary additionally censuses the
+    live roots and, when the fragment space shrank >= 2x, renumbers it densely
+    before running the next levels — so late levels cost O(alive fragments)
+    instead of O(n). Vertex labels are restored by one replay pass at the end
+    (``_replay_stages``). Returns ``(mst_rank_mask, fragment, levels)``.
     """
     n_pad = vmin0.shape[0]
     fragment, mst, fa, fb, stats = _rank_head(
@@ -211,16 +349,67 @@ def solve_rank_staged(
     lv, count = (int(x) for x in jax.device_get(stats))
     rank_of_slot = jnp.arange(ra.shape[0], dtype=jnp.int32)
     max_levels = _max_levels(n_pad)
+    if compact_space is None:
+        compact_space = compact_after <= 1
+
+    space = n_pad  # current fragment-space size
+    frag_state = fragment  # vertex-level until the first shrink, cfrag after
+    vertex_fragment = fragment  # frozen at first shrink, for the final replay
+    rep = None  # current-space -> original-root map (None = original space)
+    stages = []  # completed (mark, newid, rep, cfrag_final) per shrink
+    pending = None  # (mark, newid, rep) of the last shrink, awaiting cfrag
+    census_failures = 0
+
     while count > 0 and lv < max_levels:
-        out_size = max(_next_pow2(count), _COMPACT_MIN_SLOTS)
-        fragment, mst, fa, fb, rank_of_slot, stats = _finish_chunk(
-            fragment, mst, fa, fb, rank_of_slot,
-            out_size=out_size, chunk_levels=chunk_levels,
-        )
+        out_size = max(_bucket_size(count), _COMPACT_MIN_SLOTS)
+        did_levels = False
+        if compact_space and space > _SHRINK_MIN_SPACE and census_failures < 2:
+            cfa_o, cfb_o, crank, mark, newid, cstats = _compact_and_mark(
+                fa, fb, rank_of_slot, n=space, out_size=out_size
+            )
+            f_count, _ = (int(x) for x in jax.device_get(cstats))
+            f_size = max(_bucket_size(f_count), _SHRINK_MIN_SPACE // 4)
+            if f_size <= space // 2:
+                census_failures = 0
+                rep_prev = (
+                    rep if rep is not None else jnp.arange(space, dtype=jnp.int32)
+                )
+                if pending is not None:
+                    stages.append((*pending, frag_state))
+                else:
+                    vertex_fragment = frag_state
+                rep, frag_state, mst, fa, fb, stats = _shrink_and_run(
+                    mark, newid, rep_prev, mst, cfa_o, cfb_o, crank,
+                    f_size=f_size, chunk_levels=chunk_levels,
+                )
+                pending = (mark, newid, rep)
+                rank_of_slot = crank
+                space = f_size
+                did_levels = True
+            else:
+                census_failures += 1
+                # Reuse the compacted slots; run the levels without shrink.
+                frag_state, mst, fa, fb, stats = _run_levels(
+                    frag_state, mst, cfa_o, cfb_o, crank,
+                    chunk_levels=chunk_levels,
+                )
+                rank_of_slot = crank
+                did_levels = True
+        if not did_levels:
+            frag_state, mst, fa, fb, rank_of_slot, stats = _finish_chunk(
+                frag_state, mst, fa, fb, rank_of_slot,
+                out_size=out_size, chunk_levels=chunk_levels,
+            )
         extra, count = (int(x) for x in jax.device_get(stats))
         lv += extra
-        if extra < chunk_levels:  # ran out of progress inside the chunk
+        if extra == 0:  # no progress possible (safety valve)
             break
+
+    if pending is not None:
+        stages.append((*pending, frag_state))
+        fragment = _replay_stages(vertex_fragment, stages)
+    else:
+        fragment = frag_state
     return mst, fragment, lv
 
 
