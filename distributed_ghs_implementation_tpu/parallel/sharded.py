@@ -188,28 +188,39 @@ def solve_graph_sharded(
 ) -> Tuple[np.ndarray, np.ndarray, int]:
     """Host entry mirroring ``models.boruvka.solve_graph`` on a device mesh.
 
-    ``strategy``: ``"flat"`` = edge-sharded flat kernel; ``"ell"`` =
-    vertex-sharded ELL kernel; ``"auto"`` mirrors the single-device choice
-    (ELL at scale, flat below it).
+    ``strategy``: ``"rank"`` = rank-space solver (the fast path — sharded
+    head + all-gathered compact finish, ``parallel/rank_sharded.py``);
+    ``"flat"`` = edge-sharded flat kernel; ``"ell"`` = vertex-sharded ELL
+    kernel; ``"auto"`` = rank at scale (single-process), ELL for
+    multi-process runs, flat below the scale threshold.
     """
     from distributed_ghs_implementation_tpu.models.boruvka import (
         ELL_AUTO_EDGE_THRESHOLD,
     )
 
-    if strategy not in ("auto", "flat", "ell"):
-        raise ValueError(f"unknown strategy {strategy!r}; expected auto|flat|ell")
+    if strategy not in ("auto", "rank", "flat", "ell"):
+        raise ValueError(
+            f"unknown strategy {strategy!r}; expected auto|rank|flat|ell"
+        )
     if jax.process_count() > 1:
-        # Flat outputs are slot-sharded (partially non-addressable per
-        # process); the ELL solver's outputs are replicated, so every process
-        # can harvest the MST locally.
-        if strategy == "flat":
+        # Flat and rank outputs are slot-sharded (partially non-addressable
+        # per process); the ELL solver's outputs are replicated, so every
+        # process can harvest the MST locally.
+        if strategy in ("flat", "rank"):
             raise ValueError(
-                "strategy='flat' is single-process only (slot-sharded outputs "
-                "are not harvestable across processes); use 'ell' or 'auto'"
+                f"strategy={strategy!r} is single-process only (slot-sharded "
+                "outputs are not harvestable across processes); use 'ell' or "
+                "'auto'"
             )
         strategy = "ell"
     if strategy == "auto":
-        strategy = "ell" if graph.num_edges >= ELL_AUTO_EDGE_THRESHOLD else "flat"
+        strategy = "rank" if graph.num_edges >= ELL_AUTO_EDGE_THRESHOLD else "flat"
+    if strategy == "rank":
+        from distributed_ghs_implementation_tpu.parallel.rank_sharded import (
+            solve_graph_rank_sharded,
+        )
+
+        return solve_graph_rank_sharded(graph, mesh=mesh)
     if strategy == "ell":
         return solve_graph_sharded_ell(graph, mesh=mesh)
     if mesh is None:

@@ -1,0 +1,81 @@
+"""BASELINE config 4: RMAT-24 (16.7M nodes, ~260M undirected edges), single chip.
+
+The BASELINE.json metric is MST edges/sec on RMAT-24 with weight parity.
+The north-star target is the v5e-8 sharded solve; this tool records the
+single-chip number (the 8-chip path is validated functionally on a virtual
+mesh — real multi-chip hardware is not attached to this host).
+
+Prints per-stage timings and a final JSON line.
+"""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+
+def log(msg):
+    print(msg, file=sys.stderr, flush=True)
+
+
+def main():
+    import jax
+
+    from distributed_ghs_implementation_tpu.graphs.generators import rmat_graph
+    from distributed_ghs_implementation_tpu.models import rank_solver as rs
+    from distributed_ghs_implementation_tpu.utils.verify import scipy_mst_weight
+
+    scale = int(sys.argv[1]) if len(sys.argv) > 1 else 24
+    t0 = time.perf_counter()
+    g = rmat_graph(scale, 16, seed=24)
+    log(f"gen RMAT-{scale}: {g.num_nodes:,} nodes {g.num_edges:,} edges "
+        f"in {time.perf_counter()-t0:.1f}s")
+
+    t0 = time.perf_counter()
+    vmin0, ra, rb = rs.prepare_rank_arrays(g)
+    jax.block_until_ready(vmin0)
+    t_prep = time.perf_counter() - t0
+    log(f"host prep + staging: {t_prep:.1f}s (m_pad={ra.shape[0]:,})")
+
+    times = []
+    lv = 0
+    for i in range(3):
+        t0 = time.perf_counter()
+        mst, frag, lv = rs.solve_rank_staged(vmin0, ra, rb, compact_after=2)
+        jax.block_until_ready((mst, frag))
+        times.append(time.perf_counter() - t0)
+        log(f"solve {i}: {times[-1]:.2f}s levels={lv}")
+    best = min(times)
+
+    import jax.numpy as jnp
+
+    packed = np.asarray(jnp.packbits(mst))
+    mask = np.unpackbits(packed, count=mst.shape[0]).astype(bool)
+    ids = g.edge_id_of_rank(np.nonzero(mask)[0])
+    weight = int(g.w[ids].sum())
+    t0 = time.perf_counter()
+    expect = int(scipy_mst_weight(g))
+    t_oracle = time.perf_counter() - t0
+    ok = weight == expect
+    out = {
+        "config": f"RMAT-{scale}",
+        "nodes": g.num_nodes,
+        "edges": g.num_edges,
+        "solve_best_s": round(best, 3),
+        "edges_per_s": round(g.num_edges / best, 0),
+        "levels": int(lv),
+        "prep_s": round(t_prep, 1),
+        "oracle_s": round(t_oracle, 1),
+        "weight": weight,
+        "verified": ok,
+    }
+    print(json.dumps(out), flush=True)
+    assert ok, (weight, expect)
+
+
+if __name__ == "__main__":
+    main()
