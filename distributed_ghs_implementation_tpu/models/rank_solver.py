@@ -54,7 +54,12 @@ from distributed_ghs_implementation_tpu.ops.union_find import hook_and_compress
 
 
 def _moe_over(fa, fb, key, n):
-    """Per-fragment min key over both edge directions (one segment_min)."""
+    """Per-fragment min key over both edge directions (one segment_min).
+
+    Measured: one concatenated segment_min beats two half-width ones even at
+    RMAT-24 width (39.1 s vs 41.0 s full solve) — the scatter's fixed cost
+    outweighs the concatenation temporaries.
+    """
     return jax.ops.segment_min(
         jnp.concatenate([key, key]), jnp.concatenate([fa, fb]), num_segments=n
     )
