@@ -304,7 +304,10 @@ def prepare_rank_arrays(graph: Graph):
     exists to kill that ~14 s of host prep at RMAT-20).
 
     The staged device arrays are cached on the graph (repeat solves skip the
-    host->device upload — ~400 MB / ~15 s at 34M edges on a tunneled chip).
+    host->device upload — ~400 MB / ~15 s at 34M edges on a tunneled chip),
+    capped at ``_STAGE_CACHE_MAX_RANKS`` so a sequence of huge solves can't
+    pin HBM for the lifetime of every Graph a caller keeps a reference to
+    (an RMAT-24-scale cache entry would hold ~2 GB of device memory).
     """
     cached = graph.__dict__.get("_rank_device_cache")
     if cached is not None:
@@ -315,10 +318,15 @@ def prepare_rank_arrays(graph: Graph):
     vmin0[: graph.num_nodes] = graph.first_ranks
     ra, rb = graph.rank_endpoints(pad_to=m_pad)
     staged = (jnp.asarray(vmin0), jnp.asarray(ra), jnp.asarray(rb))
-    # Graph is a frozen dataclass; write the cache the way cached_property
-    # does (directly into __dict__, bypassing the frozen __setattr__).
-    graph.__dict__["_rank_device_cache"] = staged
+    if m_pad <= _STAGE_CACHE_MAX_RANKS:
+        # Graph is a frozen dataclass; write the cache the way cached_property
+        # does (directly into __dict__, bypassing the frozen __setattr__).
+        graph.__dict__["_rank_device_cache"] = staged
     return staged
+
+
+# Cache staged arrays only below ~0.5 GB of device memory per graph.
+_STAGE_CACHE_MAX_RANKS = 1 << 26
 
 
 def _pick_compact_after(graph: Graph) -> int:

@@ -45,6 +45,7 @@ from distributed_ghs_implementation_tpu.models.boruvka import (
 from distributed_ghs_implementation_tpu.models.rank_solver import (
     _compact_slots,
     _level_core,
+    _moe_over,
 )
 from distributed_ghs_implementation_tpu.ops.segment_ops import INT32_MAX
 from distributed_ghs_implementation_tpu.ops.union_find import hook_and_compress
@@ -90,10 +91,7 @@ def _rank_sharded_head(vmin0, ra, rb):
     # ---- Level 2: per-shard segment_min + one pmin combine.
     gslot = k * mb + jnp.arange(mb, dtype=jnp.int32)
     key = jnp.where(fa != fb, gslot, INT32_MAX)
-    moe = jax.ops.segment_min(
-        jnp.concatenate([key, key]), jnp.concatenate([fa, fb]), num_segments=n
-    )
-    moe = jax.lax.pmin(moe, EDGE_AXIS)
+    moe = jax.lax.pmin(_moe_over(fa, fb, key, n), EDGE_AXIS)
     has2 = moe < INT32_MAX
     wa, mine2, li2 = _owner_lookup(fa, moe, has2, k, mb, EDGE_AXIS)
     wb, _, _ = _owner_lookup(fb, moe, has2, k, mb, EDGE_AXIS)
@@ -209,6 +207,9 @@ def solve_graph_rank_sharded(
         finish = make_rank_sharded_finish(mesh, fs_local, _max_levels(n_pad))
         fragment, mst, extra = finish(fragment, mst, fa, fb)
         lv += int(extra)
-    ranks = np.nonzero(np.asarray(mst))[0]
+    # Bit-packed mask fetch, as in solve_graph_rank (8x less transfer; the
+    # mask is ~268 MB of bools at RMAT-24 width).
+    packed = np.asarray(jnp.packbits(mst))
+    ranks = np.nonzero(np.unpackbits(packed, count=mst.shape[0]))[0]
     edge_ids = np.sort(graph.edge_id_of_rank(ranks))
     return edge_ids, np.asarray(fragment)[:n], lv

@@ -106,9 +106,7 @@ def test_rank_sharded_matches_device(seed):
     ids, frag, lv = solve_graph_rank_sharded(g)
     rd = minimum_spanning_forest(g, backend="device")
     assert np.array_equal(ids, rd.edge_ids)
-    assert verify_result(
-        minimum_spanning_forest(g, backend="device"), oracle="scipy"
-    ).ok
+    assert verify_result(rd, oracle="scipy").ok
 
 
 def test_rank_sharded_high_diameter():

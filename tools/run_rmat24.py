@@ -48,7 +48,7 @@ def main():
 
     t0 = time.perf_counter()
     vmin0, ra, rb = rs.prepare_rank_arrays(g)
-    jax.block_until_ready(vmin0)
+    jax.block_until_ready((vmin0, ra, rb))
     t_prep = time.perf_counter() - t0
     log(f"host prep + staging: {t_prep:.1f}s (m_pad={ra.shape[0]:,})")
 
