@@ -2,6 +2,11 @@
 a Pallas TPU kernel. If this compiles + runs fast, the ELL scan's dominant
 cost (fragment[dstb] random gather, ~480 ms at RMAT-20) drops ~7x."""
 
+import os as _os
+import sys as _sys
+
+_sys.path.insert(0, _os.path.dirname(_os.path.dirname(_os.path.abspath(__file__))))
+
 import functools
 import time
 
