@@ -341,6 +341,14 @@ def _pick_compact_after(graph: Graph) -> int:
 _SHRINK_MIN_SPACE = 1 << 15
 
 
+@jax.jit
+def _relabel_slots(fragment, ra, rb):
+    """Resume path: rebuild slot endpoints from a restored vertex partition."""
+    fa = fragment[ra]
+    fb = fragment[rb]
+    return fa, fb, jnp.sum((fa != fb).astype(jnp.int32))
+
+
 def solve_rank_staged(
     vmin0,
     ra,
@@ -349,6 +357,8 @@ def solve_rank_staged(
     compact_after: int = 2,
     chunk_levels: int = 3,
     compact_space: bool | None = None,
+    initial_state: tuple | None = None,
+    on_chunk=None,
 ) -> Tuple[jax.Array, jax.Array, int]:
     """Device-resident solve from staged arrays.
 
@@ -364,12 +374,34 @@ def solve_rank_staged(
     before running the next levels — so late levels cost O(alive fragments)
     instead of O(n). Vertex labels are restored by one replay pass at the end
     (``_replay_stages``). Returns ``(mst_rank_mask, fragment, levels)``.
+
+    ``initial_state`` is ``(fragment, mst_rank_mask, level)`` from a
+    checkpoint: the head is skipped and slot endpoints are rebuilt from the
+    restored partition. ``on_chunk(level, vertex_fragment, mst, count)``
+    fires after the head and each finish chunk with the *vertex-level*
+    fragment (replayed through any shrink stages so far) — the checkpoint
+    hook.
     """
     n_pad = vmin0.shape[0]
-    fragment, mst, fa, fb, stats = _rank_head(
-        vmin0, ra, rb, compact_after=compact_after
-    )
-    lv, count = (int(x) for x in jax.device_get(stats))
+    if initial_state is not None:
+        fragment = jnp.asarray(np.asarray(initial_state[0], dtype=np.int32))
+        if fragment.shape[0] != n_pad:  # stored unpadded; restore padding
+            fragment = jnp.concatenate(
+                [fragment, jnp.arange(fragment.shape[0], n_pad, dtype=jnp.int32)]
+            )
+        mst_np = np.asarray(initial_state[1], dtype=bool)
+        if mst_np.shape[0] != ra.shape[0]:  # padding width changed
+            fixed = np.zeros(ra.shape[0], dtype=bool)
+            fixed[: min(mst_np.shape[0], ra.shape[0])] = mst_np[: ra.shape[0]]
+            mst_np = fixed
+        mst = jnp.asarray(mst_np)
+        fa, fb, count_d = _relabel_slots(fragment, ra, rb)
+        lv, count = int(initial_state[2]), int(jax.device_get(count_d))
+    else:
+        fragment, mst, fa, fb, stats = _rank_head(
+            vmin0, ra, rb, compact_after=compact_after
+        )
+        lv, count = (int(x) for x in jax.device_get(stats))
     rank_of_slot = jnp.arange(ra.shape[0], dtype=jnp.int32)
     max_levels = _max_levels(n_pad)
     if compact_space is None:
@@ -386,6 +418,14 @@ def solve_rank_staged(
     stages = []  # completed (mark, newid, rep, cfrag_final) per shrink
     pending = None  # (mark, newid, rep) of the last shrink, awaiting cfrag
     census_failures = 0
+
+    def current_vertex_fragment():
+        if pending is None:
+            return frag_state
+        return _replay_stages(vertex_fragment, stages + [(*pending, frag_state)])
+
+    if on_chunk is not None and initial_state is None:
+        on_chunk(lv, current_vertex_fragment(), mst, count)
 
     while count > 0 and lv < max_levels:
         out_size = max(_bucket_size(count), _COMPACT_MIN_SLOTS)
@@ -429,6 +469,8 @@ def solve_rank_staged(
             )
         extra, count = (int(x) for x in jax.device_get(stats))
         lv += extra
+        if on_chunk is not None:
+            on_chunk(lv, current_vertex_fragment(), mst, count)
         if extra == 0:  # no progress possible (safety valve)
             break
 

@@ -85,32 +85,75 @@ def solve_graph_checkpointed(
     *,
     every: int = 1,
     resume: bool = True,
+    strategy: str = "auto",
 ) -> Tuple[np.ndarray, np.ndarray, int]:
-    """Host-stepped solve writing a checkpoint every ``every`` levels; resumes
-    from ``checkpoint_path`` when present. Same return contract as
-    ``models.boruvka.solve_graph``."""
-    from distributed_ghs_implementation_tpu.models.boruvka import (
-        prepare_device_arrays,
-        solve_arrays_stepped,
-    )
+    """Checkpointing solve; resumes from ``checkpoint_path`` when present.
+    Same return contract as ``models.boruvka.solve_graph``.
 
+    ``strategy``: ``"stepped"`` checkpoints after every ``every`` levels;
+    ``"rank"`` uses the fast rank-space solver and checkpoints at its chunk
+    boundaries (the per-chunk vertex partition is reconstructed through any
+    fragment-space shrinks by the replay pass — at RMAT-24 scale the stepped
+    kernel is not a practical host). ``"auto"`` picks rank at bench scale.
+    """
     n = graph.num_nodes
     if n == 0 or graph.num_edges == 0:
         return np.zeros(0, dtype=np.int64), np.arange(n, dtype=np.int32), 0
 
-    args = prepare_device_arrays(graph)
     fp = graph_fingerprint(graph)
     initial_state = None
     if resume and os.path.exists(checkpoint_path):
         initial_state = load_checkpoint(checkpoint_path, expect_fingerprint=fp)
 
-    def on_level(level, fragment, mst_ranks, has, count, dt):
-        if level % every == 0 or not has:
-            save_checkpoint(checkpoint_path, fragment, mst_ranks, level, fingerprint=fp)
+    if strategy == "auto":
+        from distributed_ghs_implementation_tpu.models.boruvka import (
+            ELL_AUTO_EDGE_THRESHOLD,
+        )
 
-    mst_ranks, fragment, levels = solve_arrays_stepped(
-        *args, stepped_levels=None, initial_state=initial_state, on_level=on_level
-    )
+        strategy = (
+            "rank" if graph.num_edges >= ELL_AUTO_EDGE_THRESHOLD else "stepped"
+        )
+
+    if strategy == "rank":
+        from distributed_ghs_implementation_tpu.models.rank_solver import (
+            _pick_compact_after,
+            prepare_rank_arrays,
+            solve_rank_staged,
+        )
+
+        vmin0, ra, rb = prepare_rank_arrays(graph)
+
+        def on_chunk(level, fragment, mst_ranks, count):
+            save_checkpoint(
+                checkpoint_path, fragment, mst_ranks, level, fingerprint=fp
+            )
+
+        mst_ranks, fragment, levels = solve_rank_staged(
+            vmin0, ra, rb,
+            compact_after=_pick_compact_after(graph),
+            initial_state=initial_state,
+            on_chunk=on_chunk,
+        )
+    elif strategy == "stepped":
+        from distributed_ghs_implementation_tpu.models.boruvka import (
+            prepare_device_arrays,
+            solve_arrays_stepped,
+        )
+
+        args = prepare_device_arrays(graph)
+
+        def on_level(level, fragment, mst_ranks, has, count, dt):
+            if level % every == 0 or not has:
+                save_checkpoint(
+                    checkpoint_path, fragment, mst_ranks, level, fingerprint=fp
+                )
+
+        mst_ranks, fragment, levels = solve_arrays_stepped(
+            *args, stepped_levels=None, initial_state=initial_state,
+            on_level=on_level,
+        )
+    else:
+        raise ValueError(f"unknown strategy {strategy!r}; expected auto|rank|stepped")
     save_checkpoint(checkpoint_path, fragment, mst_ranks, levels, fingerprint=fp)
 
     ranks_chosen = np.nonzero(np.asarray(mst_ranks))[0]
