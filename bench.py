@@ -55,7 +55,7 @@ def main(argv=None) -> int:
         from distributed_ghs_implementation_tpu.models.rank_solver import (
             _pick_compact_after,
             prepare_rank_arrays,
-            solve_rank_staged,
+            solve_rank_auto,
         )
 
         t0 = time.perf_counter()
@@ -63,11 +63,11 @@ def main(argv=None) -> int:
         print(f"host prep (ranks + first_ranks + staging): "
               f"{time.perf_counter() - t0:.1f}s", file=sys.stderr)
         ca = _pick_compact_after(g)  # same path production takes
-        mst, fragment, levels = solve_rank_staged(vmin0, ra, rb, compact_after=ca)
+        mst, fragment, levels = solve_rank_auto(vmin0, ra, rb, compact_after=ca)
         _ = np.asarray(mst.ravel()[0])  # warm + sync
         for _ in range(args.repeats):
             t0 = time.perf_counter()
-            mst, fragment, levels = solve_rank_staged(vmin0, ra, rb, compact_after=ca)
+            mst, fragment, levels = solve_rank_auto(vmin0, ra, rb, compact_after=ca)
             _ = np.asarray(mst.ravel()[0])
             times.append(time.perf_counter() - t0)
         # Wrap the timed kernel's own output for verification below.
