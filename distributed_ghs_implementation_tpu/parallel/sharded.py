@@ -24,8 +24,8 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from distributed_ghs_implementation_tpu.graphs.edgelist import Graph
 from distributed_ghs_implementation_tpu.models.boruvka import (
     BoruvkaState,
+    _bucket_size,
     _max_levels,
-    _next_pow2,
     boruvka_level,
 )
 from distributed_ghs_implementation_tpu.parallel.mesh import (
@@ -145,8 +145,8 @@ def solve_graph_sharded_ell(
     if n == 0 or graph.num_edges == 0:
         return np.zeros(0, dtype=np.int64), np.arange(n, dtype=np.int32), 0
 
-    n_pad = _next_pow2(n)
-    m_pad = _next_pow2(graph.num_edges)
+    n_pad = _bucket_size(n)
+    m_pad = _bucket_size(graph.num_edges)
     ra_np, rb_np = graph.rank_endpoints(pad_to=m_pad)
 
     int32_max = np.iinfo(np.int32).max
@@ -229,9 +229,9 @@ def solve_graph_sharded(
     n = graph.num_nodes
     if n == 0 or graph.num_edges == 0:
         return np.zeros(0, dtype=np.int64), np.arange(n, dtype=np.int32), 0
-    n_pad = _next_pow2(n) if bucket_shapes else n
+    n_pad = _bucket_size(n) if bucket_shapes else n
     e2 = 2 * graph.num_edges
-    e_pad = _next_pow2(e2) if bucket_shapes else e2
+    e_pad = _bucket_size(e2) if bucket_shapes else e2
     # Both the slot axis and the rank axis (e_pad // 2) must divide by mesh size.
     e_pad = int(math.ceil(e_pad / (2 * n_dev)) * 2 * n_dev)
     src_np, dst_np, rank_np, ra_np, rb_np = graph.rank_arrays(
