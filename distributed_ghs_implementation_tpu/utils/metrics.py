@@ -39,19 +39,31 @@ class SolveMetrics:
         return json.dumps(dataclasses.asdict(self), indent=2)
 
 
-def solve_graph_instrumented(graph, *, compact: bool = True) -> tuple:
+def solve_graph_instrumented(
+    graph, *, compact: bool = True, strategy: str = "stepped"
+) -> tuple:
     """Like ``models.boruvka.solve_graph`` but returns ``(result_tuple,
-    SolveMetrics)`` with one record per level (host-stepped execution via the
-    shared ``solve_arrays_stepped`` driver)."""
-    from distributed_ghs_implementation_tpu.models.boruvka import (
-        prepare_device_arrays,
-        solve_arrays_stepped,
-    )
+    SolveMetrics)``.
 
+    ``strategy="stepped"`` records one entry per level (host-stepped
+    execution); ``strategy="rank"`` uses the fast rank solver and records one
+    entry per chunk boundary (its hook granularity) — the practical choice at
+    bench scale where the stepped kernel is not a usable host.
+    """
     n = graph.num_nodes
     if n == 0 or graph.num_edges == 0:
         empty = (np.zeros(0, dtype=np.int64), np.arange(n, dtype=np.int32), 0)
         return empty, SolveMetrics(n, graph.num_edges, [], 0.0)
+
+    if strategy == "rank":
+        return _solve_rank_instrumented(graph)
+    if strategy != "stepped":
+        raise ValueError(f"unknown strategy {strategy!r}; expected stepped|rank")
+
+    from distributed_ghs_implementation_tpu.models.boruvka import (
+        prepare_device_arrays,
+        solve_arrays_stepped,
+    )
 
     args = prepare_device_arrays(graph)
     records: List[LevelMetrics] = []
@@ -75,6 +87,50 @@ def solve_graph_instrumented(graph, *, compact: bool = True) -> tuple:
     t_start = time.perf_counter()
     mst_ranks, fragment, levels = solve_arrays_stepped(
         *args, compact=compact, stepped_levels=None, on_level=on_level
+    )
+    total = time.perf_counter() - t_start
+
+    ranks_chosen = np.nonzero(np.asarray(mst_ranks))[0]
+    edge_ids = np.sort(graph.edge_id_of_rank(ranks_chosen))
+    result = (edge_ids, np.asarray(fragment)[:n], levels)
+    return result, SolveMetrics(n, graph.num_edges, records, total)
+
+
+def _solve_rank_instrumented(graph) -> tuple:
+    """Rank-solver instrumentation via its ``on_chunk`` hook (chunk-boundary
+    granularity; the alive count there is undirected already)."""
+    from distributed_ghs_implementation_tpu.models.rank_solver import (
+        _pick_compact_after,
+        prepare_rank_arrays,
+        solve_rank_staged,
+    )
+
+    n = graph.num_nodes
+    vmin0, ra, rb = prepare_rank_arrays(graph)
+    records = []
+    frags_before = [n]
+    last = [time.perf_counter()]
+
+    def on_chunk(level, fragment, mst_ranks, count):
+        now = time.perf_counter()
+        frags_after = int(np.unique(np.asarray(fragment)[:n]).size)
+        records.append(
+            LevelMetrics(
+                level=level,
+                fragments_before=frags_before[0],
+                fragments_after=frags_after,
+                edges_alive_after=count,
+                wall_time_s=now - last[0],
+            )
+        )
+        frags_before[0] = frags_after
+        last[0] = now
+
+    t_start = time.perf_counter()
+    mst_ranks, fragment, levels = solve_rank_staged(
+        vmin0, ra, rb,
+        compact_after=_pick_compact_after(graph),
+        on_chunk=on_chunk,
     )
     total = time.perf_counter() - t_start
 

@@ -260,3 +260,22 @@ def test_checkpointed_rank_solve_and_resume(tmp_path):
     assert np.array_equal(edge_ids, ref_ids)
     assert np.array_equal(np.sort(np.unique(fragment)), np.sort(np.unique(ref_frag)))
     assert levels >= lv_saved
+
+
+def test_instrumented_rank_strategy():
+    from distributed_ghs_implementation_tpu.graphs.generators import road_grid_graph
+
+    g = road_grid_graph(80, 80, seed=12)
+    (edge_ids, fragment, levels), metrics = solve_graph_instrumented(
+        g, strategy="rank"
+    )
+    ref_ids, _, _ = solve_graph(g, strategy="rank")
+    assert np.array_equal(edge_ids, ref_ids)
+    assert metrics.levels, "expected at least one chunk record"
+    assert metrics.levels[-1].edges_alive_after == 0
+    assert metrics.levels[-1].fragments_after == 1
+    # fragment counts must be monotonically non-increasing across chunks
+    seq = [m.fragments_before for m in metrics.levels] + [
+        metrics.levels[-1].fragments_after
+    ]
+    assert all(a >= b for a, b in zip(seq, seq[1:]))
