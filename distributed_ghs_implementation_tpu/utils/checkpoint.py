@@ -91,10 +91,11 @@ def solve_graph_checkpointed(
     Same return contract as ``models.boruvka.solve_graph``.
 
     ``strategy``: ``"stepped"`` checkpoints after every ``every`` levels;
-    ``"rank"`` uses the fast rank-space solver and checkpoints at its chunk
-    boundaries (the per-chunk vertex partition is reconstructed through any
-    fragment-space shrinks by the replay pass — at RMAT-24 scale the stepped
-    kernel is not a practical host). ``"auto"`` picks rank at bench scale.
+    ``"rank"`` uses the fast rank-space solver and checkpoints every
+    ``every``-th chunk boundary (the per-chunk vertex partition is
+    reconstructed through any fragment-space shrinks by the replay pass — at
+    RMAT-24 scale the stepped kernel is not a practical host). ``"auto"``
+    picks rank at bench scale.
     """
     n = graph.num_nodes
     if n == 0 or graph.num_edges == 0:
@@ -122,15 +123,22 @@ def solve_graph_checkpointed(
         )
 
         vmin0, ra, rb = prepare_rank_arrays(graph)
+        chunks_seen = [0]
 
         def on_chunk(level, fragment, mst_ranks, count):
-            save_checkpoint(
-                checkpoint_path, fragment, mst_ranks, level, fingerprint=fp
-            )
+            # `every` counts chunk boundaries here (levels on the stepped
+            # path); the final state is always saved below either way.
+            chunks_seen[0] += 1
+            if chunks_seen[0] % every == 0 or count == 0:
+                save_checkpoint(
+                    checkpoint_path, fragment, mst_ranks, level, fingerprint=fp
+                )
 
+        ca = _pick_compact_after(graph)
         mst_ranks, fragment, levels = solve_rank_staged(
             vmin0, ra, rb,
-            compact_after=_pick_compact_after(graph),
+            compact_after=ca,
+            chunk_levels=2 if ca <= 1 else 3,  # match solve_rank_auto tuning
             initial_state=initial_state,
             on_chunk=on_chunk,
         )

@@ -126,10 +126,12 @@ def _solve_rank_instrumented(graph) -> tuple:
         frags_before[0] = frags_after
         last[0] = now
 
+    ca = _pick_compact_after(graph)
     t_start = time.perf_counter()
     mst_ranks, fragment, levels = solve_rank_staged(
         vmin0, ra, rb,
-        compact_after=_pick_compact_after(graph),
+        compact_after=ca,
+        chunk_levels=2 if ca <= 1 else 3,  # match solve_rank_auto tuning
         on_chunk=on_chunk,
     )
     total = time.perf_counter() - t_start

@@ -8,12 +8,13 @@ while_loop time for comparison (per-level sync overhead is the difference).
 Usage: python tools/profile_levels.py [--scale 20] [--edge-factor 16]
 """
 
+from __future__ import annotations
+
 import os as _os
 import sys as _sys
 
 _sys.path.insert(0, _os.path.dirname(_os.path.dirname(_os.path.abspath(__file__))))
 
-from __future__ import annotations
 
 import argparse
 import functools

@@ -6,12 +6,13 @@ per-fragment scatter-min alone, (d) ``hook_and_compress`` alone, (e) the
 rank-endpoint lookups. Answers: where do the ~780 ms/level go?
 """
 
+from __future__ import annotations
+
 import os as _os
 import sys as _sys
 
 _sys.path.insert(0, _os.path.dirname(_os.path.dirname(_os.path.abspath(__file__))))
 
-from __future__ import annotations
 
 import argparse
 import functools

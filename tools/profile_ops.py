@@ -1,12 +1,13 @@
 """Raw op throughput on the chip: dispatch overhead, gather, scatter,
 segment_min, pointer_jump — the numbers the kernel design trades on."""
 
+from __future__ import annotations
+
 import os as _os
 import sys as _sys
 
 _sys.path.insert(0, _os.path.dirname(_os.path.dirname(_os.path.abspath(__file__))))
 
-from __future__ import annotations
 
 import time
 
