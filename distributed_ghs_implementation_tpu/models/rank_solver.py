@@ -56,10 +56,17 @@ from distributed_ghs_implementation_tpu.ops.union_find import hook_and_compress
 def _moe_over(fa, fb, key, n):
     """Per-fragment min key over both edge directions (one segment_min).
 
-    Measured: one concatenated segment_min beats two half-width ones even at
+    Measured: one concatenated segment_min beats two half-width ones up to
     RMAT-24 width (39.1 s vs 41.0 s full solve) — the scatter's fixed cost
-    outweighs the concatenation temporaries.
+    outweighs the concatenation temporaries. Above 2^28 slots (RMAT-25
+    class) the ~2x slot-sized concat temporaries push a 16 GB chip into
+    RESOURCE_EXHAUSTED, so the two-pass form takes over there.
     """
+    if fa.shape[0] > (1 << 28):
+        return jnp.minimum(
+            jax.ops.segment_min(key, fa, num_segments=n),
+            jax.ops.segment_min(key, fb, num_segments=n),
+        )
     return jax.ops.segment_min(
         jnp.concatenate([key, key]), jnp.concatenate([fa, fb]), num_segments=n
     )
