@@ -53,7 +53,7 @@ def main(argv=None) -> int:
 
         from distributed_ghs_implementation_tpu.api import MSTResult
         from distributed_ghs_implementation_tpu.models.rank_solver import (
-            _pick_compact_after,
+            _pick_family,
             prepare_rank_arrays,
             solve_rank_auto,
         )
@@ -62,12 +62,12 @@ def main(argv=None) -> int:
         vmin0, ra, rb = prepare_rank_arrays(g)
         print(f"host prep (ranks + first_ranks + staging): "
               f"{time.perf_counter() - t0:.1f}s", file=sys.stderr)
-        ca = _pick_compact_after(g)  # same path production takes
-        mst, fragment, levels = solve_rank_auto(vmin0, ra, rb, compact_after=ca)
+        fam = _pick_family(g)  # same path production takes
+        mst, fragment, levels = solve_rank_auto(vmin0, ra, rb, family=fam)
         _ = np.asarray(mst.ravel()[0])  # warm + sync
         for _ in range(args.repeats):
             t0 = time.perf_counter()
-            mst, fragment, levels = solve_rank_auto(vmin0, ra, rb, compact_after=ca)
+            mst, fragment, levels = solve_rank_auto(vmin0, ra, rb, family=fam)
             _ = np.asarray(mst.ravel()[0])
             times.append(time.perf_counter() - t0)
         # Wrap the timed kernel's own output for verification below.

@@ -336,11 +336,29 @@ def prepare_rank_arrays(graph: Graph):
 _STAGE_CACHE_MAX_RANKS = 1 << 26
 
 
-def _pick_compact_after(graph: Graph) -> int:
-    # Bounded-degree graphs (roads, grids, meshes) retire most edges at level
-    # 1; skewed-degree graphs need level 2 at full width first.
+def _pick_family(graph: Graph) -> str:
+    """Graph-family policy for the staged solver.
+
+    * ``"sparse"`` (avg degree <= 3: paths, trees, real road networks —
+      USA-road is ~2.4): level 1 retires most edges; a full-width level 2
+      would be a wasted pass. Short finish chunks.
+    * ``"grid"`` (3 < avg degree <= 8: grids, meshes): level 2 at full width
+      pays off (measured 11.8 s vs 12.6 s on a 4096^2 grid), but survivor
+      counts stay too high for the speculative m/8 width. Short chunks.
+    * ``"dense"`` (avg degree > 8: RMAT, ER at bench densities): level 2
+      retires ~94%; speculative single-round-trip finish when the fragment
+      space is under the census threshold.
+    """
     avg_degree = 2.0 * graph.num_edges / max(graph.num_nodes, 1)
-    return 1 if avg_degree <= 6.0 else 2
+    if avg_degree <= 3.0:
+        return "sparse"
+    return "grid" if avg_degree <= 8.0 else "dense"
+
+
+def _pick_compact_after(graph: Graph) -> int:
+    """Head depth for :func:`_pick_family`'s choice (kept as the stable
+    knob the checkpoint/metrics paths share)."""
+    return 1 if _pick_family(graph) == "sparse" else 2
 
 
 # Below this fragment-space size a shrink buys nothing (level cost is all
@@ -518,14 +536,13 @@ def solve_rank_staged(
     return mst, fragment, lv
 
 
-def solve_rank_auto(vmin0, ra, rb, *, compact_after: int):
-    """Dispatch policy shared by ``solve_graph_rank`` and ``bench.py``:
-    speculative single-round-trip path for RMAT-band graphs, staged loop
-    (short chunks on road-like graphs — measured 12.1 s vs 13.2 s at
-    chunk_levels 2 vs 3 on a 4096^2 grid; 1 loses to dispatch overhead at
-    14.1 s) otherwise."""
+def solve_rank_auto(vmin0, ra, rb, *, family: str = "dense"):
+    """Dispatch policy shared by ``solve_graph_rank`` and ``bench.py`` —
+    see :func:`_pick_family` for the per-family rationale. Chunk length 2
+    beats 3 on many-level graphs (measured 12.1 s vs 13.2 s on a 4096^2
+    grid; 1 loses to dispatch overhead at 14.1 s)."""
     n_pad = vmin0.shape[0]
-    if compact_after >= 2 and n_pad < (1 << 21):
+    if family == "dense" and n_pad < (1 << 21):
         # Below the census threshold the finish is one chunk and the fetch
         # overhead dominates: speculate the survivor width at m/8 (2x the
         # worst measured RMAT ratio) and fall back on misprediction.
@@ -535,8 +552,9 @@ def solve_rank_auto(vmin0, ra, rb, *, compact_after: int):
             return result
     return solve_rank_staged(
         vmin0, ra, rb,
-        compact_after=compact_after,
-        chunk_levels=2 if compact_after <= 1 else 3,
+        compact_after=1 if family == "sparse" else 2,
+        chunk_levels=3 if family == "dense" else 2,
+        compact_space=True if family != "dense" else None,
     )
 
 
@@ -547,7 +565,7 @@ def solve_graph_rank(graph: Graph) -> Tuple[np.ndarray, np.ndarray, int]:
         return np.zeros(0, dtype=np.int64), np.arange(n, dtype=np.int32), 0
     vmin0, ra, rb = prepare_rank_arrays(graph)
     mst, fragment, levels = solve_rank_auto(
-        vmin0, ra, rb, compact_after=_pick_compact_after(graph)
+        vmin0, ra, rb, family=_pick_family(graph)
     )
     # Fetch the mask bit-packed: 8x less tunnel traffic (a 16.8M-node road
     # grid's 42 MB bool mask is ~1.4 s of transfer on this setup).

@@ -117,7 +117,7 @@ def solve_graph_checkpointed(
 
     if strategy == "rank":
         from distributed_ghs_implementation_tpu.models.rank_solver import (
-            _pick_compact_after,
+            _pick_family,
             prepare_rank_arrays,
             solve_rank_staged,
         )
@@ -134,11 +134,12 @@ def solve_graph_checkpointed(
                     checkpoint_path, fragment, mst_ranks, level, fingerprint=fp
                 )
 
-        ca = _pick_compact_after(graph)
+        fam = _pick_family(graph)
         mst_ranks, fragment, levels = solve_rank_staged(
             vmin0, ra, rb,
-            compact_after=ca,
-            chunk_levels=2 if ca <= 1 else 3,  # match solve_rank_auto tuning
+            compact_after=1 if fam == "sparse" else 2,
+            chunk_levels=3 if fam == "dense" else 2,  # solve_rank_auto tuning
+            compact_space=True if fam != "dense" else None,
             initial_state=initial_state,
             on_chunk=on_chunk,
         )

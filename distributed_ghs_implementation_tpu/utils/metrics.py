@@ -100,7 +100,7 @@ def _solve_rank_instrumented(graph) -> tuple:
     """Rank-solver instrumentation via its ``on_chunk`` hook (chunk-boundary
     granularity; the alive count there is undirected already)."""
     from distributed_ghs_implementation_tpu.models.rank_solver import (
-        _pick_compact_after,
+        _pick_family,
         prepare_rank_arrays,
         solve_rank_staged,
     )
@@ -126,12 +126,13 @@ def _solve_rank_instrumented(graph) -> tuple:
         frags_before[0] = frags_after
         last[0] = now
 
-    ca = _pick_compact_after(graph)
+    fam = _pick_family(graph)
     t_start = time.perf_counter()
     mst_ranks, fragment, levels = solve_rank_staged(
         vmin0, ra, rb,
-        compact_after=ca,
-        chunk_levels=2 if ca <= 1 else 3,  # match solve_rank_auto tuning
+        compact_after=1 if fam == "sparse" else 2,
+        chunk_levels=3 if fam == "dense" else 2,  # solve_rank_auto tuning
+        compact_space=True if fam != "dense" else None,
         on_chunk=on_chunk,
     )
     total = time.perf_counter() - t_start

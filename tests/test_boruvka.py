@@ -301,7 +301,7 @@ def test_speculative_rank_misprediction_falls_back():
         ranks = np.nonzero(np.asarray(mst))[0]
         ids = np.sort(g.edge_id_of_rank(ranks))
         assert np.array_equal(ids, ref_ids)
-    mst, fragment, levels = rs.solve_rank_auto(vmin0, ra, rb, compact_after=2)
+    mst, fragment, levels = rs.solve_rank_auto(vmin0, ra, rb, family="dense")
     ranks = np.nonzero(np.asarray(mst))[0]
     ids = np.sort(g.edge_id_of_rank(ranks))
     assert np.array_equal(ids, ref_ids)

@@ -83,9 +83,18 @@ def test_compact_space_shrink_fires_and_is_exact():
 
     rs._shrink_and_run = spy
     try:
-        ids, frag, lv = rs.solve_graph_rank(g)
+        # Force the sparse head (level 1 only): the grid family's full-width
+        # level 2 would leave just one shrink; this path exercises the
+        # multi-stage chain + replay.
+        vmin0, ra, rb = rs.prepare_rank_arrays(g)
+        mst, fragment, lv = rs.solve_rank_staged(
+            vmin0, ra, rb, compact_after=1, chunk_levels=2, compact_space=True
+        )
     finally:
         rs._shrink_and_run = orig
+    ranks = np.nonzero(np.asarray(mst))[0]
+    ids = np.sort(g.edge_id_of_rank(ranks))
+    frag = np.asarray(fragment)[: g.num_nodes]
     assert len(f_sizes) >= 2, f_sizes  # multi-stage shrink chain + replay
     assert abs(float(g.w[ids].sum()) - scipy_mst_weight(g)) < 1e-6
     assert np.unique(frag).size == 1
