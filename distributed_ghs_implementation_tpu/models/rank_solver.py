@@ -356,9 +356,19 @@ def _pick_family(graph: Graph) -> str:
 
 
 def _pick_compact_after(graph: Graph) -> int:
-    """Head depth for :func:`_pick_family`'s choice (kept as the stable
-    knob the checkpoint/metrics paths share)."""
-    return 1 if _pick_family(graph) == "sparse" else 2
+    """Head depth for :func:`_pick_family`'s choice."""
+    return _family_params(_pick_family(graph))["compact_after"]
+
+
+def _family_params(family: str) -> dict:
+    """Staged-solver knobs for a :func:`_pick_family` choice — the single
+    source shared by ``solve_rank_auto``, the checkpoint path, and the
+    instrumented-metrics path (measured rationale in ``_pick_family``)."""
+    return dict(
+        compact_after=1 if family == "sparse" else 2,
+        chunk_levels=3 if family == "dense" else 2,
+        compact_space=True if family != "dense" else None,
+    )
 
 
 # Below this fragment-space size a shrink buys nothing (level cost is all
@@ -422,8 +432,8 @@ def solve_rank_staged(
     width every chunk instead of paying the first compaction's width for
     all ~12+ remaining levels.
 
-    With ``compact_space`` (default: on for road-like graphs, where
-    ``compact_after <= 1``), each chunk boundary additionally censuses the
+    With ``compact_space`` (default: on for sparse/grid families and for
+    large fragment spaces), each chunk boundary additionally censuses the
     live roots and, when the fragment space shrank >= 2x, renumbers it densely
     before running the next levels — so late levels cost O(alive fragments)
     instead of O(n). Vertex labels are restored by one replay pass at the end
@@ -550,12 +560,17 @@ def solve_rank_auto(vmin0, ra, rb, *, family: str = "dense"):
         result = solve_rank_speculative(vmin0, ra, rb, out_size=out_size)
         if result is not None:
             return result
-    return solve_rank_staged(
-        vmin0, ra, rb,
-        compact_after=1 if family == "sparse" else 2,
-        chunk_levels=3 if family == "dense" else 2,
-        compact_space=True if family != "dense" else None,
-    )
+    return solve_rank_staged(vmin0, ra, rb, **_family_params(family))
+
+
+def fetch_mst_edge_ids(graph: Graph, mst) -> np.ndarray:
+    """Device mask -> sorted edge ids, fetched bit-packed (8x less tunnel
+    traffic: a 16.8M-node road grid's 42 MB bool mask is ~1.4 s of transfer
+    on this setup). Shared by the single-chip and sharded hosts and the
+    bench tools."""
+    packed = np.asarray(jnp.packbits(mst))
+    mask = np.unpackbits(packed, count=mst.shape[0]).astype(bool)
+    return np.sort(graph.edge_id_of_rank(np.nonzero(mask)[0]))
 
 
 def solve_graph_rank(graph: Graph) -> Tuple[np.ndarray, np.ndarray, int]:
@@ -567,10 +582,4 @@ def solve_graph_rank(graph: Graph) -> Tuple[np.ndarray, np.ndarray, int]:
     mst, fragment, levels = solve_rank_auto(
         vmin0, ra, rb, family=_pick_family(graph)
     )
-    # Fetch the mask bit-packed: 8x less tunnel traffic (a 16.8M-node road
-    # grid's 42 MB bool mask is ~1.4 s of transfer on this setup).
-    packed = np.asarray(jnp.packbits(mst))
-    mask = np.unpackbits(packed, count=mst.shape[0]).astype(bool)
-    ranks = np.nonzero(mask)[0]
-    edge_ids = np.sort(graph.edge_id_of_rank(ranks))
-    return edge_ids, np.asarray(fragment)[:n], levels
+    return fetch_mst_edge_ids(graph, mst), np.asarray(fragment)[:n], levels

@@ -207,9 +207,8 @@ def solve_graph_rank_sharded(
         finish = make_rank_sharded_finish(mesh, fs_local, _max_levels(n_pad))
         fragment, mst, extra = finish(fragment, mst, fa, fb)
         lv += int(extra)
-    # Bit-packed mask fetch, as in solve_graph_rank (8x less transfer; the
-    # mask is ~268 MB of bools at RMAT-24 width).
-    packed = np.asarray(jnp.packbits(mst))
-    ranks = np.nonzero(np.unpackbits(packed, count=mst.shape[0]))[0]
-    edge_ids = np.sort(graph.edge_id_of_rank(ranks))
-    return edge_ids, np.asarray(fragment)[:n], lv
+    from distributed_ghs_implementation_tpu.models.rank_solver import (
+        fetch_mst_edge_ids,
+    )
+
+    return fetch_mst_edge_ids(graph, mst), np.asarray(fragment)[:n], lv

@@ -117,6 +117,7 @@ def solve_graph_checkpointed(
 
     if strategy == "rank":
         from distributed_ghs_implementation_tpu.models.rank_solver import (
+            _family_params,
             _pick_family,
             prepare_rank_arrays,
             solve_rank_staged,
@@ -134,12 +135,9 @@ def solve_graph_checkpointed(
                     checkpoint_path, fragment, mst_ranks, level, fingerprint=fp
                 )
 
-        fam = _pick_family(graph)
         mst_ranks, fragment, levels = solve_rank_staged(
             vmin0, ra, rb,
-            compact_after=1 if fam == "sparse" else 2,
-            chunk_levels=3 if fam == "dense" else 2,  # solve_rank_auto tuning
-            compact_space=True if fam != "dense" else None,
+            **_family_params(_pick_family(graph)),
             initial_state=initial_state,
             on_chunk=on_chunk,
         )

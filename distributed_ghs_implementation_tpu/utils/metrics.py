@@ -100,6 +100,7 @@ def _solve_rank_instrumented(graph) -> tuple:
     """Rank-solver instrumentation via its ``on_chunk`` hook (chunk-boundary
     granularity; the alive count there is undirected already)."""
     from distributed_ghs_implementation_tpu.models.rank_solver import (
+        _family_params,
         _pick_family,
         prepare_rank_arrays,
         solve_rank_staged,
@@ -126,13 +127,10 @@ def _solve_rank_instrumented(graph) -> tuple:
         frags_before[0] = frags_after
         last[0] = now
 
-    fam = _pick_family(graph)
     t_start = time.perf_counter()
     mst_ranks, fragment, levels = solve_rank_staged(
         vmin0, ra, rb,
-        compact_after=1 if fam == "sparse" else 2,
-        chunk_levels=3 if fam == "dense" else 2,  # solve_rank_auto tuning
-        compact_space=True if fam != "dense" else None,
+        **_family_params(_pick_family(graph)),
         on_chunk=on_chunk,
     )
     total = time.perf_counter() - t_start

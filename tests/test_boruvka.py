@@ -311,3 +311,16 @@ def solve_graph_for_test(g):
     from distributed_ghs_implementation_tpu.models.boruvka import solve_graph
 
     return solve_graph(g, strategy="fused")
+
+
+def test_baseline_config2_exact():
+    """BASELINE.json config 2: gnm_random_graph(1024, 8192), all backends."""
+    from distributed_ghs_implementation_tpu.graphs.generators import gnm_random_graph
+    from distributed_ghs_implementation_tpu.utils.verify import verify_result
+
+    g = gnm_random_graph(1024, 8192, seed=2)
+    r = minimum_spanning_forest(g)
+    assert verify_result(r).ok
+    ids_rank, _, _ = solve_graph_for_test(g)
+    rs = minimum_spanning_forest(g, backend="sharded")
+    assert np.array_equal(rs.edge_ids, r.edge_ids)

@@ -62,11 +62,7 @@ def main():
         log(f"solve {i}: {times[-1]:.2f}s levels={lv}")
     best = min(times)
 
-    import jax.numpy as jnp
-
-    packed = np.asarray(jnp.packbits(mst))
-    mask = np.unpackbits(packed, count=mst.shape[0]).astype(bool)
-    ids = g.edge_id_of_rank(np.nonzero(mask)[0])
+    ids = rs.fetch_mst_edge_ids(g, mst)
     weight = int(g.w[ids].sum())
     t_oracle = 0.0
     if expect is None:  # pass the known weight as argv[2] to skip the oracle
