@@ -250,7 +250,10 @@ class Graph:
                     return order
             except Exception:  # noqa: BLE001 — any native issue -> fallback
                 pass
-        return np.lexsort((np.arange(self.num_edges), self.w))
+        # Stable argsort by weight == lexsort by (weight, edge id), at about
+        # half the cost (single key) — matters for float weights, which skip
+        # the native counting sort.
+        return np.argsort(self.w, kind="stable")
 
     @functools.cached_property
     def first_ranks(self) -> np.ndarray:
