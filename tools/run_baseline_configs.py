@@ -1,0 +1,92 @@
+"""Reproduce every BASELINE.json config in one run; emits one JSON line each.
+
+Configs (BASELINE.json `configs`):
+  1. the reference's 6-node README sample (thread-backend analog: device)
+  2. gnm_random_graph(1024, 8192)
+  3. RMAT scale-20 single-chip (the bench.py headline)
+  4. RMAT scale-24 (16.7M nodes) — `--big` only; the 8-chip version of this
+     config is validated functionally on a virtual mesh (dryrun_multichip)
+  5. USA-road-scale high-diameter grid (23.9M nodes) — `--big` only
+
+Default run (configs 1-3) takes ~1 minute warm on the chip; `--big` adds
+the two multi-minute configs. Every solve is weight-verified against the
+NetworkX/SciPy oracle before its line is printed.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+
+def run_config(name, graph, *, oracle="scipy", expect_weight=None):
+    from distributed_ghs_implementation_tpu.api import minimum_spanning_forest
+    from distributed_ghs_implementation_tpu.utils.verify import verify_result
+
+    t0 = time.perf_counter()
+    result = minimum_spanning_forest(graph)
+    wall = time.perf_counter() - t0
+    if expect_weight is not None:
+        ok = result.total_weight == expect_weight
+        expected = expect_weight
+    else:
+        v = verify_result(result, oracle=oracle)
+        ok, expected = v.ok, v.expected_weight
+    line = {
+        "config": name,
+        "nodes": graph.num_nodes,
+        "edges": graph.num_edges,
+        "levels": result.num_levels,
+        "wall_s": round(wall, 3),
+        "weight": result.total_weight,
+        "expected": expected,
+        "verified": bool(ok),
+    }
+    print(json.dumps(line), flush=True)
+    if not ok:
+        raise SystemExit(f"VERIFICATION FAILED for {name}: {line}")
+    return line
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--big", action="store_true",
+                   help="also run RMAT-24 and the USA-road-scale grid")
+    p.add_argument("--rmat24-weight", type=int, default=None,
+                   help="known MST weight for RMAT-24 seed 24 (skips the "
+                        "~15-minute SciPy oracle); 518885017 for this repo's "
+                        "generator")
+    args = p.parse_args(argv)
+
+    from distributed_ghs_implementation_tpu.graphs.generators import (
+        gnm_random_graph,
+        readme_sample_graph,
+        rmat_graph,
+        road_grid_graph,
+    )
+
+    run_config("1: readme 6-node sample", readme_sample_graph(),
+               oracle="networkx")
+    run_config("2: gnm(1024, 8192)", gnm_random_graph(1024, 8192, seed=2),
+               oracle="networkx")
+    run_config("3: RMAT-20 single chip", rmat_graph(20, 16, seed=24))
+    if args.big:
+        run_config(
+            "4: RMAT-24 single chip (8-chip layout validated on virtual mesh)",
+            rmat_graph(24, 16, seed=24),
+            expect_weight=args.rmat24_weight,
+        )
+        run_config("5: USA-road-scale grid (23.9M nodes, diameter ~10k)",
+                   road_grid_graph(4864, 4912, seed=7))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
