@@ -279,3 +279,29 @@ def test_instrumented_rank_strategy():
         metrics.levels[-1].fragments_after
     ]
     assert all(a >= b for a, b in zip(seq, seq[1:]))
+
+
+def test_checkpoint_every_stride_on_rank_path(tmp_path):
+    """every=N on the rank strategy saves at every Nth chunk boundary (plus
+    the final state)."""
+    from distributed_ghs_implementation_tpu.graphs.generators import road_grid_graph
+    from distributed_ghs_implementation_tpu.utils import checkpoint as cp
+
+    g = road_grid_graph(70, 70, seed=4)
+    saves = []
+    orig = cp.save_checkpoint
+
+    def spy(path, fragment, mst_ranks, level, **kw):
+        saves.append(int(level))
+        return orig(path, fragment, mst_ranks, level, **kw)
+
+    cp.save_checkpoint = spy
+    try:
+        p2 = str(tmp_path / "stride.npz")
+        cp.solve_graph_checkpointed(g, p2, every=100, strategy="rank")
+        sparse_saves = list(saves)
+    finally:
+        cp.save_checkpoint = orig
+    # With a huge stride only the count==0 boundary save plus the final
+    # explicit save happen.
+    assert len(sparse_saves) <= 2, sparse_saves
