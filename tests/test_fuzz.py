@@ -84,3 +84,38 @@ def test_float_weights_all_strategies():
     for strat in ("rank", "fused"):
         ids, _, _ = solve_graph(g, strategy=strat)
         assert abs(float(g.w[ids].sum()) - expect) < 1e-9, strat
+
+
+@pytest.mark.slow
+def test_determinism_across_processes(tmp_path):
+    """Same graph, two fresh interpreter processes, byte-identical MST edge
+    ids — the guarantee the reference fundamentally lacks (its 20-node config
+    differs run to run)."""
+    import subprocess
+    import sys
+
+    code = r"""
+import sys
+sys.path.insert(0, {repo!r})
+import jax
+jax.config.update("jax_platforms", "cpu")
+import numpy as np
+from distributed_ghs_implementation_tpu.graphs.generators import rmat_graph
+from distributed_ghs_implementation_tpu.models.boruvka import solve_graph
+g = rmat_graph(13, 8, seed=77)
+ids, frag, lv = solve_graph(g, strategy="rank")
+np.save(sys.argv[1], ids)
+"""
+    import os
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    outs = []
+    for i in range(2):
+        out = str(tmp_path / f"ids{i}.npy")
+        subprocess.run(
+            [sys.executable, "-c", code.format(repo=repo), out],
+            check=True, timeout=300,
+            env={**os.environ, "JAX_PLATFORMS": "cpu"},
+        )
+        outs.append(np.load(out))
+    assert np.array_equal(outs[0], outs[1])
