@@ -39,7 +39,15 @@ if _cache_dir:
     try:
         import jax as _jax
 
-        if _jax.config.jax_compilation_cache_dir is None:  # don't clobber
+        # CPU-only sessions (the test suite) skip the cache: CPU compiles
+        # are cheap, and reloading CPU AOT results across processes can hit
+        # machine-feature-detection mismatches (observed
+        # "+prefer-no-scatter ... could lead to SIGILL" loader warnings).
+        _platforms = _jax.config.jax_platforms or _os.environ.get(
+            "JAX_PLATFORMS", ""
+        )
+        _cpu_only = _platforms == "cpu"
+        if not _cpu_only and _jax.config.jax_compilation_cache_dir is None:
             _jax.config.update("jax_compilation_cache_dir", _cache_dir)
             _jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
             _jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
