@@ -46,7 +46,12 @@ if _cache_dir:
         _platforms = _jax.config.jax_platforms or _os.environ.get(
             "JAX_PLATFORMS", ""
         )
-        _cpu_only = _platforms == "cpu"
+        # Explicit cpu selection, or no accelerator platform mentioned at
+        # all: skip the cache (only accelerator compiles are worth it).
+        _cpu_only = _platforms == "cpu" or (
+            _platforms == "" and not _os.environ.get("PJRT_DEVICE")
+            and not _os.path.exists("/root/.axon_site")
+        )
         if not _cpu_only and _jax.config.jax_compilation_cache_dir is None:
             _jax.config.update("jax_compilation_cache_dir", _cache_dir)
             _jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)

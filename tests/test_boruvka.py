@@ -321,6 +321,7 @@ def test_baseline_config2_exact():
     g = gnm_random_graph(1024, 8192, seed=2)
     r = minimum_spanning_forest(g)
     assert verify_result(r).ok
-    ids_rank, _, _ = solve_graph_for_test(g)
+    ids_fused, _, _ = solve_graph_for_test(g)
+    assert np.array_equal(ids_fused, r.edge_ids)
     rs = minimum_spanning_forest(g, backend="sharded")
     assert np.array_equal(rs.edge_ids, r.edge_ids)
