@@ -21,11 +21,12 @@ Structure:
     current fragment endpoints ``(fa[r], fb[r])`` — half the directed-slot
     count of the flat kernel, no ELL padding, and the rank index itself is
     the tie-break total order (weights never reach the device).
-  * **One dispatch.** Levels 1-2, an order-preserving stream compaction into
-    a statically-sized buffer, and the fused finish loop all compile into a
-    single program; the host syncs once at the end. If the survivor count
-    overflows the static buffer (wrong graph shape for the heuristic) the
-    host detects it from the returned count and re-runs with the exact size.
+  * **Both spaces shrink.** Finish chunks stream-compact the surviving
+    slots AND (census + dense renumber) the live fragment id space, so late
+    levels cost O(alive) instead of O(n); vertex labels come back via one
+    replay pass. A ``_pick_family`` policy (sparse/grid/dense by average
+    degree) sets head depth and chunk length, and dense graphs take a
+    speculative single-round-trip finish with a misprediction fallback.
 
 Protocol parity: each level is one GHS round (TEST/ACCEPT/REJECT + REPORT =
 the segment_min; CONNECT/INITIATE/CHANGEROOT = ``hook_and_compress``; BRANCH
