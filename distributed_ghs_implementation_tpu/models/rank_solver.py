@@ -73,6 +73,36 @@ def _moe_over(fa, fb, key, n):
     )
 
 
+def _level1_hook(vmin0, ra, rb):
+    """Level 1 (traced helper shared by both heads): hook every vertex on its
+    host-precomputed minimum incident rank. Returns ``(fragment, parent1,
+    has1, safe1)``."""
+    n = vmin0.shape[0]
+    ids = jnp.arange(n, dtype=jnp.int32)
+    has1 = vmin0 < INT32_MAX
+    safe1 = jnp.where(has1, vmin0, 0)
+    a = ra[safe1]
+    b = rb[safe1]
+    dst1 = jnp.where(has1, jnp.where(a == ids, b, a), ids)
+    fragment, parent1 = hook_and_compress(has1, dst1, ids)
+    return fragment, parent1, has1, safe1
+
+
+def _prefix_level2_core(fragment, fa, fb):
+    """Level 2 over already-relabeled prefix slots (traced helper shared by
+    the single-chip and sharded filtered heads). Returns ``(fragment, fa,
+    fb, has2, safe2, count)`` — callers mark ``mst.at[safe2].max(has2)``
+    into their own mask width (prefix slot index == global rank)."""
+    n = fragment.shape[0]
+    slot = jnp.arange(fa.shape[0], dtype=jnp.int32)
+    key2 = jnp.where(fa != fb, slot, INT32_MAX)
+    fragment, parent2, has2, safe2 = _level_core(fragment, fa, fb, key2, n)
+    fa = parent2[fa]
+    fb = parent2[fb]
+    count = jnp.sum((fa != fb).astype(jnp.int32))
+    return fragment, fa, fb, has2, safe2, count
+
+
 def _level_core(fragment, fa, fb, key_of_slot, n):
     """MOE + hook for one level; returns (fragment2, parent, has, safe)."""
     ids = jnp.arange(n, dtype=jnp.int32)
@@ -96,16 +126,9 @@ def _rank_head(vmin0, ra, rb, *, compact_after: int = 2):
     """
     n = vmin0.shape[0]
     mp = ra.shape[0]
-    ids = jnp.arange(n, dtype=jnp.int32)
     slot = jnp.arange(mp, dtype=jnp.int32)
 
-    # ---- Level 1: hook every vertex on its host-precomputed min rank.
-    has1 = vmin0 < INT32_MAX
-    safe1 = jnp.where(has1, vmin0, 0)
-    a = ra[safe1]
-    b = rb[safe1]
-    dst1 = jnp.where(has1, jnp.where(a == ids, b, a), ids)
-    fragment, parent1 = hook_and_compress(has1, dst1, ids)
+    fragment, parent1, has1, safe1 = _level1_hook(vmin0, ra, rb)
     any1 = jnp.any(has1)
 
     # Relabel rank endpoints to level-1 fragments — 2 m-sized gathers, the
@@ -376,6 +399,11 @@ def _family_params(family: str) -> dict:
 # fixed overhead); also the floor for census-worthiness.
 _SHRINK_MIN_SPACE = 1 << 15
 
+# Vertex-space size above which the census/compact-space finish pays for
+# itself on dense graphs (measured at RMAT-24: plain finish 9.6 s vs census
+# 2.8 s + compact finish 1.1 s).
+_CENSUS_MIN_SPACE = 1 << 21
+
 
 @jax.jit
 def _relabel_slots(fragment, ra, rb):
@@ -468,15 +496,48 @@ def solve_rank_staged(
         )
         lv, count = (int(x) for x in jax.device_get(stats))
     rank_of_slot = jnp.arange(ra.shape[0], dtype=jnp.int32)
-    max_levels = _max_levels(n_pad)
     if compact_space is None:
         # Road-like graphs always (many levels to amortize); anything else
         # once the fragment space is big enough that finish levels paying
-        # O(n_pad) dominates the census cost (measured at RMAT-24: plain
-        # finish 9.6 s vs census 2.8 s + compact finish 1.1 s).
-        compact_space = compact_after <= 1 or n_pad >= (1 << 21)
+        # O(n_pad) dominate the census cost.
+        compact_space = compact_after <= 1 or n_pad >= _CENSUS_MIN_SPACE
 
-    space = n_pad  # current fragment-space size
+    if on_chunk is not None and initial_state is None:
+        on_chunk(lv, fragment, mst, count)
+
+    return _finish_to_fixpoint(
+        fragment, mst, fa, fb, rank_of_slot,
+        lv=lv, count=count, space=n_pad, max_levels=_max_levels(n_pad),
+        chunk_levels=chunk_levels, compact_space=compact_space,
+        on_chunk=on_chunk,
+    )
+
+
+def _finish_to_fixpoint(
+    fragment,
+    mst,
+    fa,
+    fb,
+    rank_of_slot,
+    *,
+    lv: int,
+    count: int,
+    space: int,
+    max_levels: int,
+    chunk_levels: int,
+    compact_space: bool,
+    on_chunk=None,
+):
+    """Drive finish chunks to fixpoint from an arbitrary mid-solve state.
+
+    ``fragment`` is the vertex-level partition (``space``-sized); ``fa/fb``
+    are the alive-slot endpoints in that space with ``rank_of_slot`` carrying
+    each slot's original rank for MST marking. Handles slot re-compaction,
+    the compact-fragment-space shrink chain, and the final replay back to
+    vertex labels. Returns ``(mst, fragment, lv)`` with ``fragment`` in the
+    original vertex space. Shared by :func:`solve_rank_staged` and
+    :func:`solve_rank_filtered`.
+    """
     frag_state = fragment  # vertex-level until the first shrink, cfrag after
     vertex_fragment = fragment  # frozen at first shrink, for the final replay
     rep = None  # current-space -> original-root map (None = original space)
@@ -488,9 +549,6 @@ def solve_rank_staged(
         if pending is None:
             return frag_state
         return _replay_stages(vertex_fragment, stages + [(*pending, frag_state)])
-
-    if on_chunk is not None and initial_state is None:
-        on_chunk(lv, current_vertex_fragment(), mst, count)
 
     while count > 0 and lv < max_levels:
         out_size = max(_bucket_size(count), _COMPACT_MIN_SLOTS)
@@ -547,13 +605,138 @@ def solve_rank_staged(
     return mst, fragment, lv
 
 
+# ---------------------------------------------------------------------------
+# Filter-Kruskal path — the dense-graph head killer.
+#
+# The staged head pays four full-width relabel gathers plus a full-width
+# segment_min (RMAT-24: ~20 s of its ~30 s head). But the rank order already
+# sorts edges by weight, so the lightest ranks are a prefix of ra/rb. Solve
+# Borůvka over that prefix only (levels 2+ restricted to prefix slots), and
+# the full edge width is touched exactly twice (one gather per endpoint) by a
+# *filter*: a suffix edge whose endpoints the prefix forest already connects
+# closes a cycle of known-MST edges and can never be an MST edge — drop it.
+# The few survivors (~1-2% on RMAT) finish through the normal chunk loop.
+#
+# Exactness (no heuristic):
+#   * Level 1 hooks every vertex on its globally minimum incident rank
+#     (full ``vmin0``) — the textbook Borůvka step; those edges are MST edges
+#     for the whole graph.
+#   * Prefix levels 2+ pick each fragment's minimum outgoing edge *among
+#     prefix slots*. Every suffix rank is strictly heavier than every prefix
+#     rank, so whenever a fragment has any outgoing prefix edge that choice
+#     equals its global minimum outgoing edge; fragments without one stall
+#     (self-hook) — no wrong selection is possible.
+#   * The filter drops a suffix edge only when its endpoints are already
+#     connected by selected (true MST) edges — the cycle rule, exact under
+#     the strict rank total order.
+#   * Survivor levels: all prefix edges are intra-fragment by then and every
+#     dropped suffix edge is too, so the minimum over survivors is again the
+#     global minimum outgoing edge.
+# The selected set is therefore exactly the unique rank-order MST — the mask
+# is bit-identical to ``solve_rank_staged``'s (asserted in tests).
+# ---------------------------------------------------------------------------
+
+
+@functools.partial(jax.jit, static_argnames=("prefix",))
+def _filtered_head(vmin0, ra, rb, *, prefix: int):
+    """Level 1 on the full vertex minima + level 2 over prefix slots only;
+    one dispatch. Returns ``(fragment, mst, fa, fb, stats)`` with ``mst``
+    full-width and ``fa/fb`` prefix-width."""
+    fragment, parent1, has1, safe1 = _level1_hook(vmin0, ra, rb)
+    mst = jnp.zeros(ra.shape[0], dtype=bool).at[safe1].max(has1)
+
+    # Level 2 restricted to the prefix: relabel only the prefix endpoints.
+    fa = parent1[ra[:prefix]]
+    fb = parent1[rb[:prefix]]
+    fragment, fa, fb, has2, safe2, count = _prefix_level2_core(fragment, fa, fb)
+    mst = mst.at[safe2].max(has2)
+
+    lv = jnp.asarray(1, jnp.int32) + jnp.any(has2).astype(jnp.int32)
+    return fragment, mst, fa, fb, jnp.stack([lv, count])
+
+
+@functools.partial(jax.jit, static_argnames=("prefix",))
+def _filter_suffix_ends(fragment, ra, rb, *, prefix: int):
+    """The one full-width pass: suffix endpoints -> current fragments, plus
+    the survivor count. Slicing inside the jit lets XLA fuse it into the
+    gather (an eager ``ra[prefix:]`` would materialize two suffix-width HBM
+    copies first). Pad slots (``ra == rb == 0``) count as dead."""
+    fa = fragment[ra[prefix:]]
+    fb = fragment[rb[prefix:]]
+    return fa, fb, jnp.sum((fa != fb).astype(jnp.int32))
+
+
+@functools.partial(jax.jit, static_argnames=("out_size",))
+def _filter_compact(fa, fb, prefix, *, out_size: int):
+    """Compact the filter survivors; slot ``i`` carries rank ``prefix + i``."""
+    rank_of_slot = jnp.arange(fa.shape[0], dtype=jnp.int32) + prefix
+    cfa, cfb, crank, _valid = _compact_slots(fa, fb, rank_of_slot, out_size)
+    return cfa, cfb, crank
+
+
+def _prefix_size(n_pad: int, m_pad: int, mult: int = 2) -> int:
+    """The filter split point: lightest ``mult * n_pad`` ranks, bucketed
+    (``mult=2`` measured best at RMAT-20: 1.456/1.461/1.573 s for 1/2/4).
+    Shared by the single-chip and sharded filtered entries so their
+    prefixes — and the parity between them — stay identical."""
+    return _bucket_size(min(mult * n_pad, m_pad))
+
+
+def solve_rank_filtered(
+    vmin0, ra, rb, *, chunk_levels: int = 3, prefix_mult: int = 2
+) -> Tuple[jax.Array, jax.Array, int]:
+    """Filter-Kruskal solve: prefix Borůvka, one-pass suffix filter, survivor
+    finish. Same contract and bit-identical results as
+    :func:`solve_rank_staged`; a large win on dense graphs (the full edge
+    width is touched by two gathers and one compaction instead of four
+    gathers, a double-width segment_min, an MST scatter, and a compaction).
+    """
+    n_pad = vmin0.shape[0]
+    m_pad = ra.shape[0]
+    prefix = _prefix_size(n_pad, m_pad, prefix_mult)
+    if 2 * prefix > m_pad:
+        # Not enough suffix to pay for the split — plain staged solve.
+        return solve_rank_staged(vmin0, ra, rb, chunk_levels=chunk_levels)
+
+    compact_space = n_pad >= _CENSUS_MIN_SPACE
+    fragment, mst, fa, fb, stats = _filtered_head(vmin0, ra, rb, prefix=prefix)
+    lv, count = (int(x) for x in jax.device_get(stats))
+    mst, fragment, lv = _finish_to_fixpoint(
+        fragment, mst, fa, fb, jnp.arange(prefix, dtype=jnp.int32),
+        lv=lv, count=count, space=n_pad, max_levels=lv + _max_levels(n_pad),
+        chunk_levels=chunk_levels, compact_space=compact_space,
+    )
+
+    fa_s, fb_s, count_d = _filter_suffix_ends(fragment, ra, rb, prefix=prefix)
+    count = int(jax.device_get(count_d))
+    if count > 0:
+        out_size = max(_bucket_size(count), _COMPACT_MIN_SLOTS)
+        cfa, cfb, crank = _filter_compact(
+            fa_s, fb_s, jnp.asarray(prefix, jnp.int32), out_size=out_size
+        )
+        del fa_s, fb_s  # free the suffix-width buffers before the finish
+        mst, fragment, lv = _finish_to_fixpoint(
+            fragment, mst, cfa, cfb, crank,
+            lv=lv, count=count, space=n_pad, max_levels=lv + _max_levels(n_pad),
+            chunk_levels=chunk_levels, compact_space=compact_space,
+        )
+    return mst, fragment, lv
+
+
+# Dense graphs at or above this rank width route through the filtered path
+# (below it, dispatch round-trips outweigh the saved full-width work).
+_FILTER_MIN_RANKS = 1 << 23
+
+
 def solve_rank_auto(vmin0, ra, rb, *, family: str = "dense"):
     """Dispatch policy shared by ``solve_graph_rank`` and ``bench.py`` —
     see :func:`_pick_family` for the per-family rationale. Chunk length 2
     beats 3 on many-level graphs (measured 12.1 s vs 13.2 s on a 4096^2
     grid; 1 loses to dispatch overhead at 14.1 s)."""
     n_pad = vmin0.shape[0]
-    if family == "dense" and n_pad < (1 << 21):
+    if family == "dense" and ra.shape[0] >= _FILTER_MIN_RANKS:
+        return solve_rank_filtered(vmin0, ra, rb)
+    if family == "dense" and n_pad < _CENSUS_MIN_SPACE:
         # Below the census threshold the finish is one chunk and the fetch
         # overhead dominates: speculate the survivor width at m/8 (2x the
         # worst measured RMAT ratio) and fall back on misprediction.

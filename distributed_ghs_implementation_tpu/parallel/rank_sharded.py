@@ -43,9 +43,16 @@ from distributed_ghs_implementation_tpu.models.boruvka import (
     _max_levels,
 )
 from distributed_ghs_implementation_tpu.models.rank_solver import (
+    _CENSUS_MIN_SPACE,
+    _FILTER_MIN_RANKS,
     _compact_slots,
+    _finish_to_fixpoint,
     _level_core,
     _moe_over,
+    _pick_family,
+    _prefix_level2_core,
+    _prefix_size,
+    fetch_mst_edge_ids,
 )
 from distributed_ghs_implementation_tpu.ops.segment_ops import INT32_MAX
 from distributed_ghs_implementation_tpu.ops.union_find import hook_and_compress
@@ -66,15 +73,15 @@ def _owner_lookup(table, ranks, has, k, mb, axis):
     return jax.lax.pmin(jnp.where(mine, table[li], INT32_MAX), axis), mine, li
 
 
-def _rank_sharded_head(vmin0, ra, rb):
-    """Per-shard body: levels 1-2. Returns ``(fragment, mst_local, fa, fb,
-    stats)`` with ``stats = [levels, total_alive, max_local_alive]``."""
+def _sharded_level1(vmin0, ra, rb):
+    """Level 1 on the mesh (traced helper shared by both per-shard heads):
+    hook every vertex on its min incident rank, looking up the winning
+    edges' endpoints from their owner shards via pmin. Returns ``(fragment,
+    parent1, mst_local)``."""
     n = vmin0.shape[0]
     mb = ra.shape[0]
     k = jax.lax.axis_index(EDGE_AXIS).astype(jnp.int32)
     ids = jnp.arange(n, dtype=jnp.int32)
-
-    # ---- Level 1: hook every vertex on its min incident rank.
     has1 = vmin0 < INT32_MAX
     a, mine1, li1 = _owner_lookup(ra, vmin0, has1, k, mb, EDGE_AXIS)
     b, _, _ = _owner_lookup(rb, vmin0, has1, k, mb, EDGE_AXIS)
@@ -83,6 +90,19 @@ def _rank_sharded_head(vmin0, ra, rb):
     mst = jnp.zeros(mb, bool).at[jnp.where(mine1, li1, mb)].max(
         mine1, mode="drop"
     )
+    return fragment, parent1, mst
+
+
+def _rank_sharded_head(vmin0, ra, rb):
+    """Per-shard body: levels 1-2. Returns ``(fragment, mst_local, fa, fb,
+    stats)`` with ``stats = [levels, total_alive, max_local_alive]``."""
+    n = vmin0.shape[0]
+    mb = ra.shape[0]
+    k = jax.lax.axis_index(EDGE_AXIS).astype(jnp.int32)
+    ids = jnp.arange(n, dtype=jnp.int32)
+
+    fragment, parent1, mst = _sharded_level1(vmin0, ra, rb)
+    has1 = vmin0 < INT32_MAX
 
     # ---- Relabel the local rank block (the sharded edge-sized work).
     fa = parent1[ra]
@@ -141,6 +161,83 @@ def _rank_sharded_finish(fragment, mst, fa, fb, *, fs_local: int, max_levels: in
     return fragment, mst, lv
 
 
+# ---------------------------------------------------------------------------
+# Filtered (filter-Kruskal) sharded path — see models/rank_solver.py for the
+# exactness argument. The division of labor on the mesh:
+#   * level 1 stays sharded (pmin owner lookups — n-sized traffic only);
+#   * the prefix solve (levels 2+ over the lightest ranks) runs REPLICATED
+#     on a replicated copy of the prefix block (2n ranks — small);
+#   * the filter — the only edge-width work — is embarrassingly parallel:
+#     each shard relabels its own rank block against the final prefix
+#     partition with two local gathers, no collectives;
+#   * the survivor finish reuses the existing compact/all-gather loop.
+# Per-chip edge-width traffic drops from four gathers + a double-width
+# segment_min to the two filter gathers.
+# ---------------------------------------------------------------------------
+
+
+def _rank_sharded_l1(vmin0, ra, rb):
+    """Per-shard body: level 1 only. Returns ``(fragment, mst_local)``."""
+    fragment, _parent1, mst = _sharded_level1(vmin0, ra, rb)
+    return fragment, mst
+
+
+@jax.jit
+def _prefix_level2(fragment, ra_p, rb_p):
+    """Replicated level 2 over the prefix block (the level-1 partition is the
+    vertex->fragment map, so relabeling endpoints through it is exact)."""
+    fa = fragment[ra_p]
+    fb = fragment[rb_p]
+    fragment, fa, fb, has2, safe2, count = _prefix_level2_core(fragment, fa, fb)
+    mst_p = jnp.zeros(ra_p.shape[0], dtype=bool).at[safe2].max(has2)
+    return fragment, mst_p, fa, fb, jnp.stack(
+        [jnp.any(has2).astype(jnp.int32), count]
+    )
+
+
+def _rank_filter_relabel(fragment, prefix_mask, mst, ra, rb, *, prefix: int):
+    """Per-shard body: the one edge-width pass. Relabels the local rank block
+    against the final prefix partition (dropped slots are exactly the edges
+    the cycle rule excludes) and merges the replicated prefix MST marks into
+    the shard that owns them."""
+    mb = ra.shape[0]
+    k = jax.lax.axis_index(EDGE_AXIS).astype(jnp.int32)
+    gi = k * mb + jnp.arange(mb, dtype=jnp.int32)
+    fa = fragment[ra]
+    fb = fragment[rb]
+    in_prefix = gi < prefix
+    mst = mst | (in_prefix & prefix_mask[jnp.minimum(gi, prefix - 1)])
+    # Prefix slots are all intra-fragment by now; they fall out of `alive`
+    # with no special-casing.
+    local_alive = jnp.sum((fa != fb).astype(jnp.int32))
+    total = jax.lax.psum(local_alive, EDGE_AXIS)
+    cmax = jax.lax.pmax(local_alive, EDGE_AXIS)
+    return mst, fa, fb, jnp.stack([total, cmax])
+
+
+@functools.lru_cache(maxsize=32)
+def make_rank_sharded_l1(mesh: Mesh):
+    mapped = shard_map_compat(
+        _rank_sharded_l1,
+        mesh,
+        in_specs=(P(), P(EDGE_AXIS), P(EDGE_AXIS)),
+        out_specs=(P(), P(EDGE_AXIS)),
+    )
+    return jax.jit(mapped)
+
+
+@functools.lru_cache(maxsize=64)
+def make_rank_filter_relabel(mesh: Mesh, prefix: int):
+    fn = functools.partial(_rank_filter_relabel, prefix=prefix)
+    mapped = shard_map_compat(
+        fn,
+        mesh,
+        in_specs=(P(), P(), P(EDGE_AXIS), P(EDGE_AXIS), P(EDGE_AXIS)),
+        out_specs=(P(EDGE_AXIS), P(EDGE_AXIS), P(EDGE_AXIS), P()),
+    )
+    return jax.jit(mapped)
+
+
 @functools.lru_cache(maxsize=32)
 def make_rank_sharded_head(mesh: Mesh):
     mapped = shard_map_compat(
@@ -167,12 +264,17 @@ def make_rank_sharded_finish(mesh: Mesh, fs_local: int, max_levels: int):
 
 
 def solve_graph_rank_sharded(
-    graph: Graph, *, mesh: Mesh | None = None
+    graph: Graph, *, mesh: Mesh | None = None, filtered: bool | None = None
 ) -> Tuple[np.ndarray, np.ndarray, int]:
     """Host entry mirroring ``solve_graph_rank`` on a device mesh.
 
-    Two dispatches: the sharded head (levels 1-2), then — sized from the
-    head's survivor stats — the compact/all-gather finish.
+    Plain path (small/sparse graphs): two dispatches — the sharded head
+    (levels 1-2), then the compact/all-gather finish sized from the head's
+    survivor stats. Dense graphs at filter scale route through the sharded
+    filter-Kruskal path instead. ``filtered`` overrides the size/density
+    policy, except that a graph without enough suffix beyond the prefix
+    (``2 * prefix > m_pad``) always takes the plain path — the split would
+    be degenerate there.
     """
     if mesh is None:
         mesh = edge_mesh()
@@ -199,6 +301,36 @@ def solve_graph_rank_sharded(
     ra = _stage(ra_np, blk)
     rb = _stage(rb_np, blk)
 
+    prefix = _prefix_size(n_pad, m_pad)
+    if filtered is None:
+        filtered = (
+            m_pad >= _FILTER_MIN_RANKS
+            and 2 * prefix <= m_pad
+            and _pick_family(graph) == "dense"
+        )
+    if filtered and 2 * prefix <= m_pad:
+        ra_p = _stage(np.ascontiguousarray(ra_np[:prefix]), rep)
+        rb_p = _stage(np.ascontiguousarray(rb_np[:prefix]), rep)
+        l1 = make_rank_sharded_l1(mesh)
+        fragment, mst = l1(vmin0, ra, rb)
+        fragment, mst_p, fa_p, fb_p, stats = _prefix_level2(fragment, ra_p, rb_p)
+        lv2, count = (int(x) for x in jax.device_get(stats))
+        lv = 1 + lv2
+        mst_p, fragment, lv = _finish_to_fixpoint(
+            fragment, mst_p, fa_p, fb_p, jnp.arange(prefix, dtype=jnp.int32),
+            lv=lv, count=count, space=n_pad, max_levels=lv + _max_levels(n_pad),
+            chunk_levels=3, compact_space=n_pad >= _CENSUS_MIN_SPACE,
+        )
+        filt = make_rank_filter_relabel(mesh, prefix)
+        mst, fa, fb, fstats = filt(fragment, mst_p, mst, ra, rb)
+        total, cmax = (int(x) for x in jax.device_get(fstats))
+        if total > 0:
+            fs_local = max(_bucket_size(cmax), 1024)
+            finish = make_rank_sharded_finish(mesh, fs_local, _max_levels(n_pad))
+            fragment, mst, extra = finish(fragment, mst, fa, fb)
+            lv += int(extra)
+        return fetch_mst_edge_ids(graph, mst), np.asarray(fragment)[:n], lv
+
     head = make_rank_sharded_head(mesh)
     fragment, mst, fa, fb, stats = head(vmin0, ra, rb)
     lv, total, cmax = (int(x) for x in jax.device_get(stats))
@@ -207,8 +339,4 @@ def solve_graph_rank_sharded(
         finish = make_rank_sharded_finish(mesh, fs_local, _max_levels(n_pad))
         fragment, mst, extra = finish(fragment, mst, fa, fb)
         lv += int(extra)
-    from distributed_ghs_implementation_tpu.models.rank_solver import (
-        fetch_mst_edge_ids,
-    )
-
     return fetch_mst_edge_ids(graph, mst), np.asarray(fragment)[:n], lv

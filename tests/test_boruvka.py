@@ -313,6 +313,113 @@ def solve_graph_for_test(g):
     return solve_graph(g, strategy="fused")
 
 
+@pytest.mark.parametrize(
+    "graph_fn",
+    [
+        lambda: rmat_graph(10, 8, seed=3),
+        lambda: rmat_graph(12, 16, seed=7),
+        lambda: gnm_random_graph(400, 3000, seed=9),
+        # Heavy ties: every weight equal — rank order is pure edge-id order.
+        lambda: Graph.from_arrays(
+            300,
+            np.random.default_rng(1).integers(0, 300, 4000),
+            np.random.default_rng(2).integers(0, 300, 4000),
+            np.ones(4000, dtype=np.int64),
+        ),
+        # Float weights (skips the native counting sort).
+        lambda: Graph.from_arrays(
+            500,
+            np.random.default_rng(3).integers(0, 500, 6000),
+            np.random.default_rng(4).integers(0, 500, 6000),
+            np.random.default_rng(5).random(6000),
+        ),
+        # Disconnected: two dense halves, no bridge.
+        lambda: Graph.from_arrays(
+            400,
+            np.concatenate([
+                np.random.default_rng(6).integers(0, 200, 2500),
+                np.random.default_rng(7).integers(200, 400, 2500),
+            ]),
+            np.concatenate([
+                np.random.default_rng(8).integers(0, 200, 2500),
+                np.random.default_rng(9).integers(200, 400, 2500),
+            ]),
+            np.random.default_rng(10).integers(1, 1000, 5000),
+        ),
+    ],
+)
+def test_filtered_rank_solver_bit_identical(graph_fn):
+    """solve_rank_filtered == solve_rank_staged, bit for bit (the filtered
+    path computes the same unique rank-order MST — see the exactness proof
+    in models/rank_solver.py)."""
+    from distributed_ghs_implementation_tpu.models import rank_solver as rs
+
+    g = graph_fn()
+    vmin0, ra, rb = rs.prepare_rank_arrays(g)
+    m_s, f_s, _ = rs.solve_rank_staged(vmin0, ra, rb)
+    m_f, f_f, _ = rs.solve_rank_filtered(vmin0, ra, rb)
+    assert np.array_equal(np.asarray(m_s), np.asarray(m_f))
+    # Same partition (root ids may differ between merge orders).
+    assert np.array_equal(
+        canonical_partition(np.asarray(f_s)), canonical_partition(np.asarray(f_f))
+    )
+
+
+def canonical_partition(f: np.ndarray) -> np.ndarray:
+    """Relabel a partition by first occurrence, making equality checks
+    insensitive to which member each class uses as its root id."""
+    _, first_idx, inv = np.unique(f, return_index=True, return_inverse=True)
+    order = np.argsort(np.argsort(first_idx))
+    return order[inv]
+
+
+def test_filtered_rank_solver_prefix_extremes():
+    """Degenerate prefix splits: prefix covering the whole graph falls back
+    to the staged path; an oversized prefix_mult is clamped to m_pad."""
+    from distributed_ghs_implementation_tpu.models import rank_solver as rs
+
+    g = line_graph(600)  # m = n - 1: no room for a suffix
+    vmin0, ra, rb = rs.prepare_rank_arrays(g)
+    m_s, _, _ = rs.solve_rank_staged(vmin0, ra, rb, compact_after=1)
+    m_f, _, _ = rs.solve_rank_filtered(vmin0, ra, rb)
+    assert np.array_equal(np.asarray(m_s), np.asarray(m_f))
+
+    g2 = gnm_random_graph(128, 2048, seed=4)
+    vmin0, ra, rb = rs.prepare_rank_arrays(g2)
+    m_s, _, _ = rs.solve_rank_staged(vmin0, ra, rb)
+    for mult in (1, 8):
+        m_f, _, _ = rs.solve_rank_filtered(vmin0, ra, rb, prefix_mult=mult)
+        assert np.array_equal(np.asarray(m_s), np.asarray(m_f))
+
+
+def test_filtered_rank_solver_compact_space(monkeypatch):
+    """The filtered path with the census/shrink finish (forced small
+    thresholds) still matches, exercising the shrink chain across the two
+    _finish_to_fixpoint calls."""
+    from distributed_ghs_implementation_tpu.models import rank_solver as rs
+
+    g = rmat_graph(11, 12, seed=5)
+    vmin0, ra, rb = rs.prepare_rank_arrays(g)
+    m_s, f_s, _ = rs.solve_rank_staged(vmin0, ra, rb)
+
+    orig = rs._finish_to_fixpoint
+
+    def forced(*args, **kw):
+        kw["compact_space"] = True
+        return orig(*args, **kw)
+
+    monkeypatch.setattr(rs, "_SHRINK_MIN_SPACE", 64)
+    try:
+        rs._finish_to_fixpoint = forced
+        m_f, f_f, _ = rs.solve_rank_filtered(vmin0, ra, rb)
+    finally:
+        rs._finish_to_fixpoint = orig
+    assert np.array_equal(np.asarray(m_s), np.asarray(m_f))
+    assert np.array_equal(
+        canonical_partition(np.asarray(f_s)), canonical_partition(np.asarray(f_f))
+    )
+
+
 def test_baseline_config2_exact():
     """BASELINE.json config 2: gnm_random_graph(1024, 8192), all backends."""
     from distributed_ghs_implementation_tpu.graphs.generators import gnm_random_graph

@@ -134,6 +134,62 @@ def test_rank_sharded_disconnected_and_isolated():
     assert np.unique(frag).size == 5  # two trees + three isolated vertices
 
 
+@pytest.mark.parametrize("seed", range(3))
+def test_rank_sharded_filtered_matches_device(seed):
+    """The sharded filter-Kruskal path (forced on below its size threshold)
+    must match the single-device solve exactly."""
+    from distributed_ghs_implementation_tpu.parallel.rank_sharded import (
+        solve_graph_rank_sharded,
+    )
+
+    g = rmat_graph(12, 12, seed=seed, use_native=False)
+    ids, frag, lv = solve_graph_rank_sharded(g, filtered=True)
+    rd = minimum_spanning_forest(g, backend="device")
+    assert np.array_equal(ids, rd.edge_ids)
+    assert verify_result(rd, oracle="scipy").ok
+
+
+def test_rank_sharded_filtered_edge_cases():
+    """Filtered sharded path on awkward shapes: disconnected forest with
+    isolated vertices, a submesh, heavy ties."""
+    from distributed_ghs_implementation_tpu.parallel.rank_sharded import (
+        solve_graph_rank_sharded,
+    )
+
+    # Disconnected forest with isolated vertices, big enough that the
+    # 2*prefix <= m_pad guard actually routes through the filtered path
+    # (two dense 40-vertex halves, 10 isolated vertices, no bridge).
+    rng0 = np.random.default_rng(21)
+    g = Graph.from_arrays(
+        90,
+        np.concatenate([rng0.integers(0, 40, 900), rng0.integers(40, 80, 900)]),
+        np.concatenate([rng0.integers(0, 40, 900), rng0.integers(40, 80, 900)]),
+        rng0.integers(1, 500, 1800),
+    )
+    ids, frag, lv = solve_graph_rank_sharded(g, filtered=True)
+    rd0 = minimum_spanning_forest(g, backend="device")
+    assert np.array_equal(ids, rd0.edge_ids)
+    assert np.unique(frag).size == rd0.num_components
+    assert np.unique(frag).size >= 12  # two components + 10 isolated
+
+    g2 = erdos_renyi_graph(80, 0.3, seed=5)
+    mesh = edge_mesh(num_devices=4)
+    ids, frag, lv = solve_graph_rank_sharded(g2, mesh=mesh, filtered=True)
+    rd = minimum_spanning_forest(g2, backend="device")
+    assert np.array_equal(ids, rd.edge_ids)
+
+    rng = np.random.default_rng(11)
+    g3 = Graph.from_arrays(
+        200,
+        rng.integers(0, 200, 3000),
+        rng.integers(0, 200, 3000),
+        np.ones(3000, dtype=np.int64),
+    )
+    ids, frag, lv = solve_graph_rank_sharded(g3, filtered=True)
+    rd = minimum_spanning_forest(g3, backend="device")
+    assert np.array_equal(ids, rd.edge_ids)
+
+
 def test_rank_sharded_submesh():
     from distributed_ghs_implementation_tpu.parallel.rank_sharded import (
         solve_graph_rank_sharded,
