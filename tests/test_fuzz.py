@@ -53,6 +53,18 @@ def test_all_strategies_agree_with_oracle(n, m, wmax, seed):
     ids_rs, _, _ = solve_graph_rank_sharded(g)
     assert abs(float(g.w[ids_rs].sum()) - expect) < 1e-6, "rank-sharded"
 
+    # Filter-Kruskal variants (single-chip and sharded), forced on even
+    # below their size thresholds.
+    from distributed_ghs_implementation_tpu.models import rank_solver as rs
+
+    vmin0, ra, rb = rs.prepare_rank_arrays(g)
+    mst_f, _, _ = rs.solve_rank_filtered(vmin0, ra, rb)
+    ranks = np.nonzero(np.asarray(mst_f))[0]
+    ids_f = np.sort(g.edge_id_of_rank(ranks))
+    assert abs(float(g.w[ids_f].sum()) - expect) < 1e-6, "filtered"
+    ids_fs, _, _ = solve_graph_rank_sharded(g, filtered=True)
+    assert abs(float(g.w[ids_fs].sum()) - expect) < 1e-6, "filtered-sharded"
+
     # The shared (weight, edge id) tie-break makes every strategy pick the
     # same edge set, not just the same weight.
     base = results["rank"]
@@ -60,6 +72,8 @@ def test_all_strategies_agree_with_oracle(n, m, wmax, seed):
         assert np.array_equal(ids, base), strat
     assert np.array_equal(ids_sh, base)
     assert np.array_equal(ids_rs, base)
+    assert np.array_equal(ids_f, base)
+    assert np.array_equal(ids_fs, base)
 
 
 def test_star_graph_all_strategies():

@@ -56,7 +56,7 @@ def main():
     lv = 0
     for i in range(3):
         t0 = time.perf_counter()
-        mst, frag, lv = rs.solve_rank_staged(vmin0, ra, rb, compact_after=2)
+        mst, frag, lv = rs.solve_rank_auto(vmin0, ra, rb, family="dense")
         jax.block_until_ready((mst, frag))
         times.append(time.perf_counter() - t0)
         log(f"solve {i}: {times[-1]:.2f}s levels={lv}")
