@@ -404,6 +404,11 @@ _SHRINK_MIN_SPACE = 1 << 15
 # 2.8 s + compact finish 1.1 s).
 _CENSUS_MIN_SPACE = 1 << 21
 
+# Compacted widths at or below this run all remaining levels in one dispatch
+# (the level loop exits early on convergence, so the only cost of a long
+# chunk at small width is skipped re-compaction — negligible there).
+_ONE_SHOT_MAX_SLOTS = 1 << 22
+
 
 @jax.jit
 def _relabel_slots(fragment, ra, rb):
@@ -505,9 +510,13 @@ def solve_rank_staged(
     if on_chunk is not None and initial_state is None:
         on_chunk(lv, fragment, mst, count)
 
+    # Budget RELATIVE to the entry level: a resume from a filtered-path
+    # checkpoint can arrive with lv already at or past _max_levels(n_pad)
+    # (the filtered phases each budget lv + _max_levels); an absolute cap
+    # would run zero chunks and silently return the incomplete forest.
     return _finish_to_fixpoint(
         fragment, mst, fa, fb, rank_of_slot,
-        lv=lv, count=count, space=n_pad, max_levels=_max_levels(n_pad),
+        lv=lv, count=count, space=n_pad, max_levels=lv + _max_levels(n_pad),
         chunk_levels=chunk_levels, compact_space=compact_space,
         on_chunk=on_chunk,
     )
@@ -552,6 +561,20 @@ def _finish_to_fixpoint(
 
     while count > 0 and lv < max_levels:
         out_size = max(_bucket_size(count), _COMPACT_MIN_SLOTS)
+        # Once the compacted width is small, per-level cost is negligible and
+        # the level loop exits early on convergence — so run ALL remaining
+        # levels in one dispatch instead of paying a host round trip
+        # (~0.12 s tunneled) every `chunk_levels`. At large widths short
+        # chunks still win: they reach the next re-compaction sooner.
+        # The one-shot budget is SHAPE-ONLY (not the run-dependent
+        # max_levels, which would multiply jit cache entries per graph):
+        # fragments still merging <= 2 * alive slots, so
+        # _max_levels(2 * out_size) levels always converge.
+        eff_levels = (
+            _max_levels(2 * out_size)
+            if out_size <= _ONE_SHOT_MAX_SLOTS
+            else chunk_levels
+        )
         did_levels = False
         if compact_space and space > _SHRINK_MIN_SPACE and census_failures < 2:
             cfa_o, cfb_o, crank, mark, newid, cstats = _compact_and_mark(
@@ -570,7 +593,7 @@ def _finish_to_fixpoint(
                     vertex_fragment = frag_state
                 rep, frag_state, mst, fa, fb, stats = _shrink_and_run(
                     mark, newid, rep_prev, mst, cfa_o, cfb_o, crank,
-                    f_size=f_size, chunk_levels=chunk_levels,
+                    f_size=f_size, chunk_levels=eff_levels,
                 )
                 pending = (mark, newid, rep)
                 rank_of_slot = crank
@@ -581,14 +604,14 @@ def _finish_to_fixpoint(
                 # Reuse the compacted slots; run the levels without shrink.
                 frag_state, mst, fa, fb, stats = _run_levels(
                     frag_state, mst, cfa_o, cfb_o, crank,
-                    chunk_levels=chunk_levels,
+                    chunk_levels=eff_levels,
                 )
                 rank_of_slot = crank
                 did_levels = True
         if not did_levels:
             frag_state, mst, fa, fb, rank_of_slot, stats = _finish_chunk(
                 frag_state, mst, fa, fb, rank_of_slot,
-                out_size=out_size, chunk_levels=chunk_levels,
+                out_size=out_size, chunk_levels=eff_levels,
             )
         extra, count = (int(x) for x in jax.device_get(stats))
         lv += extra
@@ -683,28 +706,40 @@ def _prefix_size(n_pad: int, m_pad: int, mult: int = 2) -> int:
 
 
 def solve_rank_filtered(
-    vmin0, ra, rb, *, chunk_levels: int = 3, prefix_mult: int = 2
+    vmin0, ra, rb, *, chunk_levels: int = 3, prefix_mult: int = 2, on_chunk=None
 ) -> Tuple[jax.Array, jax.Array, int]:
     """Filter-Kruskal solve: prefix Borůvka, one-pass suffix filter, survivor
     finish. Same contract and bit-identical results as
     :func:`solve_rank_staged`; a large win on dense graphs (the full edge
     width is touched by two gathers and one compaction instead of four
     gathers, a double-width segment_min, an MST scatter, and a compaction).
+
+    ``on_chunk(level, vertex_fragment, mst, count)`` fires after the head
+    and each finish chunk with the vertex-level fragment and the full-width
+    rank mask — the same checkpoint contract as the staged path (``count``
+    is the alive count of the *current phase's* slots). Resume from a
+    checkpoint goes through :func:`solve_rank_staged`'s ``initial_state``,
+    which is exact from any saved partition.
     """
     n_pad = vmin0.shape[0]
     m_pad = ra.shape[0]
     prefix = _prefix_size(n_pad, m_pad, prefix_mult)
     if 2 * prefix > m_pad:
         # Not enough suffix to pay for the split — plain staged solve.
-        return solve_rank_staged(vmin0, ra, rb, chunk_levels=chunk_levels)
+        return solve_rank_staged(
+            vmin0, ra, rb, chunk_levels=chunk_levels, on_chunk=on_chunk
+        )
 
     compact_space = n_pad >= _CENSUS_MIN_SPACE
     fragment, mst, fa, fb, stats = _filtered_head(vmin0, ra, rb, prefix=prefix)
     lv, count = (int(x) for x in jax.device_get(stats))
+    if on_chunk is not None:
+        on_chunk(lv, fragment, mst, count)
     mst, fragment, lv = _finish_to_fixpoint(
         fragment, mst, fa, fb, jnp.arange(prefix, dtype=jnp.int32),
         lv=lv, count=count, space=n_pad, max_levels=lv + _max_levels(n_pad),
         chunk_levels=chunk_levels, compact_space=compact_space,
+        on_chunk=on_chunk,
     )
 
     fa_s, fb_s, count_d = _filter_suffix_ends(fragment, ra, rb, prefix=prefix)
@@ -719,13 +754,88 @@ def solve_rank_filtered(
             fragment, mst, cfa, cfb, crank,
             lv=lv, count=count, space=n_pad, max_levels=lv + _max_levels(n_pad),
             chunk_levels=chunk_levels, compact_space=compact_space,
+            on_chunk=on_chunk,
         )
     return mst, fragment, lv
+
+
+@functools.partial(jax.jit, static_argnames=("prefix", "out_size", "max_levels"))
+def _filtered_speculative_program(
+    vmin0, ra, rb, *, prefix: int, out_size: int, max_levels: int
+):
+    """The whole filtered solve as ONE dispatch, for the small-dense regime
+    where host round trips (~0.12 s each on a tunneled chip) dominate:
+
+      head -> prefix levels to fixpoint at full prefix width (no compaction
+      — the prefix is only ~2n ranks) -> suffix filter -> compact to the
+      *predicted* ``out_size`` -> survivor levels to fixpoint.
+
+    One combined stats fetch afterwards validates the speculation; the
+    caller falls back to the exact staged sequence if the survivor width
+    overflowed or either fixpoint loop hit ``max_levels`` while alive.
+    Results are bit-identical to :func:`solve_rank_filtered` when accepted.
+
+    Returns ``(fragment, mst, stats)`` with ``stats = [levels,
+    filter_count, prefix_alive_end, survivor_alive_end]``.
+    """
+    fragment, mst, fa, fb, stats0 = _filtered_head(vmin0, ra, rb, prefix=prefix)
+    crank_p = jnp.arange(prefix, dtype=jnp.int32)
+    fragment, mst, fa, fb, stats1 = _levels_loop(
+        fragment, mst, fa, fb, crank_p, chunk_levels=max_levels
+    )
+
+    fa_s = fragment[ra[prefix:]]
+    fb_s = fragment[rb[prefix:]]
+    filter_count = jnp.sum((fa_s != fb_s).astype(jnp.int32))
+    rank_of_slot = jnp.arange(fa_s.shape[0], dtype=jnp.int32) + prefix
+    cfa, cfb, crank, _valid = _compact_slots(fa_s, fb_s, rank_of_slot, out_size)
+    fragment, mst, cfa, cfb, stats2 = _levels_loop(
+        fragment, mst, cfa, cfb, crank, chunk_levels=max_levels
+    )
+
+    lv = stats0[0] + stats1[0] + stats2[0]
+    return fragment, mst, jnp.stack(
+        [lv, filter_count, stats1[1], stats2[1]]
+    )
+
+
+def solve_rank_filtered_speculative(
+    vmin0, ra, rb, *, prefix_mult: int = 2, out_size: int | None = None
+) -> Tuple[jax.Array, jax.Array, int] | None:
+    """Single-round-trip filtered solve; ``None`` on misprediction (caller
+    falls back to :func:`solve_rank_filtered`). The survivor width defaults
+    to ``m/8`` — comfortably above every measured RMAT/ER survivor ratio
+    (the filter kills ~97-99% of the suffix)."""
+    n_pad = vmin0.shape[0]
+    m_pad = ra.shape[0]
+    prefix = _prefix_size(n_pad, m_pad, prefix_mult)
+    if 2 * prefix > m_pad:
+        return None
+    if out_size is None:
+        out_size = max(_bucket_size(m_pad // 8), _COMPACT_MIN_SLOTS)
+    max_levels = _max_levels(n_pad)
+    fragment, mst, stats = _filtered_speculative_program(
+        vmin0, ra, rb, prefix=prefix, out_size=out_size, max_levels=max_levels
+    )
+    lv, filter_count, prefix_alive, survivor_alive = (
+        int(x) for x in jax.device_get(stats)
+    )
+    if filter_count <= out_size and prefix_alive == 0 and survivor_alive == 0:
+        return mst, fragment, lv
+    return None
 
 
 # Dense graphs at or above this rank width route through the filtered path
 # (below it, dispatch round-trips outweigh the saved full-width work).
 _FILTER_MIN_RANKS = 1 << 23
+
+
+def use_filtered_path(family: str, num_ranks: int) -> bool:
+    """THE routing predicate for the filter-Kruskal path — shared by
+    ``solve_rank_auto``, the checkpoint path, and the sharded entry, so a
+    retune cannot route checkpointed or sharded runs down a different
+    kernel than the benchmarked auto path."""
+    return family == "dense" and num_ranks >= _FILTER_MIN_RANKS
 
 
 def solve_rank_auto(vmin0, ra, rb, *, family: str = "dense"):
@@ -734,7 +844,11 @@ def solve_rank_auto(vmin0, ra, rb, *, family: str = "dense"):
     beats 3 on many-level graphs (measured 12.1 s vs 13.2 s on a 4096^2
     grid; 1 loses to dispatch overhead at 14.1 s)."""
     n_pad = vmin0.shape[0]
-    if family == "dense" and ra.shape[0] >= _FILTER_MIN_RANKS:
+    if use_filtered_path(family, ra.shape[0]):
+        # Measured at RMAT-20: the staged filtered path with adaptive
+        # (one-shot at small width) chunking beats the fully-fused
+        # speculative program (1.86 s), whose uncompacted level loops cost
+        # more than the round trips they save at this width.
         return solve_rank_filtered(vmin0, ra, rb)
     if family == "dense" and n_pad < _CENSUS_MIN_SPACE:
         # Below the census threshold the finish is one chunk and the fetch

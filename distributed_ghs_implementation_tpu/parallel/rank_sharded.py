@@ -44,7 +44,6 @@ from distributed_ghs_implementation_tpu.models.boruvka import (
 )
 from distributed_ghs_implementation_tpu.models.rank_solver import (
     _CENSUS_MIN_SPACE,
-    _FILTER_MIN_RANKS,
     _compact_slots,
     _finish_to_fixpoint,
     _level_core,
@@ -53,6 +52,7 @@ from distributed_ghs_implementation_tpu.models.rank_solver import (
     _prefix_level2_core,
     _prefix_size,
     fetch_mst_edge_ids,
+    use_filtered_path,
 )
 from distributed_ghs_implementation_tpu.ops.segment_ops import INT32_MAX
 from distributed_ghs_implementation_tpu.ops.union_find import hook_and_compress
@@ -313,9 +313,7 @@ def solve_graph_rank_sharded(
     prefix = _prefix_size(n_pad, m_pad)
     if filtered is None:
         filtered = (
-            m_pad >= _FILTER_MIN_RANKS
-            and 2 * prefix <= m_pad
-            and _pick_family(graph) == "dense"
+            use_filtered_path(_pick_family(graph), m_pad) and 2 * prefix <= m_pad
         )
     if filtered and 2 * prefix <= m_pad:
         slice_rep = make_prefix_slice(mesh, prefix)

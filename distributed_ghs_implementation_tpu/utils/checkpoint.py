@@ -120,7 +120,9 @@ def solve_graph_checkpointed(
             _family_params,
             _pick_family,
             prepare_rank_arrays,
+            solve_rank_filtered,
             solve_rank_staged,
+            use_filtered_path,
         )
 
         vmin0, ra, rb = prepare_rank_arrays(graph)
@@ -135,12 +137,21 @@ def solve_graph_checkpointed(
                     checkpoint_path, fragment, mst_ranks, level, fingerprint=fp
                 )
 
-        mst_ranks, fragment, levels = solve_rank_staged(
-            vmin0, ra, rb,
-            **_family_params(_pick_family(graph)),
-            initial_state=initial_state,
-            on_chunk=on_chunk,
-        )
+        family = _pick_family(graph)
+        if initial_state is None and use_filtered_path(family, ra.shape[0]):
+            # Fresh dense solve: the filter-Kruskal path, same on_chunk
+            # contract. A resume continues through the staged path below —
+            # exact from any saved partition, just without the filter split.
+            mst_ranks, fragment, levels = solve_rank_filtered(
+                vmin0, ra, rb, on_chunk=on_chunk
+            )
+        else:
+            mst_ranks, fragment, levels = solve_rank_staged(
+                vmin0, ra, rb,
+                **_family_params(family),
+                initial_state=initial_state,
+                on_chunk=on_chunk,
+            )
     elif strategy == "stepped":
         from distributed_ghs_implementation_tpu.models.boruvka import (
             prepare_device_arrays,

@@ -373,6 +373,50 @@ def canonical_partition(f: np.ndarray) -> np.ndarray:
     return order[inv]
 
 
+@pytest.mark.parametrize(
+    "graph_fn",
+    [
+        lambda: rmat_graph(12, 16, seed=7),
+        lambda: gnm_random_graph(400, 3000, seed=9),
+        lambda: rmat_graph(10, 8, seed=3),
+    ],
+)
+def test_filtered_speculative_bit_identical(graph_fn):
+    """The one-dispatch speculative filtered solve matches the staged path
+    bit for bit when its predictions hold."""
+    from distributed_ghs_implementation_tpu.models import rank_solver as rs
+
+    g = graph_fn()
+    vmin0, ra, rb = rs.prepare_rank_arrays(g)
+    m_s, f_s, _ = rs.solve_rank_staged(vmin0, ra, rb)
+    r = rs.solve_rank_filtered_speculative(vmin0, ra, rb)
+    assert r is not None
+    m_f, f_f, _ = r
+    assert np.array_equal(np.asarray(m_s), np.asarray(m_f))
+    assert np.array_equal(
+        canonical_partition(np.asarray(f_s)), canonical_partition(np.asarray(f_f))
+    )
+
+
+def test_filtered_speculative_misprediction_falls_back():
+    """An absurdly small survivor-width prediction must return None (never
+    corrupt results), and solve_rank_auto must still produce the exact MST
+    through the fallback chain."""
+    from distributed_ghs_implementation_tpu.models import rank_solver as rs
+
+    g = gnm_random_graph(300, 4000, seed=13)
+    vmin0, ra, rb = rs.prepare_rank_arrays(g)
+    ref_ids, _, _ = solve_graph_for_test(g)
+    r = rs.solve_rank_filtered_speculative(vmin0, ra, rb, out_size=2)
+    if r is not None:  # accepted only if the filter truly left <= 2 survivors
+        mst, _, _ = r
+        ids = np.sort(g.edge_id_of_rank(np.nonzero(np.asarray(mst))[0]))
+        assert np.array_equal(ids, ref_ids)
+    mst, fragment, _ = rs.solve_rank_auto(vmin0, ra, rb, family="dense")
+    ids = np.sort(g.edge_id_of_rank(np.nonzero(np.asarray(mst))[0]))
+    assert np.array_equal(ids, ref_ids)
+
+
 def test_filtered_rank_solver_prefix_extremes():
     """Degenerate prefix splits: prefix covering the whole graph falls back
     to the staged path; an oversized prefix_mult is clamped to m_pad."""

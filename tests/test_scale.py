@@ -75,6 +75,7 @@ def test_compact_space_shrink_fires_and_is_exact():
 
     g = road_grid_graph(512, 512, seed=3)
     orig = rs._shrink_and_run
+    orig_oneshot = rs._ONE_SHOT_MAX_SLOTS
     f_sizes = []
 
     def spy(*a, **k):
@@ -82,6 +83,10 @@ def test_compact_space_shrink_fires_and_is_exact():
         return orig(*a, **k)
 
     rs._shrink_and_run = spy
+    # Disable adaptive one-shot chunking: at this test's size it finishes
+    # the solve in the first shrink's dispatch, leaving the multi-stage
+    # chain (the thing under test) unexercised.
+    rs._ONE_SHOT_MAX_SLOTS = 0
     try:
         # Force the sparse head (level 1 only): the grid family's full-width
         # level 2 would leave just one shrink; this path exercises the
@@ -92,6 +97,7 @@ def test_compact_space_shrink_fires_and_is_exact():
         )
     finally:
         rs._shrink_and_run = orig
+        rs._ONE_SHOT_MAX_SLOTS = orig_oneshot
     ranks = np.nonzero(np.asarray(mst))[0]
     ids = np.sort(g.edge_id_of_rank(ranks))
     frag = np.asarray(fragment)[: g.num_nodes]
