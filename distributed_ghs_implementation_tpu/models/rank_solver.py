@@ -697,6 +697,83 @@ def _filter_compact(fa, fb, prefix, *, out_size: int):
     return cfa, cfb, crank
 
 
+@functools.partial(jax.jit, static_argnames=("width",))
+def _filter_chunk_ends(fragment, ra, rb, start, *, width: int):
+    """One suffix chunk of the filter: relabel ranks ``[start, start+width)``
+    and count survivors. Slicing inside the jit keeps only chunk-width
+    intermediates live — the point of the chunked filter."""
+    ca = jax.lax.dynamic_slice(ra, (start,), (width,))
+    cb = jax.lax.dynamic_slice(rb, (start,), (width,))
+    fa = fragment[ca]
+    fb = fragment[cb]
+    return fa, fb, jnp.sum((fa != fb).astype(jnp.int32))
+
+
+# Suffix bytes above which the filter runs in chunks. This is a CAPACITY
+# mechanism, not a speedup: measured at RMAT-25 (3.96 GB suffix, fits
+# single-pass) chunking was 47.5 s vs 45.5 s single-pass, so the threshold
+# sits just above that — chunking engages only where the single-pass
+# suffix-width fa/fb cannot fit next to the resident rank arrays at all
+# (RMAT-26: 8.6 GB of ra/rb alone on a 16 GB chip).
+_FILTER_CHUNK_BYTES = 1 << 32
+# Per-chunk width target (~0.54 GB of fa+fb per chunk).
+_FILTER_CHUNK_RANKS = 1 << 26
+
+
+def _filter_suffix_chunked(fragment, ra, rb, prefix: int):
+    """The full-width filter pass in rank-ordered chunks.
+
+    Returns ``(cfa, cfb, crank, count)`` with survivors concatenated in
+    ascending-rank order (chunks are processed ascending and each chunk's
+    compaction is order-preserving, so the concatenated slot order remains
+    the global tie-break order — the same invariant the single-pass filter
+    relies on). Peak extra HBM is two chunk-width int32 arrays instead of
+    two suffix-width ones.
+    """
+    m_pad = ra.shape[0]
+    suffix = m_pad - prefix
+    n_chunks = max(1, -(-suffix // _FILTER_CHUNK_RANKS))
+    width = -(-suffix // n_chunks)
+    # Both prefix and m_pad are bucket sizes (multiples of large powers of
+    # two), so width divides evenly in practice; guard the general case by
+    # clamping the last chunk's start and masking the overlap.
+    parts = []
+    count = 0
+    for k in range(n_chunks):
+        start = prefix + k * width
+        overlap = 0
+        if start + width > m_pad:  # re-reads tail ranks already filtered
+            overlap = start + width - m_pad
+            start = m_pad - width
+        fa, fb, cnt_d = _filter_chunk_ends(
+            fragment, ra, rb, jnp.asarray(start, jnp.int32), width=width
+        )
+        if overlap:
+            keep = jnp.arange(width, dtype=jnp.int32) >= overlap
+            fa = jnp.where(keep, fa, 0)
+            fb = jnp.where(keep, fb, 0)
+            cnt_d = jnp.sum((fa != fb).astype(jnp.int32))
+        cnt = int(jax.device_get(cnt_d))
+        if cnt:
+            out_c = max(_bucket_size(cnt), _COMPACT_MIN_SLOTS)
+            cfa, cfb, crank = _filter_compact(
+                fa, fb, jnp.asarray(start, jnp.int32), out_size=out_c
+            )
+            parts.append((cfa[:cnt], cfb[:cnt], crank[:cnt]))
+            count += cnt
+        del fa, fb
+    if not parts:
+        return None, None, None, 0
+    out_size = max(_bucket_size(count), _COMPACT_MIN_SLOTS)
+    pad = out_size - count
+    cfa = jnp.concatenate([p[0] for p in parts] + [jnp.zeros(pad, jnp.int32)])
+    cfb = jnp.concatenate([p[1] for p in parts] + [jnp.zeros(pad, jnp.int32)])
+    crank = jnp.concatenate(
+        [p[2] for p in parts] + [jnp.zeros(pad, jnp.int32)]
+    )
+    return cfa, cfb, crank, count
+
+
 def _prefix_size(n_pad: int, m_pad: int, mult: int = 2) -> int:
     """The filter split point: lightest ``mult * n_pad`` ranks, bucketed
     (``mult=2`` measured best at RMAT-20: 1.456/1.461/1.573 s for 1/2/4).
@@ -742,14 +819,22 @@ def solve_rank_filtered(
         on_chunk=on_chunk,
     )
 
-    fa_s, fb_s, count_d = _filter_suffix_ends(fragment, ra, rb, prefix=prefix)
-    count = int(jax.device_get(count_d))
+    if 8 * (m_pad - prefix) > _FILTER_CHUNK_BYTES:
+        # RMAT-25+ widths: chunk the filter so its intermediates never
+        # exceed two chunk-width arrays (the single-pass form's suffix-width
+        # fa/fb are the HBM-capacity knee at ~0.5B ranks).
+        cfa, cfb, crank, count = _filter_suffix_chunked(fragment, ra, rb, prefix)
+    else:
+        fa_s, fb_s, count_d = _filter_suffix_ends(fragment, ra, rb, prefix=prefix)
+        count = int(jax.device_get(count_d))
+        cfa = cfb = crank = None
+        if count > 0:
+            out_size = max(_bucket_size(count), _COMPACT_MIN_SLOTS)
+            cfa, cfb, crank = _filter_compact(
+                fa_s, fb_s, jnp.asarray(prefix, jnp.int32), out_size=out_size
+            )
+            del fa_s, fb_s  # free the suffix-width buffers before the finish
     if count > 0:
-        out_size = max(_bucket_size(count), _COMPACT_MIN_SLOTS)
-        cfa, cfb, crank = _filter_compact(
-            fa_s, fb_s, jnp.asarray(prefix, jnp.int32), out_size=out_size
-        )
-        del fa_s, fb_s  # free the suffix-width buffers before the finish
         mst, fragment, lv = _finish_to_fixpoint(
             fragment, mst, cfa, cfb, crank,
             lv=lv, count=count, space=n_pad, max_levels=lv + _max_levels(n_pad),

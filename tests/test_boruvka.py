@@ -417,6 +417,26 @@ def test_filtered_speculative_misprediction_falls_back():
     assert np.array_equal(ids, ref_ids)
 
 
+def test_filtered_chunked_filter_bit_identical(monkeypatch):
+    """The chunked suffix filter (forced via tiny thresholds, including a
+    non-dividing chunk width that exercises the clamped-overlap path) is
+    bit-identical to the single-pass filter."""
+    from distributed_ghs_implementation_tpu.models import rank_solver as rs
+
+    g = rmat_graph(12, 16, seed=7)
+    vmin0, ra, rb = rs.prepare_rank_arrays(g)
+    m_ref, f_ref, _ = rs.solve_rank_filtered(vmin0, ra, rb)
+    monkeypatch.setattr(rs, "_FILTER_CHUNK_BYTES", 1)
+    for chunk_ranks in (1 << 13, 12345):  # pow2 and a non-dividing width
+        monkeypatch.setattr(rs, "_FILTER_CHUNK_RANKS", chunk_ranks)
+        m_c, f_c, _ = rs.solve_rank_filtered(vmin0, ra, rb)
+        assert np.array_equal(np.asarray(m_ref), np.asarray(m_c)), chunk_ranks
+        assert np.array_equal(
+            canonical_partition(np.asarray(f_ref)),
+            canonical_partition(np.asarray(f_c)),
+        )
+
+
 def test_filtered_rank_solver_prefix_extremes():
     """Degenerate prefix splits: prefix covering the whole graph falls back
     to the staged path; an oversized prefix_mult is clamped to m_pad."""
