@@ -946,13 +946,34 @@ def solve_rank_auto(vmin0, ra, rb, *, family: str = "dense"):
     return solve_rank_staged(vmin0, ra, rb, **_family_params(family))
 
 
+# packbits over masks wider than this runs in slices: the single
+# full-width program fails to compile at 2^30 width (observed on the
+# tunneled chip's compile helper at RMAT-26). Slice boundaries stay
+# byte-aligned — every width above the threshold is a bucket size, i.e. a
+# multiple of a large power of two, so both the chunk and any remainder
+# tail are multiples of 8 and per-byte bit order is unaffected.
+_PACKBITS_CHUNK = 1 << 27
+
+
 def fetch_mst_edge_ids(graph: Graph, mst) -> np.ndarray:
     """Device mask -> sorted edge ids, fetched bit-packed (8x less tunnel
     traffic: a 16.8M-node road grid's 42 MB bool mask is ~1.4 s of transfer
     on this setup). Shared by the single-chip and sharded hosts and the
     bench tools."""
-    packed = np.asarray(jnp.packbits(mst))
-    mask = np.unpackbits(packed, count=mst.shape[0]).astype(bool)
+    w = mst.shape[0]
+    if w > _PACKBITS_CHUNK and w % 8 == 0:
+        parts = []
+        for s in range(0, w, _PACKBITS_CHUNK):
+            size = min(_PACKBITS_CHUNK, w - s)  # tail slice stays byte-aligned
+            parts.append(
+                np.asarray(
+                    jnp.packbits(jax.lax.dynamic_slice(mst, (s,), (size,)))
+                )
+            )
+        packed = np.concatenate(parts)
+    else:
+        packed = np.asarray(jnp.packbits(mst))
+    mask = np.unpackbits(packed, count=w).astype(bool)
     return np.sort(graph.edge_id_of_rank(np.nonzero(mask)[0]))
 
 

@@ -437,6 +437,26 @@ def test_filtered_chunked_filter_bit_identical(monkeypatch):
         )
 
 
+def test_fetch_mst_edge_ids_chunked_packbits(monkeypatch):
+    """The sliced packbits fetch (forced via a tiny threshold) returns the
+    same edge ids as the single-program form."""
+    from distributed_ghs_implementation_tpu.models import rank_solver as rs
+
+    g = rmat_graph(10, 8, seed=3)
+    vmin0, ra, rb = rs.prepare_rank_arrays(g)
+    mst, _, _ = rs.solve_rank_staged(vmin0, ra, rb)
+    ids_full = rs.fetch_mst_edge_ids(g, mst)
+    w = mst.shape[0]
+    assert w % 8 == 0
+    # A dividing chunk and a non-dividing one (exercises the remainder
+    # tail — quarter-step bucket widths need not divide by the chunk).
+    for chunk in (w // 4, max(8, (w // 3) & ~7)):
+        monkeypatch.setattr(rs, "_PACKBITS_CHUNK", chunk)
+        assert w > chunk
+        ids_chunked = rs.fetch_mst_edge_ids(g, mst)
+        assert np.array_equal(ids_full, ids_chunked), chunk
+
+
 def test_filtered_rank_solver_prefix_extremes():
     """Degenerate prefix splits: prefix covering the whole graph falls back
     to the staged path; an oversized prefix_mult is clamped to m_pad."""
