@@ -844,68 +844,91 @@ def solve_rank_filtered(
     return mst, fragment, lv
 
 
-@functools.partial(jax.jit, static_argnames=("prefix", "out_size", "max_levels"))
+@functools.partial(
+    jax.jit, static_argnames=("prefix", "prefix_out", "out_size", "max_levels")
+)
 def _filtered_speculative_program(
-    vmin0, ra, rb, *, prefix: int, out_size: int, max_levels: int
+    vmin0, ra, rb, *, prefix: int, prefix_out: int, out_size: int, max_levels: int
 ):
     """The whole filtered solve as ONE dispatch, for the small-dense regime
     where host round trips (~0.12 s each on a tunneled chip) dominate:
 
-      head -> prefix levels to fixpoint at full prefix width (no compaction
-      — the prefix is only ~2n ranks) -> suffix filter -> compact to the
-      *predicted* ``out_size`` -> survivor levels to fixpoint.
+      head -> compact prefix survivors to the predicted ``prefix_out`` ->
+      levels to fixpoint there -> suffix filter -> compact to the predicted
+      ``out_size`` -> survivor levels to fixpoint.
 
-    One combined stats fetch afterwards validates the speculation; the
-    caller falls back to the exact staged sequence if the survivor width
-    overflowed or either fixpoint loop hit ``max_levels`` while alive.
-    Results are bit-identical to :func:`solve_rank_filtered` when accepted.
+    Both inner loops run COMPACTED (an uncompacted variant measured 1.86 s
+    at RMAT-20 where the adaptive-chunked staged path runs 1.41 s —
+    per-level cost at full prefix width costs more than the round trips it
+    saves; measured survivor ratios are 5.3% of the prefix and 0.21% of
+    the suffix, so the speculative widths carry >2x margin). One combined
+    stats fetch validates every speculation; the
+    caller falls back to the exact staged sequence on any overflow or
+    non-convergence. Results are bit-identical to
+    :func:`solve_rank_filtered` when accepted.
 
     Returns ``(fragment, mst, stats)`` with ``stats = [levels,
-    filter_count, prefix_alive_end, survivor_alive_end]``.
+    prefix_count, prefix_alive_end, filter_count, survivor_alive_end]``.
     """
     fragment, mst, fa, fb, stats0 = _filtered_head(vmin0, ra, rb, prefix=prefix)
-    crank_p = jnp.arange(prefix, dtype=jnp.int32)
-    fragment, mst, fa, fb, stats1 = _levels_loop(
-        fragment, mst, fa, fb, crank_p, chunk_levels=max_levels
+    prefix_count = stats0[1]
+    rank_p = jnp.arange(prefix, dtype=jnp.int32)
+    cfa_p, cfb_p, crank_p, _ = _compact_slots(fa, fb, rank_p, prefix_out)
+    fragment, mst, cfa_p, cfb_p, stats1 = _levels_loop(
+        fragment, mst, cfa_p, cfb_p, crank_p, chunk_levels=max_levels
     )
 
-    fa_s = fragment[ra[prefix:]]
-    fb_s = fragment[rb[prefix:]]
-    filter_count = jnp.sum((fa_s != fb_s).astype(jnp.int32))
-    rank_of_slot = jnp.arange(fa_s.shape[0], dtype=jnp.int32) + prefix
-    cfa, cfb, crank, _valid = _compact_slots(fa_s, fb_s, rank_of_slot, out_size)
+    fa_s, fb_s, filter_count = _filter_suffix_ends(fragment, ra, rb, prefix=prefix)
+    cfa, cfb, crank = _filter_compact(
+        fa_s, fb_s, jnp.asarray(prefix, jnp.int32), out_size=out_size
+    )
     fragment, mst, cfa, cfb, stats2 = _levels_loop(
         fragment, mst, cfa, cfb, crank, chunk_levels=max_levels
     )
 
     lv = stats0[0] + stats1[0] + stats2[0]
     return fragment, mst, jnp.stack(
-        [lv, filter_count, stats1[1], stats2[1]]
+        [lv, prefix_count, stats1[1], filter_count, stats2[1]]
     )
 
 
 def solve_rank_filtered_speculative(
-    vmin0, ra, rb, *, prefix_mult: int = 2, out_size: int | None = None
+    vmin0,
+    ra,
+    rb,
+    *,
+    prefix_mult: int = 2,
+    prefix_out: int | None = None,
+    out_size: int | None = None,
 ) -> Tuple[jax.Array, jax.Array, int] | None:
     """Single-round-trip filtered solve; ``None`` on misprediction (caller
-    falls back to :func:`solve_rank_filtered`). The survivor width defaults
-    to ``m/8`` — comfortably above every measured RMAT/ER survivor ratio
-    (the filter kills ~97-99% of the suffix)."""
+    falls back to :func:`solve_rank_filtered`). Default speculative widths:
+    ``prefix/8`` for prefix survivors (measured 5.3% alive after the head)
+    and ``m/128`` for filter survivors (measured 0.21% of the suffix)."""
     n_pad = vmin0.shape[0]
     m_pad = ra.shape[0]
     prefix = _prefix_size(n_pad, m_pad, prefix_mult)
     if 2 * prefix > m_pad:
         return None
+    if prefix_out is None:
+        prefix_out = max(_bucket_size(prefix // 8), _COMPACT_MIN_SLOTS)
     if out_size is None:
-        out_size = max(_bucket_size(m_pad // 8), _COMPACT_MIN_SLOTS)
+        out_size = max(_bucket_size(m_pad // 128), _COMPACT_MIN_SLOTS)
     max_levels = _max_levels(n_pad)
     fragment, mst, stats = _filtered_speculative_program(
-        vmin0, ra, rb, prefix=prefix, out_size=out_size, max_levels=max_levels
+        vmin0, ra, rb,
+        prefix=prefix, prefix_out=prefix_out, out_size=out_size,
+        max_levels=max_levels,
     )
-    lv, filter_count, prefix_alive, survivor_alive = (
+    lv, prefix_count, prefix_alive, filter_count, survivor_alive = (
         int(x) for x in jax.device_get(stats)
     )
-    if filter_count <= out_size and prefix_alive == 0 and survivor_alive == 0:
+    if (
+        prefix_count <= prefix_out
+        and filter_count <= out_size
+        and prefix_alive == 0
+        and survivor_alive == 0
+    ):
         return mst, fragment, lv
     return None
 
@@ -930,10 +953,14 @@ def solve_rank_auto(vmin0, ra, rb, *, family: str = "dense"):
     grid; 1 loses to dispatch overhead at 14.1 s)."""
     n_pad = vmin0.shape[0]
     if use_filtered_path(family, ra.shape[0]):
-        # Measured at RMAT-20: the staged filtered path with adaptive
-        # (one-shot at small width) chunking beats the fully-fused
-        # speculative program (1.86 s), whose uncompacted level loops cost
-        # more than the round trips they save at this width.
+        if n_pad < _CENSUS_MIN_SPACE:
+            # Small-dense: one dispatch with compacted inner loops beats the
+            # staged sequence (RMAT-20: 1.31 s vs 1.41 s staged, same
+            # session). Falls back to the exact staged path on any width
+            # misprediction.
+            result = solve_rank_filtered_speculative(vmin0, ra, rb)
+            if result is not None:
+                return result
         return solve_rank_filtered(vmin0, ra, rb)
     if family == "dense" and n_pad < _CENSUS_MIN_SPACE:
         # Below the census threshold the finish is one chunk and the fetch
