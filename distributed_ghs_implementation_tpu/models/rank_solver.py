@@ -775,15 +775,19 @@ def _filter_suffix_chunked(fragment, ra, rb, prefix: int):
 
 
 def _prefix_size(n_pad: int, m_pad: int, mult: int = 2) -> int:
-    """The filter split point: lightest ``mult * n_pad`` ranks, bucketed
-    (``mult=2`` measured best at RMAT-20: 1.456/1.461/1.573 s for 1/2/4).
-    Shared by the single-chip and sharded filtered entries so their
-    prefixes — and the parity between them — stay identical."""
+    """The filter split point: lightest ``mult * n_pad`` ranks, bucketed.
+    Measured: the staged filtered path prefers ``mult=1`` (RMAT-24 12.53 s
+    vs 13.44 s; a wash at 20/22/25 — the smaller prefix halves the head's
+    relabel/segment_min width and the extra survivors are cheap); the
+    speculative path keeps ``mult=2``, whose acceptance margins were
+    measured there (1.456/1.461/1.573 s for mult 1/2/4 at RMAT-20). The
+    sharded entry uses the staged default (``mult=1``) — its prefix solve
+    is replicated, so the smaller prefix helps it at least as much."""
     return _bucket_size(min(mult * n_pad, m_pad))
 
 
 def solve_rank_filtered(
-    vmin0, ra, rb, *, chunk_levels: int = 3, prefix_mult: int = 2, on_chunk=None
+    vmin0, ra, rb, *, chunk_levels: int = 3, prefix_mult: int = 1, on_chunk=None
 ) -> Tuple[jax.Array, jax.Array, int]:
     """Filter-Kruskal solve: prefix Borůvka, one-pass suffix filter, survivor
     finish. Same contract and bit-identical results as

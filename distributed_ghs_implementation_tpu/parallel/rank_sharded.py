@@ -310,7 +310,7 @@ def solve_graph_rank_sharded(
     ra = _stage(ra_np, blk)
     rb = _stage(rb_np, blk)
 
-    prefix = _prefix_size(n_pad, m_pad)
+    prefix = _prefix_size(n_pad, m_pad, mult=1)  # tuned staged default
     if filtered is None:
         filtered = (
             use_filtered_path(_pick_family(graph), m_pad) and 2 * prefix <= m_pad
