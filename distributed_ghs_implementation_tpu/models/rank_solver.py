@@ -776,13 +776,16 @@ def _filter_suffix_chunked(fragment, ra, rb, prefix: int):
 
 def _prefix_size(n_pad: int, m_pad: int, mult: int = 2) -> int:
     """The filter split point: lightest ``mult * n_pad`` ranks, bucketed.
-    Measured: the staged filtered path prefers ``mult=1`` (RMAT-24 12.53 s
-    vs 13.44 s; a wash at 20/22/25 — the smaller prefix halves the head's
-    relabel/segment_min width and the extra survivors are cheap); the
-    speculative path keeps ``mult=2``, whose acceptance margins were
-    measured there (1.456/1.461/1.573 s for mult 1/2/4 at RMAT-20). The
-    sharded entry uses the staged default (``mult=1``) — its prefix solve
-    is replicated, so the smaller prefix helps it at least as much."""
+    Measured policy (selected by ``solve_rank_filtered``'s auto-default):
+    ``mult=1`` wherever the single-pass filter fits (RMAT-24 12.53 s vs
+    13.44 s; a wash at 20/22/25 — the smaller prefix halves the head's
+    relabel/segment_min width and the extra survivors are cheap), but
+    ``mult=2`` in the chunked-filter capacity regime (RMAT-26 class) and
+    on the speculative path — the configurations those results were
+    measured under (mult=1 at RMAT-26 hung in compilation and ships
+    nowhere unmeasured). The sharded entry follows the mult=1 staged
+    choice — its prefix solve is replicated, so the smaller prefix helps
+    it at least as much."""
     return _bucket_size(min(mult * n_pad, m_pad))
 
 
