@@ -21,9 +21,11 @@ mesh's edge axis and keeps everything else replicated:
     tie-break — then the remaining levels run replicated with no further
     host round trips.
 
-Single-process only on the harvest side (the MST mask comes back
-rank-block-sharded, like the flat kernel); multi-process runs use the
-replicated-output ELL path in ``parallel/sharded.py``.
+Harvest is multi-process capable: the rank-block-sharded MST mask is
+bit-packed per shard and replicated by one tiled ``all_gather`` (m/8 bytes
+over ICI/DCN), so every process reads the full mask from its own addressable
+devices — the reference's rank-0 result gather
+(``/root/reference/ghs_implementation_mpi.py:760-779``) done as a collective.
 """
 
 from __future__ import annotations
@@ -51,7 +53,6 @@ from distributed_ghs_implementation_tpu.models.rank_solver import (
     _pick_family,
     _prefix_level2_core,
     _prefix_size,
-    fetch_mst_edge_ids,
     use_filtered_path,
 )
 from distributed_ghs_implementation_tpu.ops.segment_ops import INT32_MAX
@@ -248,6 +249,23 @@ def make_rank_filter_relabel(mesh: Mesh, prefix: int):
 
 
 @functools.lru_cache(maxsize=32)
+def make_mask_harvest(mesh: Mesh):
+    """Pack each shard's MST mask to bits, then replicate the packed bytes
+    with one tiled ``all_gather``. Shard widths are multiples of 8 (the
+    staging pad guarantees it), so the concatenated per-shard bytes equal a
+    global ``packbits`` of the full mask. The replicated result is fully
+    addressable on every process — the multi-process harvest path."""
+
+    def pack_gather(mst):
+        return jax.lax.all_gather(jnp.packbits(mst), EDGE_AXIS, tiled=True)
+
+    mapped = shard_map_compat(
+        pack_gather, mesh, in_specs=(P(EDGE_AXIS),), out_specs=P()
+    )
+    return jax.jit(mapped)
+
+
+@functools.lru_cache(maxsize=32)
 def make_rank_sharded_head(mesh: Mesh):
     mapped = shard_map_compat(
         _rank_sharded_head,
@@ -287,18 +305,16 @@ def solve_graph_rank_sharded(
     """
     if mesh is None:
         mesh = edge_mesh()
-    if jax.process_count() > 1:
-        raise ValueError(
-            "rank-sharded harvest is single-process; use strategy='ell' for "
-            "multi-process runs"
-        )
     n_dev = int(mesh.devices.size)
     n = graph.num_nodes
     if n == 0 or graph.num_edges == 0:
         return np.zeros(0, dtype=np.int64), np.arange(n, dtype=np.int32), 0
 
     n_pad = _bucket_size(n)
-    m_pad = int(math.ceil(_bucket_size(graph.num_edges) / n_dev) * n_dev)
+    # Shard widths must divide by 8 so the bit-packed harvest's per-shard
+    # byte blocks concatenate into a global packbits (pad slots are inert).
+    unit = 8 * n_dev
+    m_pad = int(math.ceil(_bucket_size(graph.num_edges) / unit) * unit)
     int32_max = np.iinfo(np.int32).max
     vmin0 = np.full(n_pad, int32_max, dtype=np.int32)
     vmin0[:n] = graph.first_ranks
@@ -341,4 +357,10 @@ def solve_graph_rank_sharded(
         finish = make_rank_sharded_finish(mesh, fs_local, _max_levels(n_pad))
         fragment, mst, extra = finish(fragment, mst, fa, fb)
         lv += int(extra)
-    return fetch_mst_edge_ids(graph, mst), np.asarray(fragment)[:n], lv
+    # One packed all-gather makes the rank-block-sharded mask addressable on
+    # every process (single-process included — one code path, and the packed
+    # fetch is the same 8x tunnel saving as fetch_mst_edge_ids).
+    packed = np.asarray(make_mask_harvest(mesh)(mst))
+    mask = np.unpackbits(packed, count=m_pad).astype(bool)
+    edge_ids = np.sort(graph.edge_id_of_rank(np.nonzero(mask)[0]))
+    return edge_ids, np.asarray(fragment)[:n], lv

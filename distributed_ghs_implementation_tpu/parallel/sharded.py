@@ -191,8 +191,8 @@ def solve_graph_sharded(
     ``strategy``: ``"rank"`` = rank-space solver (the fast path — sharded
     head + all-gathered compact finish, ``parallel/rank_sharded.py``);
     ``"flat"`` = edge-sharded flat kernel; ``"ell"`` = vertex-sharded ELL
-    kernel; ``"auto"`` = rank at scale (single-process), ELL for
-    multi-process runs, flat below the scale threshold.
+    kernel; ``"auto"`` = rank at scale (any process count), below the scale
+    threshold flat (single-process) or ELL (multi-process).
     """
     from distributed_ghs_implementation_tpu.models.boruvka import (
         ELL_AUTO_EDGE_THRESHOLD,
@@ -203,16 +203,19 @@ def solve_graph_sharded(
             f"unknown strategy {strategy!r}; expected auto|rank|flat|ell"
         )
     if jax.process_count() > 1:
-        # Flat and rank outputs are slot-sharded (partially non-addressable
-        # per process); the ELL solver's outputs are replicated, so every
-        # process can harvest the MST locally.
-        if strategy in ("flat", "rank"):
+        # The flat kernel's slot-sharded output is partially non-addressable
+        # per process; rank (packed all-gather harvest) and ELL (replicated
+        # outputs) both harvest everywhere. Auto keeps the fast path on pods.
+        if strategy == "flat":
             raise ValueError(
-                f"strategy={strategy!r} is single-process only (slot-sharded "
-                "outputs are not harvestable across processes); use 'ell' or "
-                "'auto'"
+                "strategy='flat' is single-process only (slot-sharded "
+                "outputs are not harvestable across processes); use 'rank', "
+                "'ell' or 'auto'"
             )
-        strategy = "ell"
+        if strategy == "auto":
+            strategy = (
+                "rank" if graph.num_edges >= ELL_AUTO_EDGE_THRESHOLD else "ell"
+            )
     if strategy == "auto":
         strategy = "rank" if graph.num_edges >= ELL_AUTO_EDGE_THRESHOLD else "flat"
     if strategy == "rank":

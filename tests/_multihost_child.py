@@ -47,6 +47,16 @@ def main() -> int:
     mesh = edge_mesh()  # spans all 4 devices across both processes
     edge_ids, fragment, levels = solve_graph_sharded(g, mesh=mesh, strategy="ell")
     weight = int(g.w[edge_ids].sum())
+
+    # The rank-space fast path, multi-process: packed all-gather harvest.
+    # Both the plain head and the filter-Kruskal split must produce the
+    # byte-identical MST on every process.
+    from distributed_ghs_implementation_tpu.parallel.rank_sharded import (
+        solve_graph_rank_sharded,
+    )
+
+    rank_ids, _, _ = solve_graph_sharded(g, mesh=mesh, strategy="rank")
+    filt_ids, _, _ = solve_graph_rank_sharded(g, mesh=mesh, filtered=True)
     record = {
         "process_id": int(process_id),
         "process_count": jax.process_count(),
@@ -57,6 +67,8 @@ def main() -> int:
         "mst_edges": len(edge_ids),
         "levels": int(levels),
         "expected_weight": float(networkx_mst_weight(g)),
+        "rank_edge_ids": [int(x) for x in rank_ids],
+        "filtered_edge_ids": [int(x) for x in filt_ids],
     }
     with open(os.path.join(outdir, f"proc{process_id}.json"), "w") as f:
         json.dump(record, f)
