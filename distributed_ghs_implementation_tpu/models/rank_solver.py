@@ -997,6 +997,14 @@ def solve_rank_auto(vmin0, ra, rb, *, family: str = "dense"):
 _PACKBITS_CHUNK = 1 << 27
 
 
+def packed_to_edge_ids(graph: Graph, packed: np.ndarray, count: int) -> np.ndarray:
+    """Host decode of a bit-packed rank mask (big-endian bit order, numpy's
+    and jnp's shared default) -> sorted edge ids. Shared by the single-chip
+    fetch and the sharded multi-process harvest."""
+    mask = np.unpackbits(packed, count=count).astype(bool)
+    return np.sort(graph.edge_id_of_rank(np.nonzero(mask)[0]))
+
+
 def fetch_mst_edge_ids(graph: Graph, mst) -> np.ndarray:
     """Device mask -> sorted edge ids, fetched bit-packed (8x less tunnel
     traffic: a 16.8M-node road grid's 42 MB bool mask is ~1.4 s of transfer
@@ -1015,8 +1023,7 @@ def fetch_mst_edge_ids(graph: Graph, mst) -> np.ndarray:
         packed = np.concatenate(parts)
     else:
         packed = np.asarray(jnp.packbits(mst))
-    mask = np.unpackbits(packed, count=w).astype(bool)
-    return np.sort(graph.edge_id_of_rank(np.nonzero(mask)[0]))
+    return packed_to_edge_ids(graph, packed, w)
 
 
 def solve_graph_rank(graph: Graph) -> Tuple[np.ndarray, np.ndarray, int]:

@@ -52,7 +52,10 @@ from distributed_ghs_implementation_tpu.models.rank_solver import (
     _moe_over,
     _pick_family,
     _prefix_level2_core,
+    _PACKBITS_CHUNK,
     _prefix_size,
+    fetch_mst_edge_ids,
+    packed_to_edge_ids,
     use_filtered_path,
 )
 from distributed_ghs_implementation_tpu.ops.segment_ops import INT32_MAX
@@ -257,7 +260,19 @@ def make_mask_harvest(mesh: Mesh):
     addressable on every process — the multi-process harvest path."""
 
     def pack_gather(mst):
-        return jax.lax.all_gather(jnp.packbits(mst), EDGE_AXIS, tiled=True)
+        w = mst.shape[0]
+        if w > _PACKBITS_CHUNK:
+            # A single full-width packbits fails to compile at 2^30 width
+            # (rank_solver._PACKBITS_CHUNK's rationale); slice it. Widths
+            # above the threshold are multiples of 8*n_dev, so every slice
+            # stays byte-aligned.
+            packed = jnp.concatenate([
+                jnp.packbits(mst[s : min(s + _PACKBITS_CHUNK, w)])
+                for s in range(0, w, _PACKBITS_CHUNK)
+            ])
+        else:
+            packed = jnp.packbits(mst)
+        return jax.lax.all_gather(packed, EDGE_AXIS, tiled=True)
 
     mapped = shard_map_compat(
         pack_gather, mesh, in_specs=(P(EDGE_AXIS),), out_specs=P()
@@ -357,10 +372,13 @@ def solve_graph_rank_sharded(
         finish = make_rank_sharded_finish(mesh, fs_local, _max_levels(n_pad))
         fragment, mst, extra = finish(fragment, mst, fa, fb)
         lv += int(extra)
-    # One packed all-gather makes the rank-block-sharded mask addressable on
-    # every process (single-process included — one code path, and the packed
-    # fetch is the same 8x tunnel saving as fetch_mst_edge_ids).
-    packed = np.asarray(make_mask_harvest(mesh)(mst))
-    mask = np.unpackbits(packed, count=m_pad).astype(bool)
-    edge_ids = np.sort(graph.edge_id_of_rank(np.nonzero(mask)[0]))
+    if jax.process_count() > 1:
+        # One packed all-gather makes the rank-block-sharded mask
+        # addressable on every process.
+        packed = np.asarray(make_mask_harvest(mesh)(mst))
+        edge_ids = packed_to_edge_ids(graph, packed, m_pad)
+    else:
+        # Single process: every shard is addressable; the measured chunked
+        # fetch (one dispatch per packbits slice) skips the all-gather.
+        edge_ids = fetch_mst_edge_ids(graph, mst)
     return edge_ids, np.asarray(fragment)[:n], lv
