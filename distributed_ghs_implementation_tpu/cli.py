@@ -86,25 +86,31 @@ def _cmd_run(args) -> int:
 
     g = _load_graph(args)
     if args.checkpoint:
-        if args.backend != "device":
-            raise SystemExit("--checkpoint requires --backend device")
+        if args.backend not in ("device", "sharded"):
+            raise SystemExit("--checkpoint requires --backend device or sharded")
         import numpy as np
 
         from distributed_ghs_implementation_tpu.api import MSTResult
         from distributed_ghs_implementation_tpu.utils.checkpoint import (
             solve_graph_checkpointed,
+            solve_graph_checkpointed_sharded,
         )
 
         t0 = time.perf_counter()
-        edge_ids, fragment, levels = solve_graph_checkpointed(
-            g, args.checkpoint, every=args.checkpoint_every
-        )
+        if args.backend == "sharded":
+            edge_ids, fragment, levels = solve_graph_checkpointed_sharded(
+                g, args.checkpoint, every=args.checkpoint_every
+            )
+        else:
+            edge_ids, fragment, levels = solve_graph_checkpointed(
+                g, args.checkpoint, every=args.checkpoint_every
+            )
         result = MSTResult(
             graph=g,
             edge_ids=edge_ids,
             num_levels=levels,
             wall_time_s=time.perf_counter() - t0,
-            backend="device/checkpointed",
+            backend=f"{args.backend}/checkpointed",
             num_components=int(np.unique(fragment).size),
         )
     else:

@@ -326,6 +326,31 @@ def _replay_stages(fragment, stages):
     return jnp.where(live1, res[jnp.where(live1, newid1[fragment], 0)], fragment)
 
 
+# The rank-space int32 envelope. Every device index — rank ids (the
+# tie-break total order), vertex ids, compact slot ids — is int32, and
+# INT32_MAX itself is the "no edge" sentinel, so padded sizes must stay
+# strictly below 2^31. Measured ceiling: RMAT-26 (2^30 padded ranks,
+# ~8.6 GB of resident ra/rb on a 16 GB chip, solved in 93.8 s); one more
+# scale step leaves the envelope everywhere at once (docs/SCALING.md).
+_INT32_RANK_LIMIT = 1 << 31
+
+
+def check_rank_envelope(n_pad: int, m_pad: int) -> None:
+    """Fail fast — at staging, with the ceiling in the message — instead of
+    somewhere deep in the level loop with an overflow-corrupted index.
+    Sharding does not lift this: global rank ids stay int32 on every shard;
+    past-2^31 ranks would need an int64 rank space (unsupported)."""
+    if m_pad >= _INT32_RANK_LIMIT or n_pad >= _INT32_RANK_LIMIT:
+        raise ValueError(
+            f"graph exceeds the int32 rank envelope: padded sizes "
+            f"(nodes {n_pad:,}, ranks {m_pad:,}) must stay below 2^31 = "
+            f"{_INT32_RANK_LIMIT:,}. The measured ceiling is RMAT-26 "
+            f"(~1.05B edges, 2^30 padded ranks); beyond it rank ids no "
+            f"longer index as int32 and the resident rank endpoints alone "
+            f"(8 bytes/rank) exceed a 16 GB chip."
+        )
+
+
 def prepare_rank_arrays(graph: Graph):
     """Host->device staging: ``(vmin0, ra, rb)`` jnp arrays, padded to
     quarter-step bucket sizes (``_bucket_size``).
@@ -345,6 +370,7 @@ def prepare_rank_arrays(graph: Graph):
         return cached
     n_pad = _bucket_size(graph.num_nodes)
     m_pad = _bucket_size(graph.num_edges)
+    check_rank_envelope(n_pad, m_pad)
     vmin0 = np.full(n_pad, np.iinfo(np.int32).max, dtype=np.int32)
     vmin0[: graph.num_nodes] = graph.first_ranks
     ra, rb = graph.rank_endpoints(pad_to=m_pad)
@@ -418,6 +444,75 @@ def _relabel_slots(fragment, ra, rb):
     return fa, fb, jnp.sum((fa != fb).astype(jnp.int32))
 
 
+def _restore_state_host(initial_state, n_pad: int, m_pad: int):
+    """Checkpoint state -> host arrays ``(fragment, mask, lv)`` at the
+    current padded sizes. Tolerates a checkpoint written under different
+    padding (bucket retune, or another backend's pad unit): pad vertices
+    never hook (sentinel ``vmin0``) and pad ranks are never marked, so a
+    too-long stored tail is identity/False and truncation is exact; a
+    too-short one is re-extended with the identity. Shared by the
+    single-chip and sharded resume paths."""
+    fragment = np.asarray(initial_state[0], dtype=np.int32)
+    if fragment.shape[0] < n_pad:
+        fragment = np.concatenate(
+            [fragment, np.arange(fragment.shape[0], n_pad, dtype=np.int32)]
+        )
+    elif fragment.shape[0] > n_pad:
+        fragment = fragment[:n_pad]
+    mask = np.asarray(initial_state[1], dtype=bool)
+    if mask.shape[0] != m_pad:
+        fixed = np.zeros(m_pad, dtype=bool)
+        fixed[: min(mask.shape[0], m_pad)] = mask[:m_pad]
+        mask = fixed
+    return fragment, mask, int(initial_state[2])
+
+
+def _restore_state(initial_state, n_pad: int, m_pad: int):
+    """Device-array form of :func:`_restore_state_host`."""
+    fragment, mask, lv = _restore_state_host(initial_state, n_pad, m_pad)
+    return jnp.asarray(fragment), jnp.asarray(mask), lv
+
+
+def solve_rank_resume(
+    vmin0, ra, rb, initial_state, *, family: str = "dense", on_chunk=None
+) -> Tuple[jax.Array, jax.Array, int]:
+    """Resume a rank-space solve from checkpoint state (exact from any saved
+    partition — the remaining work is Borůvka from that partition).
+
+    Below the chunked-filter capacity regime this is
+    :func:`solve_rank_staged`'s ``initial_state`` path (one full-width
+    endpoint rebuild). At widths where suffix-size ``fa/fb`` cannot sit next
+    to the resident rank arrays (the regime the chunked filter exists for —
+    RMAT-26's ra/rb alone are ~8.6 GB on a 16 GB chip), a full-width
+    ``_relabel_slots`` would RESOURCE_EXHAUSTED exactly where checkpointing
+    matters most; instead the alive slots are rebuilt in rank-ordered chunks
+    against the restored partition (reusing the chunked filter machinery — a
+    slot is alive iff its endpoints' fragments differ) and the compacted
+    survivors feed straight into the finish loop.
+    """
+    params = _family_params(family)
+    n_pad = vmin0.shape[0]
+    m_pad = ra.shape[0]
+    if 8 * m_pad <= _FILTER_CHUNK_BYTES:
+        return solve_rank_staged(
+            vmin0, ra, rb, **params,
+            initial_state=initial_state, on_chunk=on_chunk,
+        )
+    fragment, mst, lv = _restore_state(initial_state, n_pad, m_pad)
+    cfa, cfb, crank, count = _filter_suffix_chunked(fragment, ra, rb, 0)
+    if count == 0:
+        return mst, fragment, lv
+    compact_space = params["compact_space"]
+    if compact_space is None:
+        compact_space = n_pad >= _CENSUS_MIN_SPACE
+    return _finish_to_fixpoint(
+        fragment, mst, cfa, cfb, crank,
+        lv=lv, count=count, space=n_pad, max_levels=lv + _max_levels(n_pad),
+        chunk_levels=params["chunk_levels"], compact_space=compact_space,
+        on_chunk=on_chunk,
+    )
+
+
 def solve_rank_speculative(
     vmin0, ra, rb, *, out_size: int
 ) -> Tuple[jax.Array, jax.Array, int] | None:
@@ -482,19 +577,9 @@ def solve_rank_staged(
     """
     n_pad = vmin0.shape[0]
     if initial_state is not None:
-        fragment = jnp.asarray(np.asarray(initial_state[0], dtype=np.int32))
-        if fragment.shape[0] != n_pad:  # stored unpadded; restore padding
-            fragment = jnp.concatenate(
-                [fragment, jnp.arange(fragment.shape[0], n_pad, dtype=jnp.int32)]
-            )
-        mst_np = np.asarray(initial_state[1], dtype=bool)
-        if mst_np.shape[0] != ra.shape[0]:  # padding width changed
-            fixed = np.zeros(ra.shape[0], dtype=bool)
-            fixed[: min(mst_np.shape[0], ra.shape[0])] = mst_np[: ra.shape[0]]
-            mst_np = fixed
-        mst = jnp.asarray(mst_np)
+        fragment, mst, lv = _restore_state(initial_state, n_pad, ra.shape[0])
         fa, fb, count_d = _relabel_slots(fragment, ra, rb)
-        lv, count = int(initial_state[2]), int(jax.device_get(count_d))
+        count = int(jax.device_get(count_d))
     else:
         fragment, mst, fa, fb, stats = _rank_head(
             vmin0, ra, rb, compact_after=compact_after
@@ -808,13 +893,19 @@ def solve_rank_filtered(
     """
     n_pad = vmin0.shape[0]
     m_pad = ra.shape[0]
+    force_chunked = False
     if prefix_mult is None:
         # mult=1 measured best where everything fits (RMAT-24 13.44 ->
         # 12.53 s; wash at 20/22/25). In the chunked-filter capacity
         # regime (RMAT-26 class) keep mult=2 — the configuration the
-        # billion-edge result was measured and verified under.
+        # billion-edge result was measured and verified under. The chunk
+        # decision below derives from the SAME test: choosing mult=2 here
+        # forces the chunked filter even if the (larger) mult=2 prefix
+        # pulls the remaining suffix back under the byte threshold — the
+        # borderline single-pass/mult=2 combination ships nowhere.
         suffix1 = m_pad - _prefix_size(n_pad, m_pad, 1)
-        prefix_mult = 2 if 8 * suffix1 > _FILTER_CHUNK_BYTES else 1
+        force_chunked = 8 * suffix1 > _FILTER_CHUNK_BYTES
+        prefix_mult = 2 if force_chunked else 1
     prefix = _prefix_size(n_pad, m_pad, prefix_mult)
     if 2 * prefix > m_pad:
         # Not enough suffix to pay for the split — plain staged solve.
@@ -834,7 +925,7 @@ def solve_rank_filtered(
         on_chunk=on_chunk,
     )
 
-    if 8 * (m_pad - prefix) > _FILTER_CHUNK_BYTES:
+    if force_chunked or 8 * (m_pad - prefix) > _FILTER_CHUNK_BYTES:
         # RMAT-25+ widths: chunk the filter so its intermediates never
         # exceed two chunk-width arrays (the single-pass form's suffix-width
         # fa/fb are the HBM-capacity knee at ~0.5B ranks).

@@ -57,3 +57,42 @@ def is_primary() -> bool:
     import jax
 
     return jax.process_index() == 0
+
+
+def broadcast_resume_state(state):
+    """Primary's checkpoint state -> every process (``None`` stays ``None``).
+
+    Checkpoint saves are primary-only (the rank-0 artifact rule), so on a
+    non-shared filesystem only the primary can see the file. A per-process
+    ``os.path.exists`` decision would diverge the SPMD program — mismatched
+    collectives hang the pod — so the primary's view is authoritative:
+    broadcast a presence flag + shapes, then the arrays. Single-process
+    runs return ``state`` unchanged.
+    """
+    import jax
+
+    if jax.process_count() == 1:
+        return state
+
+    import numpy as np
+    from jax.experimental import multihost_utils as mu
+
+    if jax.process_index() == 0 and state is not None:
+        frag = np.asarray(state[0], dtype=np.int32)
+        mask = np.asarray(state[1], dtype=bool)
+        meta = np.asarray(
+            [1, frag.shape[0], mask.shape[0], int(state[2])], dtype=np.int64
+        )
+    else:
+        frag = np.zeros(0, dtype=np.int32)
+        mask = np.zeros(0, dtype=bool)
+        meta = np.zeros(4, dtype=np.int64)
+    meta = np.asarray(mu.broadcast_one_to_all(meta))
+    if meta[0] == 0:
+        return None
+    if jax.process_index() != 0:
+        frag = np.zeros(int(meta[1]), dtype=np.int32)
+        mask = np.zeros(int(meta[2]), dtype=bool)
+    frag = np.asarray(mu.broadcast_one_to_all(frag))
+    mask = np.asarray(mu.broadcast_one_to_all(mask))
+    return frag, mask, int(meta[3])

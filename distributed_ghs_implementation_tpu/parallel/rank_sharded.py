@@ -54,6 +54,8 @@ from distributed_ghs_implementation_tpu.models.rank_solver import (
     _prefix_level2_core,
     _PACKBITS_CHUNK,
     _prefix_size,
+    _restore_state_host,
+    check_rank_envelope,
     fetch_mst_edge_ids,
     packed_to_edge_ids,
     use_filtered_path,
@@ -186,6 +188,19 @@ def _rank_sharded_l1(vmin0, ra, rb):
     return fragment, mst
 
 
+def _rank_resume_relabel(fragment, ra, rb):
+    """Per-shard body for checkpoint resume: rebuild the local rank block's
+    endpoints from a restored vertex partition (exact from any saved
+    partition — the remaining work is Borůvka from there). Two local
+    gathers, no collectives beyond the survivor stats."""
+    fa = fragment[ra]
+    fb = fragment[rb]
+    local_alive = jnp.sum((fa != fb).astype(jnp.int32))
+    total = jax.lax.psum(local_alive, EDGE_AXIS)
+    cmax = jax.lax.pmax(local_alive, EDGE_AXIS)
+    return fa, fb, jnp.stack([total, cmax])
+
+
 @jax.jit
 def _prefix_level2(fragment, ra_p, rb_p):
     """Replicated level 2 over the prefix block (the level-1 partition is the
@@ -281,6 +296,29 @@ def make_mask_harvest(mesh: Mesh):
 
 
 @functools.lru_cache(maxsize=32)
+def make_rank_resume_relabel(mesh: Mesh):
+    mapped = shard_map_compat(
+        _rank_resume_relabel,
+        mesh,
+        in_specs=(P(), P(EDGE_AXIS), P(EDGE_AXIS)),
+        out_specs=(P(EDGE_AXIS), P(EDGE_AXIS), P()),
+    )
+    return jax.jit(mapped)
+
+
+def _full_mask_host(mesh, mst, m_pad: int, mst_p=None, prefix: int = 0):
+    """Materialize the full-width rank mask on the host (checkpoint saves):
+    harvest the block-sharded mask bit-packed, then overlay the replicated
+    prefix-phase marks. Every process gets the full mask (the harvest is an
+    all-gather), so checkpoint writes can be gated on the primary alone."""
+    packed = np.asarray(make_mask_harvest(mesh)(mst))
+    mask = np.unpackbits(packed, count=m_pad).astype(bool)
+    if mst_p is not None:
+        mask[:prefix] |= np.asarray(mst_p)[:prefix]
+    return mask
+
+
+@functools.lru_cache(maxsize=32)
 def make_rank_sharded_head(mesh: Mesh):
     mapped = shard_map_compat(
         _rank_sharded_head,
@@ -306,7 +344,12 @@ def make_rank_sharded_finish(mesh: Mesh, fs_local: int, max_levels: int):
 
 
 def solve_graph_rank_sharded(
-    graph: Graph, *, mesh: Mesh | None = None, filtered: bool | None = None
+    graph: Graph,
+    *,
+    mesh: Mesh | None = None,
+    filtered: bool | None = None,
+    on_chunk=None,
+    initial_state: tuple | None = None,
 ) -> Tuple[np.ndarray, np.ndarray, int]:
     """Host entry mirroring ``solve_graph_rank`` on a device mesh.
 
@@ -317,6 +360,19 @@ def solve_graph_rank_sharded(
     policy, except that a graph without enough suffix beyond the prefix
     (``2 * prefix > m_pad``) always takes the plain path — the split would
     be degenerate there.
+
+    ``on_chunk(level, vertex_fragment, mask_fn, count)`` fires after the
+    head, each prefix-phase chunk, the filter, and the finish. Unlike the
+    single-chip contract, the third argument is a ZERO-ARG CALLABLE that
+    materializes the full-width mask on the host when invoked — the
+    materialization is a collective (packed all-gather) plus a sizeable
+    host transfer, so receivers skip it on chunks they don't save; because
+    it is a collective, the decision to invoke it must be identical on
+    every process (derive it from the chunk counter, not from local
+    state). ``initial_state`` is ``(fragment, mask, level)`` from
+    a checkpoint — exact from any saved partition: the local rank blocks are
+    relabeled against the restored partition (two local gathers per shard)
+    and the survivors run through the normal compact/all-gather finish.
     """
     if mesh is None:
         mesh = edge_mesh()
@@ -330,6 +386,7 @@ def solve_graph_rank_sharded(
     # byte blocks concatenate into a global packbits (pad slots are inert).
     unit = 8 * n_dev
     m_pad = int(math.ceil(_bucket_size(graph.num_edges) / unit) * unit)
+    check_rank_envelope(n_pad, m_pad)
     int32_max = np.iinfo(np.int32).max
     vmin0 = np.full(n_pad, int32_max, dtype=np.int32)
     vmin0[:n] = graph.first_ranks
@@ -346,7 +403,13 @@ def solve_graph_rank_sharded(
         filtered = (
             use_filtered_path(_pick_family(graph), m_pad) and 2 * prefix <= m_pad
         )
-    if filtered and 2 * prefix <= m_pad:
+    if initial_state is not None:
+        frag_np, mask_np, lv = _restore_state_host(initial_state, n_pad, m_pad)
+        fragment = _stage(frag_np, rep)
+        mst = _stage(mask_np, blk)
+        fa, fb, stats = make_rank_resume_relabel(mesh)(fragment, ra, rb)
+        total, cmax = (int(x) for x in jax.device_get(stats))
+    elif filtered and 2 * prefix <= m_pad:
         slice_rep = make_prefix_slice(mesh, prefix)
         ra_p = slice_rep(ra)
         rb_p = slice_rep(rb)
@@ -355,10 +418,26 @@ def solve_graph_rank_sharded(
         fragment, mst_p, fa_p, fb_p, stats = _prefix_level2(fragment, ra_p, rb_p)
         lv2, count = (int(x) for x in jax.device_get(stats))
         lv = 1 + lv2
+        hook = None
+        if on_chunk is not None:
+            def hook(lv_, frag_, mstp_, count_):
+                # The sharded mask carries the level-1 marks; the prefix
+                # phase's replicated marks overlay it. Lazy: the harvest is
+                # a collective + host transfer, paid only if the receiver
+                # decides to save (its decision must be identical on every
+                # process — see the docstring).
+                on_chunk(
+                    lv_, frag_,
+                    lambda: _full_mask_host(mesh, mst, m_pad, mstp_, prefix),
+                    count_,
+                )
+
+            hook(lv, fragment, mst_p, count)
         mst_p, fragment, lv = _finish_to_fixpoint(
             fragment, mst_p, fa_p, fb_p, jnp.arange(prefix, dtype=jnp.int32),
             lv=lv, count=count, space=n_pad, max_levels=lv + _max_levels(n_pad),
             chunk_levels=3, compact_space=n_pad >= _CENSUS_MIN_SPACE,
+            on_chunk=hook,
         )
         filt = make_rank_filter_relabel(mesh, prefix)
         mst, fa, fb, fstats = filt(fragment, mst_p, mst, ra, rb)
@@ -367,11 +446,21 @@ def solve_graph_rank_sharded(
         head = make_rank_sharded_head(mesh)
         fragment, mst, fa, fb, stats = head(vmin0, ra, rb)
         lv, total, cmax = (int(x) for x in jax.device_get(stats))
+    if on_chunk is not None and initial_state is None:
+        mst_now = mst
+        on_chunk(
+            lv, fragment, lambda: _full_mask_host(mesh, mst_now, m_pad), total
+        )
     if total > 0:
         fs_local = max(_bucket_size(cmax), 1024)
         finish = make_rank_sharded_finish(mesh, fs_local, _max_levels(n_pad))
         fragment, mst, extra = finish(fragment, mst, fa, fb)
         lv += int(extra)
+        if on_chunk is not None:
+            mst_fin = mst
+            on_chunk(
+                lv, fragment, lambda: _full_mask_host(mesh, mst_fin, m_pad), 0
+            )
     if jax.process_count() > 1:
         # One packed all-gather makes the rank-block-sharded mask
         # addressable on every process.

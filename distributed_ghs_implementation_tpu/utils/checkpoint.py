@@ -121,6 +121,7 @@ def solve_graph_checkpointed(
             _pick_family,
             prepare_rank_arrays,
             solve_rank_filtered,
+            solve_rank_resume,
             solve_rank_staged,
             use_filtered_path,
         )
@@ -138,10 +139,17 @@ def solve_graph_checkpointed(
                 )
 
         family = _pick_family(graph)
-        if initial_state is None and use_filtered_path(family, ra.shape[0]):
+        if initial_state is not None:
+            # Resume is exact from any saved partition; solve_rank_resume
+            # picks the chunked endpoint rebuild at widths where a
+            # full-width relabel would not fit (the capacity regime the
+            # chunked filter exists for).
+            mst_ranks, fragment, levels = solve_rank_resume(
+                vmin0, ra, rb, initial_state, family=family, on_chunk=on_chunk
+            )
+        elif use_filtered_path(family, ra.shape[0]):
             # Fresh dense solve: the filter-Kruskal path, same on_chunk
-            # contract. A resume continues through the staged path below —
-            # exact from any saved partition, just without the filter split.
+            # contract.
             mst_ranks, fragment, levels = solve_rank_filtered(
                 vmin0, ra, rb, on_chunk=on_chunk
             )
@@ -149,7 +157,6 @@ def solve_graph_checkpointed(
             mst_ranks, fragment, levels = solve_rank_staged(
                 vmin0, ra, rb,
                 **_family_params(family),
-                initial_state=initial_state,
                 on_chunk=on_chunk,
             )
     elif strategy == "stepped":
@@ -177,3 +184,62 @@ def solve_graph_checkpointed(
     ranks_chosen = np.nonzero(np.asarray(mst_ranks))[0]
     edge_ids = np.sort(graph.edge_id_of_rank(ranks_chosen))
     return edge_ids, np.asarray(fragment)[:n], levels
+
+
+def solve_graph_checkpointed_sharded(
+    graph: Graph,
+    checkpoint_path: str,
+    *,
+    mesh=None,
+    every: int = 1,
+    resume: bool = True,
+    filtered: bool | None = None,
+) -> Tuple[np.ndarray, np.ndarray, int]:
+    """Checkpointing solve on a device mesh (``parallel/rank_sharded.py``).
+
+    Same contract as :func:`solve_graph_checkpointed`. Saves fire at the
+    sharded solver's chunk boundaries; the full-width mask is materialized
+    (a collective harvest + host transfer) only on boundaries that will be
+    saved — the decision derives from the chunk counter, identical on every
+    process, so the collective stays SPMD — and only the primary writes
+    (the reference's rank-0 artifact rule,
+    ``ghs_implementation_mpi.py:929-954``). The resume decision and state
+    are broadcast from the primary, so a non-shared filesystem cannot
+    diverge the program. Resume is exact from any saved partition and works
+    across backends — a checkpoint written by the single-chip solver
+    restores into the sharded solve and vice versa (both save the vertex
+    partition + the full-width rank mask). The solver's last chunk hook
+    (``count == 0``) persists the converged state, so no separate final
+    save is needed.
+    """
+    from distributed_ghs_implementation_tpu.parallel import multihost
+    from distributed_ghs_implementation_tpu.parallel.rank_sharded import (
+        solve_graph_rank_sharded,
+    )
+
+    n = graph.num_nodes
+    if n == 0 or graph.num_edges == 0:
+        return np.zeros(0, dtype=np.int64), np.arange(n, dtype=np.int32), 0
+
+    fp = graph_fingerprint(graph)
+    primary = multihost.is_primary()
+    initial_state = None
+    if resume and primary and os.path.exists(checkpoint_path):
+        initial_state = load_checkpoint(checkpoint_path, expect_fingerprint=fp)
+    initial_state = multihost.broadcast_resume_state(initial_state)
+
+    chunks_seen = [0]
+
+    def on_chunk(level, fragment, mask_fn, count):
+        chunks_seen[0] += 1
+        if chunks_seen[0] % every == 0 or count == 0:
+            full_mask = mask_fn()  # collective: every process participates
+            if primary:
+                save_checkpoint(
+                    checkpoint_path, fragment, full_mask, level, fingerprint=fp
+                )
+
+    return solve_graph_rank_sharded(
+        graph, mesh=mesh, filtered=filtered,
+        on_chunk=on_chunk, initial_state=initial_state,
+    )

@@ -57,6 +57,20 @@ def main() -> int:
 
     rank_ids, _, _ = solve_graph_sharded(g, mesh=mesh, strategy="rank")
     filt_ids, _, _ = solve_graph_rank_sharded(g, mesh=mesh, filtered=True)
+
+    # Checkpointed sharded solve with PER-PROCESS checkpoint dirs (the
+    # non-shared-filesystem shape): only the primary writes; the resume
+    # decision + state must come from the primary via broadcast, not from
+    # local os.path.exists — a divergent decision would hang the pod.
+    from distributed_ghs_implementation_tpu.utils.checkpoint import (
+        solve_graph_checkpointed_sharded,
+    )
+
+    ckdir = os.path.join(outdir, f"ck{process_id}")
+    os.makedirs(ckdir, exist_ok=True)
+    ck = os.path.join(ckdir, "shard.npz")
+    ck_ids, _, _ = solve_graph_checkpointed_sharded(g, ck, mesh=mesh, filtered=True)
+    ck_ids2, _, _ = solve_graph_checkpointed_sharded(g, ck, mesh=mesh, filtered=True)
     record = {
         "process_id": int(process_id),
         "process_count": jax.process_count(),
@@ -69,6 +83,9 @@ def main() -> int:
         "expected_weight": float(networkx_mst_weight(g)),
         "rank_edge_ids": [int(x) for x in rank_ids],
         "filtered_edge_ids": [int(x) for x in filt_ids],
+        "ckpt_edge_ids": [int(x) for x in ck_ids],
+        "ckpt_resume_edge_ids": [int(x) for x in ck_ids2],
+        "ckpt_file_exists": os.path.exists(ck),
     }
     with open(os.path.join(outdir, f"proc{process_id}.json"), "w") as f:
         json.dump(record, f)

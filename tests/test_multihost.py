@@ -84,3 +84,9 @@ def test_two_process_distributed_solve(tmp_path):
     for r in records:
         assert r["rank_edge_ids"] == expected
         assert r["filtered_edge_ids"] == expected
+        # Checkpointed sharded solve + broadcast-agreed resume.
+        assert r["ckpt_edge_ids"] == expected
+        assert r["ckpt_resume_edge_ids"] == expected
+    # Primary-only artifact rule: exactly process 0 wrote its checkpoint.
+    by_id = sorted(records, key=lambda r: r["process_id"])
+    assert [r["ckpt_file_exists"] for r in by_id] == [True, False]

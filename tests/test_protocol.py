@@ -56,6 +56,21 @@ def test_reference_20_node_config():
         assert verify_result(r).ok
 
 
+def test_reference_50_node_extrapolation_config():
+    """The 50-node extrapolation of the reference's :793 config (same
+    edge probability and seed). The reference's thread backend hit its 30 s
+    timeout there and returned a wrong forest (52 edges, weight 89 vs the
+    oracle's 82 — SURVEY.md §6); the protocol tier must return the exact
+    MST, every run, with no timeout heuristics in the loop."""
+    g = reference_random_graph(50, 0.3, 500)
+    rd = minimum_spanning_forest(g, backend="device")
+    for _ in range(3):
+        r = minimum_spanning_forest(g, backend="protocol")
+        assert verify_result(r).ok
+        assert np.array_equal(r.edge_ids, rd.edge_ids)
+        assert r.num_edges == 49  # a spanning tree, not a truncated forest
+
+
 def test_determinism_exact_message_counts():
     g = erdos_renyi_graph(30, 0.2, seed=5)
     _, t1 = run_protocol(g)

@@ -165,3 +165,29 @@ def test_rank_sharded_high_diameter_scale():
     ids, frag, lv = solve_graph_rank_sharded(g)
     assert abs(float(g.w[ids].sum()) - scipy_mst_weight(g)) < 1e-6
     assert lv >= 8  # genuinely multi-level
+
+
+def test_int32_rank_envelope_guard():
+    """A graph whose padded rank space leaves the int32 envelope fails at
+    staging with the measured ceiling in the message, not deep in the level
+    loop (VERDICT r3 weak #6)."""
+    from distributed_ghs_implementation_tpu.models.rank_solver import (
+        check_rank_envelope,
+        prepare_rank_arrays,
+    )
+
+    check_rank_envelope(1 << 27, 1 << 30)  # RMAT-26 class: inside
+    with pytest.raises(ValueError, match="int32 rank envelope"):
+        check_rank_envelope(1 << 27, 1 << 31)
+    with pytest.raises(ValueError, match="int32 rank envelope"):
+        check_rank_envelope(1 << 31, 1 << 30)
+
+    class ScaleTooBig:
+        """Duck-typed stand-in: 2^31-edge arrays are not allocatable here;
+        the guard must fire before any allocation happens."""
+
+        num_nodes = 1 << 28
+        num_edges = (1 << 31) - 100
+
+    with pytest.raises(ValueError, match="2\\^31"):
+        prepare_rank_arrays(ScaleTooBig())
