@@ -191,3 +191,31 @@ def test_int32_rank_envelope_guard():
 
     with pytest.raises(ValueError, match="2\\^31"):
         prepare_rank_arrays(ScaleTooBig())
+
+
+@pytest.mark.slow
+def test_rank_sharded_filtered_realistic_width():
+    """The sharded filter-Kruskal path at RMAT-19 width (VERDICT r3 item 2):
+    ~7.7M edges, 1M-slot shards on the 8-device mesh — the auto policy
+    engages the filter for real (m_pad >= _FILTER_MIN_RANKS), and per-shard
+    compaction / fs_local sizing / the packed harvest all run at a width
+    where overflow bugs would show. Byte-identical to the single-device
+    solve and oracle-verified."""
+    from distributed_ghs_implementation_tpu.models.rank_solver import (
+        _pick_family,
+        use_filtered_path,
+    )
+    from distributed_ghs_implementation_tpu.parallel.rank_sharded import (
+        solve_graph_rank_sharded,
+    )
+
+    from distributed_ghs_implementation_tpu.models.boruvka import _bucket_size
+
+    g = rmat_graph(19, 16, seed=24)
+    m_pad = _bucket_size(g.num_edges)  # the entry's policy tests padded width
+    assert use_filtered_path(_pick_family(g), m_pad)  # auto = filtered
+    ids, frag, lv = solve_graph_rank_sharded(g)
+    ids_d, frag_d, _ = solve_graph(g, strategy="rank")
+    assert np.array_equal(ids, ids_d)
+    assert np.array_equal(frag, frag_d)
+    assert abs(float(g.w[ids].sum()) - scipy_mst_weight(g)) < 1e-6
