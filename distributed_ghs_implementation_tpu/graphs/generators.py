@@ -256,6 +256,77 @@ def road_grid_graph(
     return Graph.from_arrays(int(rows * cols), u, v, w)
 
 
+def random_road_network(
+    rows: int,
+    cols: int,
+    *,
+    seed: int = 0,
+    hole_prob: float = 0.08,
+    axis_prob: float = 0.53,
+    diag_prob: float = 0.12,
+    weight_scale: int = 1000,
+) -> Graph:
+    """Random planar-ish road network — the NON-grid stand-in for BASELINE
+    config 5 (USA-road; the real DIMACS file is not obtainable offline, the
+    reader in ``graphs/io.py`` is tested and ready for it).
+
+    Construction: one jittered intersection point per cell of a
+    ``rows x cols`` lattice, with ``hole_prob`` of the cells removed
+    (holes force detours and kill the grid's translational regularity);
+    independent Bernoulli links to the 4 axis and 4 diagonal neighbors;
+    integer weights derived from Euclidean length (like road distances —
+    NOT the grid generator's i.i.d. uniform draws, so weight and topology
+    correlate the way they do on real roads). Unlike ``road_grid_graph``
+    the degree distribution is irregular — dead ends, chains, junctions,
+    degrees 0..8 — with incident average
+    ``(4*axis_prob + 4*diag_prob) * (1 - hole_prob)`` ~= 2.4 at the
+    defaults, matching USA-road's ~2.4 (58.3M directed arcs / 23.9M
+    nodes); isolated cells come out as singleton components (the solver
+    returns the spanning forest, as for any real disconnected road graph).
+    """
+    rng = np.random.default_rng(seed)
+    # float32 draws throughout: every full-lattice temporary is 91 MB at the
+    # 23.9M-cell USA-road size instead of float64's 191 MB.
+    alive = rng.random((rows, cols), dtype=np.float32) >= hole_prob
+    jx = rng.random((rows, cols), dtype=np.float32)
+    jy = rng.random((rows, cols), dtype=np.float32)
+    xs = np.arange(cols, dtype=np.float32)[None, :] + jx
+    ys = np.arange(rows, dtype=np.float32)[:, None] + jy
+    del jx, jy
+    newid = np.cumsum(alive.ravel()).reshape(alive.shape).astype(np.int64) - 1
+    n = int(alive.sum())
+
+    us, vs, ws = [], [], []
+    offsets = [
+        (0, 1, axis_prob), (1, 0, axis_prob),
+        (1, 1, diag_prob), (1, -1, diag_prob),
+    ]
+    for dr, dc, p in offsets:
+        r0, r1 = (0, rows - dr), (dr, rows)
+        if dc >= 0:
+            c0, c1 = (0, cols - dc), (dc, cols)
+        else:
+            c0, c1 = (-dc, cols), (0, cols + dc)
+        a_sl = (slice(r0[0], r0[1]), slice(c0[0], c0[1]))
+        b_sl = (slice(r1[0], r1[1]), slice(c1[0], c1[1]))
+        keep = (
+            alive[a_sl] & alive[b_sl]
+            & (rng.random(alive[a_sl].shape, dtype=np.float32) < p)
+        )
+        dx = xs[a_sl][keep] - xs[b_sl][keep]
+        dy = ys[a_sl][keep] - ys[b_sl][keep]
+        d = np.hypot(dx, dy)
+        del dx, dy
+        us.append(newid[a_sl][keep])
+        vs.append(newid[b_sl][keep])
+        ws.append(np.maximum(1, np.round(d * weight_scale)).astype(np.int64))
+        del d, keep
+    u = np.concatenate(us)
+    v = np.concatenate(vs)
+    w = np.concatenate(ws)
+    return Graph.from_arrays(n, u, v, w)
+
+
 def line_graph(num_nodes: int, *, weight: int = 1) -> Graph:
     """Path 0-1-...-(n-1): the high-diameter worst case for level count."""
     n = int(num_nodes)
