@@ -179,7 +179,9 @@ def _compact_slots(fa, fb, rank_of_slot, out_size: int):
     return cfa, cfb, crank, valid
 
 
-@functools.partial(jax.jit, static_argnames=("out_size", "chunk_levels"))
+@functools.partial(
+    jax.jit, static_argnames=("out_size", "chunk_levels"), donate_argnums=(1,)
+)
 def _finish_chunk(
     fragment, mst, fa, fb, rank_of_slot, *, out_size: int, chunk_levels: int = 3
 ):
@@ -192,6 +194,12 @@ def _finish_chunk(
     width every remaining level. Order-preserving compaction keeps the local
     slot index a valid tie-break total order; ``rank_of_slot`` carries the
     original rank through the chain for MST marking.
+
+    ``mst`` is DONATED (as in ``_shrink_and_run``/``_run_levels``): the
+    functional ``.at[].max`` update would otherwise copy the full-width
+    mask every chunk (~268 MB at RMAT-24, measured in the r4 bisection);
+    callers must treat the passed buffer as consumed and rebind from the
+    return, as ``_finish_to_fixpoint`` does.
 
     Returns ``(fragment, mst, cfa, cfb, crank, stats)`` with ``stats =
     [levels_run, alive_count]``.
@@ -240,7 +248,9 @@ def _compact_and_mark(fa, fb, rank_of_slot, *, n: int, out_size: int):
     return cfa_o, cfb_o, crank, mark, newid, stats
 
 
-@functools.partial(jax.jit, static_argnames=("f_size", "chunk_levels"))
+@functools.partial(
+    jax.jit, static_argnames=("f_size", "chunk_levels"), donate_argnums=(3,)
+)
 def _shrink_and_run(
     mark, newid, rep_prev, mst, cfa_o, cfb_o, crank, *, f_size: int, chunk_levels: int
 ):
@@ -291,7 +301,9 @@ def _levels_loop(fragment, mst, cfa, cfb, crank, *, chunk_levels: int):
     return fragment, mst, cfa, cfb, jnp.stack([k, count])
 
 
-@functools.partial(jax.jit, static_argnames=("chunk_levels",))
+@functools.partial(
+    jax.jit, static_argnames=("chunk_levels",), donate_argnums=(1,)
+)
 def _run_levels(fragment, mst, cfa, cfb, crank, *, chunk_levels: int):
     """Levels over already-compacted slots, no re-compaction; one dispatch."""
     return _levels_loop(fragment, mst, cfa, cfb, crank, chunk_levels=chunk_levels)
@@ -573,7 +585,10 @@ def solve_rank_staged(
     restored partition. ``on_chunk(level, vertex_fragment, mst, count)``
     fires after the head and each finish chunk with the *vertex-level*
     fragment (replayed through any shrink stages so far) — the checkpoint
-    hook.
+    hook. The hook MUST consume the arrays during the call (``np.asarray``
+    / ``device_get``, as the checkpoint writer does): the mask buffer is
+    DONATED to the next chunk dispatch, so a reference held past the hook
+    reads a deleted buffer on TPU (a loud RuntimeError, not corruption).
     """
     n_pad = vmin0.shape[0]
     if initial_state is not None:
@@ -782,6 +797,40 @@ def _filter_compact(fa, fb, prefix, *, out_size: int):
     return cfa, cfb, crank
 
 
+@functools.partial(jax.jit, static_argnames=("prefix", "out_size"))
+def _filter_suffix_fused(fragment, ra, rb, *, prefix: int, out_size: int):
+    """Filter + compaction in ONE dispatch, with no suffix-width endpoint
+    materialization (r4 bisection: the two-step form's ``fa/fb`` cost ~2 GB
+    of HBM write+read at RMAT-24 and a second dispatch + stats fetch).
+
+    The alive test consumes the relabel gathers directly (bool out), and
+    the survivors' endpoints are RE-gathered at the compacted width
+    (``out_size`` << suffix — survivors measure 0.21% of the suffix on
+    RMAT, so the speculative m/128 width carries >3x margin). Survivor
+    positions come from ``searchsorted`` over the alive cumsum — out_size
+    binary searches (~28 * out_size gather-elems) instead of
+    ``_compact_slots``'s suffix-wide position scatter, which at the
+    measured ~6-11 ns/elem scatter cost was the residual ~1.5 s of the
+    r4 bisection's filter+compact phase. Returns ``(cfa, cfb, crank,
+    count)``; ``count > out_size`` means the width overflowed and
+    survivors were dropped — the caller falls back to the exact two-step
+    filter. Bit-identical to it when accepted (searchsorted positions are
+    ascending, the same order-preserving compaction; same cycle rule)."""
+    alive = fragment[ra[prefix:]] != fragment[rb[prefix:]]
+    cum = jnp.cumsum(alive.astype(jnp.int32))  # inclusive count
+    count = cum[-1]
+    j = jnp.arange(out_size, dtype=jnp.int32)
+    # Position of the (j+1)-th survivor: first index with cum == j+1.
+    cpos = jnp.searchsorted(cum, j + 1, side="left").astype(jnp.int32)
+    valid = j < count
+    # Pad slots carry crank 0 with cfa == cfb == 0: inert, never marked
+    # (same contract as _compact_slots).
+    crank = jnp.where(valid, cpos + prefix, 0)
+    cfa = jnp.where(valid, fragment[ra[crank]], 0)
+    cfb = jnp.where(valid, fragment[rb[crank]], 0)
+    return cfa, cfb, crank, count
+
+
 @functools.partial(jax.jit, static_argnames=("width",))
 def _filter_chunk_ends(fragment, ra, rb, start, *, width: int):
     """One suffix chunk of the filter: relabel ranks ``[start, start+width)``
@@ -887,9 +936,10 @@ def solve_rank_filtered(
     ``on_chunk(level, vertex_fragment, mst, count)`` fires after the head
     and each finish chunk with the vertex-level fragment and the full-width
     rank mask — the same checkpoint contract as the staged path (``count``
-    is the alive count of the *current phase's* slots). Resume from a
-    checkpoint goes through :func:`solve_rank_staged`'s ``initial_state``,
-    which is exact from any saved partition.
+    is the alive count of the *current phase's* slots), including the
+    consume-during-the-call rule (the mask buffer is donated to the next
+    chunk dispatch; see :func:`solve_rank_staged`). Resume goes through
+    :func:`solve_rank_resume`, exact from any saved partition.
     """
     n_pad = vmin0.shape[0]
     m_pad = ra.shape[0]
@@ -931,10 +981,20 @@ def solve_rank_filtered(
         # fa/fb are the HBM-capacity knee at ~0.5B ranks).
         cfa, cfb, crank, count = _filter_suffix_chunked(fragment, ra, rb, prefix)
     else:
-        fa_s, fb_s, count_d = _filter_suffix_ends(fragment, ra, rb, prefix=prefix)
+        # Fused filter+compact at a speculative width: one dispatch, no
+        # suffix-width endpoint arrays (r4 bisection: 6.3 s -> the alive
+        # pass alone). Overflow (count > out_size) falls back to the exact
+        # two-step filter sized from the true count.
+        out_size = max(_bucket_size(m_pad // 128), _COMPACT_MIN_SLOTS)
+        cfa, cfb, crank, count_d = _filter_suffix_fused(
+            fragment, ra, rb, prefix=prefix, out_size=out_size
+        )
         count = int(jax.device_get(count_d))
-        cfa = cfb = crank = None
-        if count > 0:
+        if count > out_size:
+            fa_s, fb_s, count_d = _filter_suffix_ends(
+                fragment, ra, rb, prefix=prefix
+            )
+            count = int(jax.device_get(count_d))
             out_size = max(_bucket_size(count), _COMPACT_MIN_SLOTS)
             cfa, cfb, crank = _filter_compact(
                 fa_s, fb_s, jnp.asarray(prefix, jnp.int32), out_size=out_size

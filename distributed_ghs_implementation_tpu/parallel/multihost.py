@@ -59,7 +59,7 @@ def is_primary() -> bool:
     return jax.process_index() == 0
 
 
-def broadcast_resume_state(state):
+def broadcast_resume_state(state, error: bool = False):
     """Primary's checkpoint state -> every process (``None`` stays ``None``).
 
     Checkpoint saves are primary-only (the rank-0 artifact rule), so on a
@@ -68,26 +68,42 @@ def broadcast_resume_state(state):
     collectives hang the pod — so the primary's view is authoritative:
     broadcast a presence flag + shapes, then the arrays. Single-process
     runs return ``state`` unchanged.
+
+    ``error=True`` (primary only, before re-raising a load failure)
+    broadcasts an abort flag instead: every other process raises too, so a
+    corrupt or mismatched checkpoint kills the whole pod cleanly rather
+    than leaving n-1 processes blocked in this collective forever.
     """
     import jax
 
     if jax.process_count() == 1:
-        return state
+        return None if error else state
 
     import numpy as np
     from jax.experimental import multihost_utils as mu
 
-    if jax.process_index() == 0 and state is not None:
-        frag = np.asarray(state[0], dtype=np.int32)
-        mask = np.asarray(state[1], dtype=bool)
-        meta = np.asarray(
-            [1, frag.shape[0], mask.shape[0], int(state[2])], dtype=np.int64
-        )
+    if jax.process_index() == 0 and (error or state is not None):
+        if error:
+            frag = np.zeros(0, dtype=np.int32)
+            mask = np.zeros(0, dtype=bool)
+            meta = np.asarray([2, 0, 0, 0], dtype=np.int64)
+        else:
+            frag = np.asarray(state[0], dtype=np.int32)
+            mask = np.asarray(state[1], dtype=bool)
+            meta = np.asarray(
+                [1, frag.shape[0], mask.shape[0], int(state[2])], dtype=np.int64
+            )
     else:
         frag = np.zeros(0, dtype=np.int32)
         mask = np.zeros(0, dtype=bool)
         meta = np.zeros(4, dtype=np.int64)
     meta = np.asarray(mu.broadcast_one_to_all(meta))
+    if meta[0] == 2:
+        if jax.process_index() == 0:
+            return None  # primary re-raises the original load error
+        raise RuntimeError(
+            "checkpoint load failed on the primary process; aborting"
+        )
     if meta[0] == 0:
         return None
     if jax.process_index() != 0:

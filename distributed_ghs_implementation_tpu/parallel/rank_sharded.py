@@ -201,6 +201,37 @@ def _rank_resume_relabel(fragment, ra, rb):
     return fa, fb, jnp.stack([total, cmax])
 
 
+def _rank_sharded_level(fragment, mst, fa, fb):
+    """Per-shard body: ONE Borůvka level over already-relabeled sharded
+    endpoints, in place (per-shard ``segment_min`` + one n-sized ``pmin``,
+    endpoints stay block-sharded — no survivor gather). Used when the alive
+    set is still too wide for the compact/all-gather finish: each level
+    at least halves the fragment count, so a few of these bring any state
+    under the gather budget. Returns updated state + ``[total, cmax,
+    progressed]``."""
+    n = fragment.shape[0]
+    mb = fa.shape[0]
+    k = jax.lax.axis_index(EDGE_AXIS).astype(jnp.int32)
+    ids = jnp.arange(n, dtype=jnp.int32)
+    gslot = k * mb + jnp.arange(mb, dtype=jnp.int32)
+    key = jnp.where(fa != fb, gslot, INT32_MAX)
+    moe = jax.lax.pmin(_moe_over(fa, fb, key, n), EDGE_AXIS)
+    has = moe < INT32_MAX
+    wa, mine, li = _owner_lookup(fa, moe, has, k, mb, EDGE_AXIS)
+    wb, _, _ = _owner_lookup(fb, moe, has, k, mb, EDGE_AXIS)
+    dst = jnp.where(has, jnp.where(wa == ids, wb, wa), ids)
+    fragment, parent = hook_and_compress(has, dst, fragment)
+    mst = mst.at[jnp.where(mine, li, mb)].max(mine, mode="drop")
+    fa = parent[fa]
+    fb = parent[fb]
+    local_alive = jnp.sum((fa != fb).astype(jnp.int32))
+    total = jax.lax.psum(local_alive, EDGE_AXIS)
+    cmax = jax.lax.pmax(local_alive, EDGE_AXIS)
+    return fragment, mst, fa, fb, jnp.stack(
+        [total, cmax, jnp.any(has).astype(jnp.int32)]
+    )
+
+
 @jax.jit
 def _prefix_level2(fragment, ra_p, rb_p):
     """Replicated level 2 over the prefix block (the level-1 partition is the
@@ -306,6 +337,25 @@ def make_rank_resume_relabel(mesh: Mesh):
     return jax.jit(mapped)
 
 
+@functools.lru_cache(maxsize=32)
+def make_rank_sharded_level(mesh: Mesh):
+    mapped = shard_map_compat(
+        _rank_sharded_level,
+        mesh,
+        in_specs=(P(), P(EDGE_AXIS), P(EDGE_AXIS), P(EDGE_AXIS)),
+        out_specs=(P(), P(EDGE_AXIS), P(EDGE_AXIS), P(EDGE_AXIS), P()),
+    )
+    return jax.jit(mapped)
+
+
+# The all-gather finish replicates three n_dev * fs_local int32 arrays per
+# chip; cap the gathered width at 2^25 slots (~400 MB total) and run
+# in-place sharded levels until the alive set fits. Reachable from a resume
+# off an early checkpoint (most ranks still alive) — the fresh paths arrive
+# here already small.
+_FINISH_GATHER_MAX_SLOTS = 1 << 25
+
+
 def _full_mask_host(mesh, mst, m_pad: int, mst_p=None, prefix: int = 0):
     """Materialize the full-width rank mask on the host (checkpoint saves):
     harvest the block-sharded mask bit-packed, then overlay the replicated
@@ -369,7 +419,11 @@ def solve_graph_rank_sharded(
     host transfer, so receivers skip it on chunks they don't save; because
     it is a collective, the decision to invoke it must be identical on
     every process (derive it from the chunk counter, not from local
-    state). ``initial_state`` is ``(fragment, mask, level)`` from
+    state). Both ``mask_fn`` and the fragment must be consumed during the
+    callback: prefix-phase ``mask_fn`` calls return one shared host array,
+    overlaid in place per save (marks are monotone, so the latest view is
+    always correct — but earlier snapshots are not preserved; copy if you
+    need history). ``initial_state`` is ``(fragment, mask, level)`` from
     a checkpoint — exact from any saved partition: the local rank blocks are
     relabeled against the restored partition (two local gathers per shard)
     and the survivors run through the normal compact/all-gather finish.
@@ -420,17 +474,24 @@ def solve_graph_rank_sharded(
         lv = 1 + lv2
         hook = None
         if on_chunk is not None:
+            # The sharded mask holds only the level-1 marks during the
+            # whole prefix phase — harvest it at most once (lazily; the
+            # harvest is a collective + host transfer) and overlay the
+            # prefix marks per save. Prefix marks are monotone, so the
+            # in-place overlay stays correct across saves. The receiver's
+            # decision to invoke mask_fn must be identical on every
+            # process (see the docstring).
+            l1_cache = []
+
             def hook(lv_, frag_, mstp_, count_):
-                # The sharded mask carries the level-1 marks; the prefix
-                # phase's replicated marks overlay it. Lazy: the harvest is
-                # a collective + host transfer, paid only if the receiver
-                # decides to save (its decision must be identical on every
-                # process — see the docstring).
-                on_chunk(
-                    lv_, frag_,
-                    lambda: _full_mask_host(mesh, mst, m_pad, mstp_, prefix),
-                    count_,
-                )
+                def mask_fn():
+                    if not l1_cache:
+                        l1_cache.append(_full_mask_host(mesh, mst, m_pad))
+                    full = l1_cache[0]
+                    full[:prefix] |= np.asarray(mstp_)[:prefix]
+                    return full
+
+                on_chunk(lv_, frag_, mask_fn, count_)
 
             hook(lv, fragment, mst_p, count)
         mst_p, fragment, lv = _finish_to_fixpoint(
@@ -451,6 +512,15 @@ def solve_graph_rank_sharded(
         on_chunk(
             lv, fragment, lambda: _full_mask_host(mesh, mst_now, m_pad), total
         )
+    # Capacity guard before the finish: shrink the alive set with in-place
+    # sharded levels while the would-be gathered width exceeds the budget.
+    while total > 0 and n_dev * _bucket_size(cmax) > _FINISH_GATHER_MAX_SLOTS:
+        level_fn = make_rank_sharded_level(mesh)
+        fragment, mst, fa, fb, lstats = level_fn(fragment, mst, fa, fb)
+        total, cmax, progressed = (int(x) for x in jax.device_get(lstats))
+        lv += 1
+        if not progressed:
+            break  # isolated remainder (disconnected pads); nothing to gather
     if total > 0:
         fs_local = max(_bucket_size(cmax), 1024)
         finish = make_rank_sharded_finish(mesh, fs_local, _max_levels(n_pad))

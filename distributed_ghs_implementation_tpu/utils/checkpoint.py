@@ -225,7 +225,15 @@ def solve_graph_checkpointed_sharded(
     primary = multihost.is_primary()
     initial_state = None
     if resume and primary and os.path.exists(checkpoint_path):
-        initial_state = load_checkpoint(checkpoint_path, expect_fingerprint=fp)
+        try:
+            initial_state = load_checkpoint(
+                checkpoint_path, expect_fingerprint=fp
+            )
+        except Exception:
+            # Tell every process to abort before re-raising: a primary-only
+            # failure would leave the others blocked in the broadcast.
+            multihost.broadcast_resume_state(None, error=True)
+            raise
     initial_state = multihost.broadcast_resume_state(initial_state)
 
     chunks_seen = [0]

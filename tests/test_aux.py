@@ -436,6 +436,50 @@ def test_checkpointed_sharded_solve_and_resume(tmp_path):
     assert np.array_equal(edge_ids2, ref_ids)
 
 
+def test_sharded_resume_capacity_guard(tmp_path, monkeypatch):
+    """Resume off an EARLY checkpoint (most ranks still alive) with the
+    gather budget pinned tiny: the in-place sharded levels must shrink the
+    alive set before the compact/all-gather finish (whose replicated width
+    would otherwise blow HBM at the scales checkpointing targets), and the
+    result stays byte-identical."""
+    from distributed_ghs_implementation_tpu.graphs.generators import rmat_graph
+    from distributed_ghs_implementation_tpu.parallel import rank_sharded as rsh
+    from distributed_ghs_implementation_tpu.utils.checkpoint import (
+        graph_fingerprint,
+        load_checkpoint,
+    )
+
+    g = rmat_graph(11, 16, seed=9)
+    ref_ids, _, _ = solve_graph(g, strategy="rank")
+    p = str(tmp_path / "early.npz")
+    fp = graph_fingerprint(g)
+
+    class Stop(Exception):
+        pass
+
+    def dying_hook(level, fragment, mask_fn, count):
+        # Save at the very first boundary — the most-alive state possible.
+        save_checkpoint(p, fragment, mask_fn(), level, fingerprint=fp)
+        raise Stop()
+
+    with pytest.raises(Stop):
+        rsh.solve_graph_rank_sharded(g, filtered=True, on_chunk=dying_hook)
+
+    used = []
+    orig = rsh.make_rank_sharded_level
+
+    def spying(mesh):
+        used.append(1)
+        return orig(mesh)
+
+    monkeypatch.setattr(rsh, "make_rank_sharded_level", spying)
+    monkeypatch.setattr(rsh, "_FINISH_GATHER_MAX_SLOTS", 64)
+    state = load_checkpoint(p, expect_fingerprint=fp)
+    edge_ids, _, _ = rsh.solve_graph_rank_sharded(g, initial_state=state)
+    assert used, "capacity guard path was not exercised"
+    assert np.array_equal(edge_ids, ref_ids)
+
+
 def test_instrumented_rank_strategy():
     from distributed_ghs_implementation_tpu.graphs.generators import road_grid_graph
 

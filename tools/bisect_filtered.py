@@ -1,0 +1,127 @@
+"""Per-phase bisection of the filtered (filter-Kruskal) solve (VERDICT r3
+item 4): where do RMAT-24's ~12.5 s actually go?
+
+The cost model says ~7-9 s (filter = 2 x 260M gathers ~ 4.7 s at the
+measured ~9 ns/elem, prefix solve ~1.5 s, plus compactions and ~0.11 s per
+dispatch); the residual has never been attributed. This tool wraps every
+jitted phase of ``solve_rank_filtered`` with a block-and-record timer (the
+host loop already syncs on a stats fetch per chunk, so blocking adds no
+real serialization) and prints a per-phase table plus the unattributed
+remainder (host work + dispatch round trips + fetches).
+
+Usage: python tools/bisect_filtered.py [scale] [expected_weight]
+"""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def log(msg):
+    print(msg, file=sys.stderr, flush=True)
+
+
+def main():
+    import jax
+
+    from distributed_ghs_implementation_tpu.graphs.generators import rmat_graph
+    from distributed_ghs_implementation_tpu.models import rank_solver as rs
+
+    scale = int(sys.argv[1]) if len(sys.argv) > 1 else 24
+    expect = int(sys.argv[2]) if len(sys.argv) > 2 else None
+    cache = f"/tmp/rmat{scale}_s24.npz"
+    t0 = time.perf_counter()
+    if os.path.exists(cache):
+        from distributed_ghs_implementation_tpu.graphs.io import read_npz
+
+        g = read_npz(cache)
+        log(f"loaded {cache} in {time.perf_counter()-t0:.1f}s")
+    else:
+        g = rmat_graph(scale, 16, seed=24)
+        log(f"gen RMAT-{scale}: {g.num_nodes:,} nodes {g.num_edges:,} edges "
+            f"in {time.perf_counter()-t0:.1f}s")
+        from distributed_ghs_implementation_tpu.graphs.io import write_npz
+
+        write_npz(g, cache)
+
+    t0 = time.perf_counter()
+    vmin0, ra, rb = rs.prepare_rank_arrays(g)
+    jax.block_until_ready((vmin0, ra, rb))
+    log(f"prep+staging {time.perf_counter()-t0:.1f}s (m_pad={ra.shape[0]:,})")
+
+    # Warm both code paths (compile + caches), and give the baseline number.
+    for i in range(2):
+        t0 = time.perf_counter()
+        mst, frag, lv = rs.solve_rank_filtered(vmin0, ra, rb)
+        jax.block_until_ready((mst, frag))
+        log(f"baseline solve {i}: {time.perf_counter()-t0:.2f}s levels={lv}")
+    baseline = time.perf_counter() - t0
+
+    # Instrument every jitted phase. The wrapper blocks on the outputs, so
+    # each record is true device time for that dispatch (the tunnel's
+    # dispatch overhead lands in the unattributed remainder).
+    record = []
+
+    def timed(name, fn):
+        def w(*a, **k):
+            t0 = time.perf_counter()
+            out = fn(*a, **k)
+            jax.block_until_ready(out)
+            record.append((name, time.perf_counter() - t0))
+            return out
+        return w
+
+    fetches = []
+    orig_get = rs.jax.device_get
+
+    names = [
+        "_filtered_head", "_compact_and_mark", "_shrink_and_run",
+        "_run_levels", "_finish_chunk", "_filter_suffix_ends",
+        "_filter_compact", "_filter_suffix_fused",
+    ]
+    saved = {n: getattr(rs, n) for n in names}
+    try:
+        for n in names:
+            setattr(rs, n, timed(n, saved[n]))
+        t0 = time.perf_counter()
+        mst, frag, lv = rs.solve_rank_filtered(vmin0, ra, rb)
+        jax.block_until_ready((mst, frag))
+        total = time.perf_counter() - t0
+    finally:
+        for n in names:
+            setattr(rs, n, saved[n])
+
+    by_phase = {}
+    for name, dt in record:
+        by_phase.setdefault(name, [0.0, 0])
+        by_phase[name][0] += dt
+        by_phase[name][1] += 1
+    log(f"\ninstrumented total: {total:.2f}s ({len(record)} timed dispatches)")
+    attributed = 0.0
+    for name, (dt, cnt) in sorted(by_phase.items(), key=lambda kv: -kv[1][0]):
+        log(f"  {name:22s} {dt:7.2f}s  x{cnt}")
+        attributed += dt
+    log(f"  {'(unattributed: host+RT)':22s} {total-attributed:7.2f}s")
+
+    ids = rs.fetch_mst_edge_ids(g, mst)
+    weight = int(g.w[ids].sum())
+    ok = expect is None or weight == expect
+    out = {
+        "tool": "bisect_filtered",
+        "scale": scale,
+        "baseline_solve_s": round(baseline, 2),
+        "instrumented_total_s": round(total, 2),
+        "phases": {k: [round(v[0], 2), v[1]] for k, v in by_phase.items()},
+        "unattributed_s": round(total - attributed, 2),
+        "weight": weight,
+        "verified": ok,
+    }
+    print(json.dumps(out), flush=True)
+    assert ok, (weight, expect)
+
+
+if __name__ == "__main__":
+    main()

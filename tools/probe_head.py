@@ -1,0 +1,129 @@
+"""Probe the filtered head's internals at RMAT-24 (r4 bisection follow-up).
+
+Questions, each answered by a direct on-chip timing:
+  1. How much of ``_filtered_head``'s ~4.6 s is the full-width MST mask
+     (zeros(m_pad) + two scatters + copy)? -> time a mask-free variant
+     that returns the n-sized L1 winners instead (the L1 marks are exactly
+     ``unique(vmin0)`` — no scatter needed).
+  2. Is the fused filter's ~6.2 s gather-bound? -> time the bare alive
+     pass (two gathers + count) alone.
+  3. Would sorting the gather indices help? -> time a 252M-element gather
+     into the 16.8M-entry table with ascending vs random indices.
+
+Usage: python tools/probe_head.py [scale]
+"""
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+
+def log(msg):
+    print(msg, file=sys.stderr, flush=True)
+
+
+def t3(fn, *args):
+    """Min-of-3 timing with a FORCED host round trip per call:
+    ``block_until_ready`` alone returns immediately on the axon tunnel
+    backend (observed: every phase measures 0.00 s), so fetch one element
+    of the last output leaf — that cannot complete before the whole output
+    buffer exists on device."""
+    import jax
+
+    best = None
+    out = None
+    for _ in range(3):
+        t0 = time.perf_counter()
+        out = fn(*args)
+        leaf = jax.tree_util.tree_leaves(out)[-1]
+        np.asarray(leaf if getattr(leaf, "ndim", 0) == 0 else leaf[:1])
+        dt = time.perf_counter() - t0
+        best = dt if best is None else min(best, dt)
+    return best, out
+
+
+def main():
+    import functools
+
+    import jax
+    import jax.numpy as jnp
+
+    from distributed_ghs_implementation_tpu.graphs.io import read_npz
+    from distributed_ghs_implementation_tpu.models import rank_solver as rs
+
+    scale = int(sys.argv[1]) if len(sys.argv) > 1 else 24
+    g = read_npz(f"/tmp/rmat{scale}_s24.npz")
+    vmin0, ra, rb = rs.prepare_rank_arrays(g)
+    jax.block_until_ready((vmin0, ra, rb))
+    n_pad = vmin0.shape[0]
+    m_pad = ra.shape[0]
+    prefix = rs._prefix_size(n_pad, m_pad, 1)
+    log(f"n_pad={n_pad:,} m_pad={m_pad:,} prefix={prefix:,}")
+
+    # 1a. The shipped head.
+    head = functools.partial(rs._filtered_head, prefix=prefix)
+    dt, (fragment, mst, fa, fb, stats) = t3(head, vmin0, ra, rb)
+    log(f"head (with full-width mask): {dt:.2f}s")
+
+    # 1b. Mask-free variant: identical work minus the m_pad-wide mask.
+    @functools.partial(jax.jit, static_argnames=("prefix",))
+    def head_nomask(vmin0, ra, rb, *, prefix):
+        fragment, parent1, has1, safe1 = rs._level1_hook(vmin0, ra, rb)
+        fa = parent1[ra[:prefix]]
+        fb = parent1[rb[:prefix]]
+        fragment, fa, fb, has2, safe2, count = rs._prefix_level2_core(
+            fragment, fa, fb
+        )
+        mst_p = jnp.zeros(prefix, dtype=bool).at[safe2].max(has2)
+        lv = jnp.asarray(1, jnp.int32) + jnp.any(has2).astype(jnp.int32)
+        return fragment, mst_p, fa, fb, jnp.stack([lv, count])
+
+    dt_nm, (fragment2, mst_p, fa2, fb2, stats2) = t3(
+        functools.partial(head_nomask, prefix=prefix), vmin0, ra, rb
+    )
+    log(f"head (mask-free, prefix-width marks): {dt_nm:.2f}s")
+
+    # 1c. L1 hook alone (the shared prologue).
+    l1 = jax.jit(rs._level1_hook)
+    dt_l1, _ = t3(l1, vmin0, ra, rb)
+    log(f"  level1_hook alone: {dt_l1:.2f}s")
+
+    # 2. Bare filter alive pass on the final prefix partition stand-in
+    # (use the head's fragment — same access pattern and table size).
+    @functools.partial(jax.jit, static_argnames=("prefix",))
+    def alive_only(fragment, ra, rb, *, prefix):
+        return jnp.sum(
+            (fragment[ra[prefix:]] != fragment[rb[prefix:]]).astype(jnp.int32)
+        )
+
+    dt_alive, _ = t3(
+        functools.partial(alive_only, prefix=prefix), fragment, ra, rb
+    )
+    log(f"filter alive pass alone (2 suffix gathers + count): {dt_alive:.2f}s")
+
+    # 3. Sorted vs random gather, suffix-sized indices into an n-sized table.
+    suffix = m_pad - prefix
+    table = fragment[:n_pad]
+    rng = np.random.default_rng(0)
+    idx_rand = jnp.asarray(
+        rng.integers(0, n_pad, size=suffix, dtype=np.int32)
+    )
+    idx_sort = jnp.sort(idx_rand)
+    jax.block_until_ready((idx_rand, idx_sort))
+
+    @jax.jit
+    def gsum(table, idx):
+        return jnp.sum(table[idx])
+
+    dt_r, _ = t3(gsum, table, idx_rand)
+    dt_s, _ = t3(gsum, table, idx_sort)
+    log(f"gather {suffix/1e6:.0f}M from {n_pad/1e6:.1f}M-entry table: "
+        f"random {dt_r:.2f}s vs sorted {dt_s:.2f}s")
+
+
+if __name__ == "__main__":
+    main()

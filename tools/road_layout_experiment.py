@@ -1,0 +1,178 @@
+"""Road-family memory-layout experiment (VERDICT r3 item 3).
+
+The road head (levels 1-2 at full width) sits at ~9 s of the 16.7 s
+USA-road-size grid solve, all in gathers/segment-min at the measured
+~9 ns/elem. The round-3 bisection (git fdc50ce) called that intrinsic for
+*this layout*; the untried lever was a locality-aware vertex relabeling at
+ingestion. This tool measures it directly: solve the same 23.9M-node grid
+under (a) the generator's row-major labels, (b) BFS/wavefront order
+(sort by i+j — the breadth order from a corner on a grid), and
+(c) Hilbert-curve order, with per-phase timers on every jitted kernel.
+
+The gather-table argument says labels should NOT matter: the index
+streams are rank-ordered (weight order — a random permutation of edges),
+so accesses into the n-sized parent/fragment tables are uniformly random
+whatever the vertex numbering; relabeling permutes table VALUES, not the
+randomness of the access sequence. A >=1.3x head win would falsify that;
+a flat result records the negative with numbers.
+
+Usage: python tools/road_layout_experiment.py [rows] [cols] [seed]
+"""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+
+def log(msg):
+    print(msg, file=sys.stderr, flush=True)
+
+
+def hilbert_order(rows: int, cols: int) -> np.ndarray:
+    """Permutation old-id -> new-id by Hilbert curve index over the grid."""
+    side = 1 << max(rows - 1, cols - 1, 1).bit_length()
+    r = np.repeat(np.arange(rows, dtype=np.int64), cols)
+    c = np.tile(np.arange(cols, dtype=np.int64), rows)
+    x, y = c.copy(), r.copy()
+    d = np.zeros(rows * cols, dtype=np.int64)
+    s = side >> 1
+    while s > 0:
+        rx = ((x & s) > 0).astype(np.int64)
+        ry = ((y & s) > 0).astype(np.int64)
+        d += s * s * ((3 * rx) ^ ry)
+        cond = ry == 0
+        flip = cond & (rx == 1)
+        xf = np.where(flip, s - 1 - x, x)
+        yf = np.where(flip, s - 1 - y, y)
+        x = np.where(cond, yf, xf)
+        y = np.where(cond, xf, yf)
+        s >>= 1
+    perm = np.argsort(d, kind="stable")
+    pi = np.empty(rows * cols, dtype=np.int64)
+    pi[perm] = np.arange(rows * cols, dtype=np.int64)
+    return pi
+
+
+def wavefront_order(rows: int, cols: int) -> np.ndarray:
+    """BFS-from-corner order on a grid == antidiagonal wavefronts."""
+    r = np.repeat(np.arange(rows, dtype=np.int64), cols)
+    c = np.tile(np.arange(cols, dtype=np.int64), rows)
+    perm = np.lexsort((r, r + c))  # by wavefront, then row within it
+    pi = np.empty(rows * cols, dtype=np.int64)
+    pi[perm] = np.arange(rows * cols, dtype=np.int64)
+    return pi
+
+
+def relabel(graph, pi):
+    from distributed_ghs_implementation_tpu.graphs.edgelist import Graph
+
+    return Graph.from_arrays(
+        graph.num_nodes, pi[graph.u], pi[graph.v], graph.w
+    )
+
+
+def solve_instrumented(g, label):
+    import jax
+
+    from distributed_ghs_implementation_tpu.models import rank_solver as rs
+
+    t0 = time.perf_counter()
+    vmin0, ra, rb = rs.prepare_rank_arrays(g)
+    jax.block_until_ready((vmin0, ra, rb))
+    prep = time.perf_counter() - t0
+
+    record = []
+
+    def timed(name, fn):
+        def w(*a, **k):
+            t0 = time.perf_counter()
+            out = fn(*a, **k)
+            jax.block_until_ready(out)
+            record.append((name, time.perf_counter() - t0))
+            return out
+        return w
+
+    names = ["_rank_head", "_compact_and_mark", "_shrink_and_run",
+             "_run_levels", "_finish_chunk"]
+    saved = {n: getattr(rs, n) for n in names}
+    best = None
+    lv = 0
+    try:
+        for n in names:
+            setattr(rs, n, timed(n, saved[n]))
+        for i in range(3):
+            record.clear()
+            t0 = time.perf_counter()
+            mst, frag, lv = rs.solve_rank_staged(
+                vmin0, ra, rb, **rs._family_params(rs._pick_family(g))
+            )
+            jax.block_until_ready((mst, frag))
+            dt = time.perf_counter() - t0
+            if best is None or dt < best[0]:
+                best = (dt, list(record))
+    finally:
+        for n in names:
+            setattr(rs, n, saved[n])
+
+    by = {}
+    for name, dt in best[1]:
+        by.setdefault(name, [0.0, 0])
+        by[name][0] += dt
+        by[name][1] += 1
+    log(f"[{label}] prep {prep:.1f}s best solve {best[0]:.2f}s levels={lv}")
+    for name, (dt, cnt) in sorted(by.items(), key=lambda kv: -kv[1][0]):
+        log(f"    {name:18s} {dt:6.2f}s x{cnt}")
+    ids = rs.fetch_mst_edge_ids(g, mst)
+    weight = int(g.w[ids].sum())
+    # Drop the staged-array cache so the next labeling doesn't pin HBM.
+    g.__dict__.pop("_rank_device_cache", None)
+    return {
+        "label": label, "prep_s": round(prep, 1),
+        "solve_best_s": round(best[0], 2), "levels": int(lv),
+        "phases": {k: [round(v[0], 2), v[1]] for k, v in by.items()},
+        "weight": weight,
+    }
+
+
+def main():
+    from distributed_ghs_implementation_tpu.graphs.generators import (
+        road_grid_graph,
+    )
+
+    rows = int(sys.argv[1]) if len(sys.argv) > 1 else 4864
+    cols = int(sys.argv[2]) if len(sys.argv) > 2 else 4912
+    seed = int(sys.argv[3]) if len(sys.argv) > 3 else 8
+    t0 = time.perf_counter()
+    g = road_grid_graph(rows, cols, seed=seed)
+    log(f"grid {rows}x{cols}: {g.num_nodes:,} nodes {g.num_edges:,} edges "
+        f"in {time.perf_counter()-t0:.1f}s")
+
+    results = [solve_instrumented(g, "row-major")]
+    t0 = time.perf_counter()
+    pi_w = wavefront_order(rows, cols)
+    log(f"wavefront order in {time.perf_counter()-t0:.1f}s")
+    results.append(solve_instrumented(relabel(g, pi_w), "bfs-wavefront"))
+    del pi_w
+    t0 = time.perf_counter()
+    pi_h = hilbert_order(rows, cols)
+    log(f"hilbert order in {time.perf_counter()-t0:.1f}s")
+    results.append(solve_instrumented(relabel(g, pi_h), "hilbert"))
+
+    weights = {r["weight"] for r in results}
+    out = {
+        "tool": "road_layout_experiment",
+        "grid": [rows, cols, seed],
+        "results": results,
+        "weights_agree": len(weights) == 1,
+    }
+    print(json.dumps(out), flush=True)
+    assert len(weights) == 1, weights
+
+
+if __name__ == "__main__":
+    main()
