@@ -378,3 +378,19 @@ class Graph:
         np.add.at(deg, self.u, 1)
         np.add.at(deg, self.v, 1)
         return deg
+
+
+def component_labels(num_nodes: int, u: np.ndarray, v: np.ndarray) -> np.ndarray:
+    """Component label per vertex of the (undirected) edge list — one
+    C-speed ``scipy.sparse.csgraph`` pass. Shared by the generators'
+    connectivity repair and the failure diagnostics (a Python union-find
+    here would crawl at bench-scale edge counts)."""
+    from scipy.sparse import coo_matrix
+    from scipy.sparse.csgraph import connected_components
+
+    adj = coo_matrix(
+        (np.ones(u.size, dtype=np.int8), (u, v)),
+        shape=(num_nodes, num_nodes),
+    )
+    _, labels = connected_components(adj, directed=False)
+    return labels.astype(np.int64)

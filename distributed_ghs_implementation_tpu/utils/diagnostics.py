@@ -22,16 +22,14 @@ _SAMPLE_CAP = 32
 
 
 def _mst_components(num_nodes: int, u: np.ndarray, v: np.ndarray) -> np.ndarray:
-    """Component label per vertex under the harvested MST edges (vectorized —
-    a failed RMAT-20 run must not spend minutes in a Python union-find)."""
-    from scipy.sparse import coo_matrix
-    from scipy.sparse.csgraph import connected_components
-
-    adj = coo_matrix(
-        (np.ones(u.size), (u, v)), shape=(num_nodes, num_nodes)
+    """Component label per vertex under the harvested MST edges (the shared
+    C-speed pass in ``graphs.edgelist.component_labels`` — a failed RMAT-20
+    run must not spend minutes in a Python union-find)."""
+    from distributed_ghs_implementation_tpu.graphs.edgelist import (
+        component_labels,
     )
-    _, labels = connected_components(adj, directed=False)
-    return labels.astype(np.int64)
+
+    return component_labels(num_nodes, u, v)
 
 
 def failure_report(result, verification=None, *, nodes: Optional[Dict] = None) -> dict:
