@@ -8,10 +8,14 @@ thread-backend experiment at 0.41 s (BASELINE.md) ≈ 68 edges/s. Its 20-node
 config is already wrong 2/3 of the time, so this is the fastest throughput the
 reference demonstrably sustains.
 
-Default config: RMAT scale-20 (1M vertices, ~15M undirected edges after
+Default config: RMAT scale-22 (4.2M vertices, ~64M undirected edges after
 dedup), solved on the real TPU chip, verified for weight parity against the
-SciPy MSF oracle. ``--scale`` adjusts size; ``--backend sharded`` exercises
-the mesh path.
+SciPy MSF oracle — the largest size whose full gen+verify cycle stays in
+single-digit minutes (scale 24's oracle alone is ~15 min; its measured
+numbers live in docs/BASELINE_RUNS.jsonl). Throughput rises with scale
+(the filter-Kruskal path amortizes fixed costs), so this is also a more
+faithful picture of the solver than scale 20 (~17.8M vs ~11.8M edges/s).
+``--scale`` adjusts size; ``--backend sharded`` exercises the mesh path.
 """
 
 from __future__ import annotations
@@ -26,7 +30,7 @@ BASELINE_EDGES_PER_SEC = 68.0  # reference: 28 edges / 0.41 s (BASELINE.md)
 
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(description=__doc__)
-    p.add_argument("--scale", type=int, default=20, help="RMAT scale (2^scale vertices)")
+    p.add_argument("--scale", type=int, default=22, help="RMAT scale (2^scale vertices)")
     p.add_argument("--edge-factor", type=int, default=16)
     p.add_argument("--repeats", type=int, default=3)
     p.add_argument("--backend", default="device", choices=["device", "sharded"])

@@ -262,7 +262,7 @@ def build_parser() -> argparse.ArgumentParser:
     e.set_defaults(fn=_cmd_experiments)
 
     b = sub.add_parser("bench", help="run the benchmark (see bench.py)")
-    b.add_argument("--scale", type=int, default=20)
+    b.add_argument("--scale", type=int, default=22)
     b.add_argument("--edge-factor", type=int, default=16)
     b.add_argument("--repeats", type=int, default=3)
     b.add_argument("--backend", default="device", choices=["device", "sharded"])
