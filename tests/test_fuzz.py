@@ -133,3 +133,62 @@ np.save(sys.argv[1], ids)
         )
         outs.append(np.load(out))
     assert np.array_equal(outs[0], outs[1])
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2])
+def test_random_road_network_fuzz(seed):
+    """Randomized road-network params (holes, link probs, shape): device and
+    sharded solves agree with the oracle and each other."""
+    from distributed_ghs_implementation_tpu.graphs.generators import (
+        random_road_network,
+    )
+
+    rng = np.random.default_rng(seed)
+    g = random_road_network(
+        int(rng.integers(20, 70)),
+        int(rng.integers(20, 70)),
+        seed=seed,
+        hole_prob=float(rng.uniform(0.0, 0.25)),
+        axis_prob=float(rng.uniform(0.3, 0.9)),
+        diag_prob=float(rng.uniform(0.0, 0.3)),
+    )
+    expect = scipy_mst_weight(g) if g.num_edges else 0.0
+    ids, _, _ = solve_graph(g, strategy="rank")
+    assert abs(float(g.w[ids].sum()) - expect) < 1e-6
+    ids_sh, _, _ = solve_graph_rank_sharded(g)
+    assert np.array_equal(ids, ids_sh)
+
+
+@pytest.mark.parametrize("stop_at", [1, 2, 3])
+def test_filtered_resume_from_every_boundary(stop_at, tmp_path):
+    """Interrupt the filtered solve at each successive chunk boundary and
+    resume: byte-identical MST from every save point (the resume contract
+    is 'exact from ANY saved partition', so test them all, not just one)."""
+    from distributed_ghs_implementation_tpu.graphs.generators import rmat_graph
+    from distributed_ghs_implementation_tpu.models import rank_solver as rs
+
+    g = rmat_graph(11, 16, seed=9)
+    ref_ids, _, _ = solve_graph(g, strategy="rank")
+    vmin0, ra, rb = rs.prepare_rank_arrays(g)
+
+    class Stop(Exception):
+        pass
+
+    state = {}
+
+    def hook(level, fragment, mst, count):
+        state["saved"] = (
+            np.asarray(fragment).copy(), np.asarray(mst).copy(), level
+        )
+        state["n"] = state.get("n", 0) + 1
+        if state["n"] == stop_at:
+            raise Stop()
+
+    try:
+        rs.solve_rank_filtered(vmin0, ra, rb, on_chunk=hook)
+    except Stop:
+        pass
+    mst_r, frag_r, _ = rs.solve_rank_resume(vmin0, ra, rb, state["saved"])
+    ranks = np.nonzero(np.asarray(mst_r))[0]
+    ids_r = np.sort(g.edge_id_of_rank(ranks))
+    assert np.array_equal(ids_r, ref_ids), f"resume from boundary {stop_at}"
