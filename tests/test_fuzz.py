@@ -160,7 +160,7 @@ def test_random_road_network_fuzz(seed):
 
 
 @pytest.mark.parametrize("stop_at", [1, 2, 3])
-def test_filtered_resume_from_every_boundary(stop_at, tmp_path):
+def test_filtered_resume_from_every_boundary(stop_at):
     """Interrupt the filtered solve at each successive chunk boundary and
     resume: byte-identical MST from every save point (the resume contract
     is 'exact from ANY saved partition', so test them all, not just one)."""
@@ -188,6 +188,10 @@ def test_filtered_resume_from_every_boundary(stop_at, tmp_path):
         rs.solve_rank_filtered(vmin0, ra, rb, on_chunk=hook)
     except Stop:
         pass
+    # The interrupt must have fired at the requested boundary — otherwise a
+    # solver retune that changes the boundary count would leave this test
+    # passing vacuously on the final state.
+    assert state["n"] == stop_at, f"only {state['n']} boundaries reached"
     mst_r, frag_r, _ = rs.solve_rank_resume(vmin0, ra, rb, state["saved"])
     ranks = np.nonzero(np.asarray(mst_r))[0]
     ids_r = np.sort(g.edge_id_of_rank(ranks))
