@@ -88,6 +88,48 @@ def _level1_hook(vmin0, ra, rb):
     return fragment, parent1, has1, safe1
 
 
+def host_level1(vmin0: np.ndarray, ra: np.ndarray, rb: np.ndarray) -> np.ndarray:
+    """Level-1 partition computed on the HOST during prep — the completion
+    of "level 1 costs nothing on device": the hook edges are already the
+    host-precomputed ``first_ranks``, so the hook-and-compress union-find
+    over them (the r4 bisection's 1.83 s of device pointer-chasing at
+    RMAT-24) is a ~1 s numpy pass off the solve clock instead.
+
+    Bit-exact replica of the device semantics (``_level1_hook`` ->
+    ``hook_and_compress``): same hook destinations, same mutual-pair break
+    (smaller id self-roots), pointer jumping to fixpoint — asserted
+    element-identical against the device in tests.
+    """
+    n = vmin0.shape[0]
+    ids = np.arange(n, dtype=np.int32)
+    has1 = vmin0 < INT32_MAX
+    safe1 = np.where(has1, vmin0, 0)
+    a = ra[safe1]
+    b = rb[safe1]
+    parent = np.where(has1, np.where(a == ids, b, a), ids).astype(np.int32)
+    mutual = parent[parent] == ids
+    parent = np.where(mutual & (ids < parent), ids, parent)
+    while True:
+        p2 = parent[parent]
+        if np.array_equal(p2, parent):
+            return parent
+        parent = p2
+
+
+@jax.jit
+def _device_level1(vmin0, ra, rb):
+    """On-device fallback for callers that stage raw arrays without the
+    host-computed level-1 parent (one extra dispatch vs the fused head)."""
+    _fragment, parent1, _has1, _safe1 = _level1_hook(vmin0, ra, rb)
+    return parent1
+
+
+def _ensure_parent1(vmin0, ra, rb, parent1):
+    if parent1 is None:
+        return _device_level1(vmin0, ra, rb)
+    return parent1
+
+
 def _prefix_level2_core(fragment, fa, fb):
     """Level 2 over already-relabeled prefix slots (traced helper shared by
     the single-chip and sharded filtered heads). Returns ``(fragment, fa,
@@ -117,8 +159,10 @@ def _level_core(fragment, fa, fb, key_of_slot, n):
 
 
 @functools.partial(jax.jit, static_argnames=("compact_after",))
-def _rank_head(vmin0, ra, rb, *, compact_after: int = 2):
-    """Levels 1(+2) at full width, one dispatch.
+def _rank_head(vmin0, ra, rb, parent1, *, compact_after: int = 2):
+    """Levels 1(+2) at full width, one dispatch. ``parent1`` is the level-1
+    partition (host-precomputed in prep, or ``_device_level1``) — the head
+    starts at the relabel, not the hook.
 
     Returns ``(fragment, mst, fa, fb, stats)`` with ``stats = [levels,
     alive_count]`` — the host reads stats in a single fetch and sizes the
@@ -128,7 +172,9 @@ def _rank_head(vmin0, ra, rb, *, compact_after: int = 2):
     mp = ra.shape[0]
     slot = jnp.arange(mp, dtype=jnp.int32)
 
-    fragment, parent1, has1, safe1 = _level1_hook(vmin0, ra, rb)
+    fragment = parent1
+    has1 = vmin0 < INT32_MAX
+    safe1 = jnp.where(has1, vmin0, 0)
     any1 = jnp.any(has1)
 
     # Relabel rank endpoints to level-1 fragments — 2 m-sized gathers, the
@@ -367,16 +413,28 @@ def prepare_rank_arrays(graph: Graph):
     """Host->device staging: ``(vmin0, ra, rb)`` jnp arrays, padded to
     quarter-step bucket sizes (``_bucket_size``).
 
-    Cheap by construction: one native counting sort for ranks plus one O(m)
-    native pass for ``first_ranks`` — no CSR, no ELL buckets (this path
-    exists to kill that ~14 s of host prep at RMAT-20).
+    Host cost: one native counting sort for ranks, one O(m) native pass for
+    ``first_ranks``, and the level-1 union-find (:func:`host_level1`,
+    ~1.5 s at RMAT-24) — no CSR, no ELL buckets. This 3-tuple form is the
+    raw-array compatibility surface; production entries use
+    :func:`prepare_rank_arrays_full`, which also returns the staged level-1
+    partition the host pass produced.
 
     The staged device arrays are cached on the graph (repeat solves skip the
     host->device upload — ~400 MB / ~15 s at 34M edges on a tunneled chip),
     capped at ``_STAGE_CACHE_MAX_RANKS`` so a sequence of huge solves can't
     pin HBM for the lifetime of every Graph a caller keeps a reference to
-    (an RMAT-24-scale cache entry would hold ~2 GB of device memory).
+    (an RMAT-24-scale cache entry would hold ~2 GB of device memory across
+    the three rank arrays plus the n-sized ``parent1``).
     """
+    return prepare_rank_arrays_full(graph)[:3]
+
+
+def prepare_rank_arrays_full(graph: Graph):
+    """:func:`prepare_rank_arrays` plus the host-computed level-1 partition:
+    ``(vmin0, ra, rb, parent1)`` staged. The production entries pass
+    ``parent1`` to the solvers so the head starts at the relabel (the
+    r4 L1 host-precompute; :func:`host_level1`)."""
     cached = graph.__dict__.get("_rank_device_cache")
     if cached is not None:
         return cached
@@ -386,7 +444,11 @@ def prepare_rank_arrays(graph: Graph):
     vmin0 = np.full(n_pad, np.iinfo(np.int32).max, dtype=np.int32)
     vmin0[: graph.num_nodes] = graph.first_ranks
     ra, rb = graph.rank_endpoints(pad_to=m_pad)
-    staged = (jnp.asarray(vmin0), jnp.asarray(ra), jnp.asarray(rb))
+    parent1 = host_level1(vmin0, ra, rb)
+    staged = (
+        jnp.asarray(vmin0), jnp.asarray(ra), jnp.asarray(rb),
+        jnp.asarray(parent1),
+    )
     if m_pad <= _STAGE_CACHE_MAX_RANKS:
         # Graph is a frozen dataclass; write the cache the way cached_property
         # does (directly into __dict__, bypassing the frozen __setattr__).
@@ -526,7 +588,7 @@ def solve_rank_resume(
 
 
 def solve_rank_speculative(
-    vmin0, ra, rb, *, out_size: int
+    vmin0, ra, rb, *, out_size: int, parent1=None
 ) -> Tuple[jax.Array, jax.Array, int] | None:
     """RMAT-shape fast path: head + one full finish chunk dispatched
     back-to-back with a *predicted* survivor width, then a single combined
@@ -540,7 +602,10 @@ def solve_rank_speculative(
     bit-identical to the staged path when accepted.
     """
     n_pad = vmin0.shape[0]
-    fragment, mst, fa, fb, stats = _rank_head(vmin0, ra, rb, compact_after=2)
+    parent1 = _ensure_parent1(vmin0, ra, rb, parent1)
+    fragment, mst, fa, fb, stats = _rank_head(
+        vmin0, ra, rb, parent1, compact_after=2
+    )
     rank_of_slot = jnp.arange(ra.shape[0], dtype=jnp.int32)
     fragment2, mst2, cfa, cfb, crank, stats2 = _finish_chunk(
         fragment, mst, fa, fb, rank_of_slot,
@@ -564,6 +629,7 @@ def solve_rank_staged(
     compact_space: bool | None = None,
     initial_state: tuple | None = None,
     on_chunk=None,
+    parent1=None,
 ) -> Tuple[jax.Array, jax.Array, int]:
     """Device-resident solve from staged arrays.
 
@@ -596,8 +662,9 @@ def solve_rank_staged(
         fa, fb, count_d = _relabel_slots(fragment, ra, rb)
         count = int(jax.device_get(count_d))
     else:
+        parent1 = _ensure_parent1(vmin0, ra, rb, parent1)
         fragment, mst, fa, fb, stats = _rank_head(
-            vmin0, ra, rb, compact_after=compact_after
+            vmin0, ra, rb, parent1, compact_after=compact_after
         )
         lv, count = (int(x) for x in jax.device_get(stats))
     rank_of_slot = jnp.arange(ra.shape[0], dtype=jnp.int32)
@@ -761,11 +828,14 @@ def _finish_to_fixpoint(
 
 
 @functools.partial(jax.jit, static_argnames=("prefix",))
-def _filtered_head(vmin0, ra, rb, *, prefix: int):
-    """Level 1 on the full vertex minima + level 2 over prefix slots only;
-    one dispatch. Returns ``(fragment, mst, fa, fb, stats)`` with ``mst``
-    full-width and ``fa/fb`` prefix-width."""
-    fragment, parent1, has1, safe1 = _level1_hook(vmin0, ra, rb)
+def _filtered_head(vmin0, ra, rb, parent1, *, prefix: int):
+    """Level-1 marks + level 2 over prefix slots only; one dispatch.
+    ``parent1`` is the level-1 partition (host-precomputed in prep).
+    Returns ``(fragment, mst, fa, fb, stats)`` with ``mst`` full-width and
+    ``fa/fb`` prefix-width."""
+    fragment = parent1
+    has1 = vmin0 < INT32_MAX
+    safe1 = jnp.where(has1, vmin0, 0)
     mst = jnp.zeros(ra.shape[0], dtype=bool).at[safe1].max(has1)
 
     # Level 2 restricted to the prefix: relabel only the prefix endpoints.
@@ -925,7 +995,7 @@ def _prefix_size(n_pad: int, m_pad: int, mult: int = 2) -> int:
 
 def solve_rank_filtered(
     vmin0, ra, rb, *, chunk_levels: int = 3, prefix_mult: int | None = None,
-    on_chunk=None,
+    on_chunk=None, parent1=None,
 ) -> Tuple[jax.Array, jax.Array, int]:
     """Filter-Kruskal solve: prefix Borůvka, one-pass suffix filter, survivor
     finish. Same contract and bit-identical results as
@@ -960,11 +1030,15 @@ def solve_rank_filtered(
     if 2 * prefix > m_pad:
         # Not enough suffix to pay for the split — plain staged solve.
         return solve_rank_staged(
-            vmin0, ra, rb, chunk_levels=chunk_levels, on_chunk=on_chunk
+            vmin0, ra, rb, chunk_levels=chunk_levels, on_chunk=on_chunk,
+            parent1=parent1,
         )
 
     compact_space = n_pad >= _CENSUS_MIN_SPACE
-    fragment, mst, fa, fb, stats = _filtered_head(vmin0, ra, rb, prefix=prefix)
+    parent1 = _ensure_parent1(vmin0, ra, rb, parent1)
+    fragment, mst, fa, fb, stats = _filtered_head(
+        vmin0, ra, rb, parent1, prefix=prefix
+    )
     lv, count = (int(x) for x in jax.device_get(stats))
     if on_chunk is not None:
         on_chunk(lv, fragment, mst, count)
@@ -1014,7 +1088,8 @@ def solve_rank_filtered(
     jax.jit, static_argnames=("prefix", "prefix_out", "out_size", "max_levels")
 )
 def _filtered_speculative_program(
-    vmin0, ra, rb, *, prefix: int, prefix_out: int, out_size: int, max_levels: int
+    vmin0, ra, rb, parent1, *, prefix: int, prefix_out: int, out_size: int,
+    max_levels: int
 ):
     """The whole filtered solve as ONE dispatch, for the small-dense regime
     where host round trips (~0.12 s each on a tunneled chip) dominate:
@@ -1036,7 +1111,9 @@ def _filtered_speculative_program(
     Returns ``(fragment, mst, stats)`` with ``stats = [levels,
     prefix_count, prefix_alive_end, filter_count, survivor_alive_end]``.
     """
-    fragment, mst, fa, fb, stats0 = _filtered_head(vmin0, ra, rb, prefix=prefix)
+    fragment, mst, fa, fb, stats0 = _filtered_head(
+        vmin0, ra, rb, parent1, prefix=prefix
+    )
     prefix_count = stats0[1]
     rank_p = jnp.arange(prefix, dtype=jnp.int32)
     cfa_p, cfb_p, crank_p, _ = _compact_slots(fa, fb, rank_p, prefix_out)
@@ -1066,6 +1143,7 @@ def solve_rank_filtered_speculative(
     prefix_mult: int = 2,
     prefix_out: int | None = None,
     out_size: int | None = None,
+    parent1=None,
 ) -> Tuple[jax.Array, jax.Array, int] | None:
     """Single-round-trip filtered solve; ``None`` on misprediction (caller
     falls back to :func:`solve_rank_filtered`). Default speculative widths:
@@ -1081,8 +1159,9 @@ def solve_rank_filtered_speculative(
     if out_size is None:
         out_size = max(_bucket_size(m_pad // 128), _COMPACT_MIN_SLOTS)
     max_levels = _max_levels(n_pad)
+    parent1 = _ensure_parent1(vmin0, ra, rb, parent1)
     fragment, mst, stats = _filtered_speculative_program(
-        vmin0, ra, rb,
+        vmin0, ra, rb, parent1,
         prefix=prefix, prefix_out=prefix_out, out_size=out_size,
         max_levels=max_levels,
     )
@@ -1112,31 +1191,38 @@ def use_filtered_path(family: str, num_ranks: int) -> bool:
     return family == "dense" and num_ranks >= _FILTER_MIN_RANKS
 
 
-def solve_rank_auto(vmin0, ra, rb, *, family: str = "dense"):
+def solve_rank_auto(vmin0, ra, rb, *, family: str = "dense", parent1=None):
     """Dispatch policy shared by ``solve_graph_rank`` and ``bench.py`` —
     see :func:`_pick_family` for the per-family rationale. Chunk length 2
     beats 3 on many-level graphs (measured 12.1 s vs 13.2 s on a 4096^2
     grid; 1 loses to dispatch overhead at 14.1 s)."""
     n_pad = vmin0.shape[0]
+    parent1 = _ensure_parent1(vmin0, ra, rb, parent1)
     if use_filtered_path(family, ra.shape[0]):
         if n_pad < _CENSUS_MIN_SPACE:
             # Small-dense: one dispatch with compacted inner loops beats the
             # staged sequence (RMAT-20: 1.31 s vs 1.41 s staged, same
             # session). Falls back to the exact staged path on any width
             # misprediction.
-            result = solve_rank_filtered_speculative(vmin0, ra, rb)
+            result = solve_rank_filtered_speculative(
+                vmin0, ra, rb, parent1=parent1
+            )
             if result is not None:
                 return result
-        return solve_rank_filtered(vmin0, ra, rb)
+        return solve_rank_filtered(vmin0, ra, rb, parent1=parent1)
     if family == "dense" and n_pad < _CENSUS_MIN_SPACE:
         # Below the census threshold the finish is one chunk and the fetch
         # overhead dominates: speculate the survivor width at m/8 (2x the
         # worst measured RMAT ratio) and fall back on misprediction.
         out_size = max(_bucket_size(ra.shape[0] // 8), _COMPACT_MIN_SLOTS)
-        result = solve_rank_speculative(vmin0, ra, rb, out_size=out_size)
+        result = solve_rank_speculative(
+            vmin0, ra, rb, out_size=out_size, parent1=parent1
+        )
         if result is not None:
             return result
-    return solve_rank_staged(vmin0, ra, rb, **_family_params(family))
+    return solve_rank_staged(
+        vmin0, ra, rb, **_family_params(family), parent1=parent1
+    )
 
 
 # packbits over masks wider than this runs in slices: the single
@@ -1182,8 +1268,8 @@ def solve_graph_rank(graph: Graph) -> Tuple[np.ndarray, np.ndarray, int]:
     n = graph.num_nodes
     if n == 0 or graph.num_edges == 0:
         return np.zeros(0, dtype=np.int64), np.arange(n, dtype=np.int32), 0
-    vmin0, ra, rb = prepare_rank_arrays(graph)
+    vmin0, ra, rb, parent1 = prepare_rank_arrays_full(graph)
     mst, fragment, levels = solve_rank_auto(
-        vmin0, ra, rb, family=_pick_family(graph)
+        vmin0, ra, rb, family=_pick_family(graph), parent1=parent1
     )
     return fetch_mst_edge_ids(graph, mst), np.asarray(fragment)[:n], levels

@@ -9,9 +9,9 @@ mesh's edge axis and keeps everything else replicated:
     rank order, which is the tie-break total order. ``vmin0`` (per-vertex
     min incident rank, host-precomputed) and all fragment state are
     replicated; MST marks live with the rank block that owns them.
-  * **Level 1** is n-sized replicated hooking; the only cross-chip traffic
-    is two ``lax.pmin``s to look up the winning edges' endpoints from their
-    owner shards.
+  * **Level 1** arrives host-precomputed (``host_level1`` during staging —
+    the hook edges are the host-known vertex minima, so the partition costs
+    the solve nothing); each shard only marks the level-1 ranks it owns.
   * **Level 2** is one per-shard ``segment_min`` over the local rank block
     plus one n-sized ``lax.pmin`` — the ICI analog of the reference's
     REPORT convergecast (``/root/reference/ghs_implementation_mpi.py:493-580``).
@@ -56,6 +56,7 @@ from distributed_ghs_implementation_tpu.models.rank_solver import (
     _prefix_size,
     _restore_state_host,
     check_rank_envelope,
+    host_level1,
     fetch_mst_edge_ids,
     packed_to_edge_ids,
     use_filtered_path,
@@ -79,36 +80,31 @@ def _owner_lookup(table, ranks, has, k, mb, axis):
     return jax.lax.pmin(jnp.where(mine, table[li], INT32_MAX), axis), mine, li
 
 
-def _sharded_level1(vmin0, ra, rb):
-    """Level 1 on the mesh (traced helper shared by both per-shard heads):
-    hook every vertex on its min incident rank, looking up the winning
-    edges' endpoints from their owner shards via pmin. Returns ``(fragment,
-    parent1, mst_local)``."""
-    n = vmin0.shape[0]
-    mb = ra.shape[0]
-    k = jax.lax.axis_index(EDGE_AXIS).astype(jnp.int32)
-    ids = jnp.arange(n, dtype=jnp.int32)
+def _sharded_l1_marks(vmin0, mb, k):
+    """Level-1 MST marks for the local rank block: the chosen ranks are
+    exactly the ``vmin0`` values (the level-1 partition itself arrives
+    host-precomputed as ``parent1`` — no cross-shard lookups needed)."""
     has1 = vmin0 < INT32_MAX
-    a, mine1, li1 = _owner_lookup(ra, vmin0, has1, k, mb, EDGE_AXIS)
-    b, _, _ = _owner_lookup(rb, vmin0, has1, k, mb, EDGE_AXIS)
-    dst1 = jnp.where(has1, jnp.where(a == ids, b, a), ids)
-    fragment, parent1 = hook_and_compress(has1, dst1, ids)
-    mst = jnp.zeros(mb, bool).at[jnp.where(mine1, li1, mb)].max(
+    safe1 = jnp.where(has1, vmin0, 0)
+    local = safe1 - k * mb
+    mine1 = has1 & (local >= 0) & (local < mb)
+    return jnp.zeros(mb, bool).at[jnp.where(mine1, local, mb)].max(
         mine1, mode="drop"
     )
-    return fragment, parent1, mst
 
 
-def _rank_sharded_head(vmin0, ra, rb):
-    """Per-shard body: levels 1-2. Returns ``(fragment, mst_local, fa, fb,
-    stats)`` with ``stats = [levels, total_alive, max_local_alive]``."""
+def _rank_sharded_head(vmin0, parent1, ra, rb):
+    """Per-shard body: levels 1-2 (level-1 partition host-precomputed).
+    Returns ``(fragment, mst_local, fa, fb, stats)`` with ``stats =
+    [levels, total_alive, max_local_alive]``."""
     n = vmin0.shape[0]
     mb = ra.shape[0]
     k = jax.lax.axis_index(EDGE_AXIS).astype(jnp.int32)
     ids = jnp.arange(n, dtype=jnp.int32)
 
-    fragment, parent1, mst = _sharded_level1(vmin0, ra, rb)
+    fragment = parent1
     has1 = vmin0 < INT32_MAX
+    mst = _sharded_l1_marks(vmin0, mb, k)
 
     # ---- Relabel the local rank block (the sharded edge-sized work).
     fa = parent1[ra]
@@ -182,10 +178,12 @@ def _rank_sharded_finish(fragment, mst, fa, fb, *, fs_local: int, max_levels: in
 # ---------------------------------------------------------------------------
 
 
-def _rank_sharded_l1(vmin0, ra, rb):
-    """Per-shard body: level 1 only. Returns ``(fragment, mst_local)``."""
-    fragment, _parent1, mst = _sharded_level1(vmin0, ra, rb)
-    return fragment, mst
+def _rank_sharded_l1(vmin0, parent1, ra):
+    """Per-shard body: level-1 marks only (the partition is ``parent1``).
+    Returns ``(fragment, mst_local)``."""
+    mb = ra.shape[0]
+    k = jax.lax.axis_index(EDGE_AXIS).astype(jnp.int32)
+    return parent1, _sharded_l1_marks(vmin0, mb, k)
 
 
 def _rank_resume_relabel(fragment, ra, rb):
@@ -279,7 +277,7 @@ def make_rank_sharded_l1(mesh: Mesh):
     mapped = shard_map_compat(
         _rank_sharded_l1,
         mesh,
-        in_specs=(P(), P(EDGE_AXIS), P(EDGE_AXIS)),
+        in_specs=(P(), P(), P(EDGE_AXIS)),
         out_specs=(P(), P(EDGE_AXIS)),
     )
     return jax.jit(mapped)
@@ -373,7 +371,7 @@ def make_rank_sharded_head(mesh: Mesh):
     mapped = shard_map_compat(
         _rank_sharded_head,
         mesh,
-        in_specs=(P(), P(EDGE_AXIS), P(EDGE_AXIS)),
+        in_specs=(P(), P(), P(EDGE_AXIS), P(EDGE_AXIS)),
         out_specs=(P(), P(EDGE_AXIS), P(EDGE_AXIS), P(EDGE_AXIS), P()),
     )
     return jax.jit(mapped)
@@ -442,13 +440,15 @@ def solve_graph_rank_sharded(
     m_pad = int(math.ceil(_bucket_size(graph.num_edges) / unit) * unit)
     check_rank_envelope(n_pad, m_pad)
     int32_max = np.iinfo(np.int32).max
-    vmin0 = np.full(n_pad, int32_max, dtype=np.int32)
-    vmin0[:n] = graph.first_ranks
+    vmin0_np = np.full(n_pad, int32_max, dtype=np.int32)
+    vmin0_np[:n] = graph.first_ranks
     ra_np, rb_np = graph.rank_endpoints(pad_to=m_pad)
+    parent1_np = host_level1(vmin0_np, ra_np, rb_np)
 
     rep = NamedSharding(mesh, P())
     blk = NamedSharding(mesh, P(EDGE_AXIS))
-    vmin0 = _stage(vmin0, rep)
+    vmin0 = _stage(vmin0_np, rep)
+    parent1 = _stage(parent1_np, rep)
     ra = _stage(ra_np, blk)
     rb = _stage(rb_np, blk)
 
@@ -468,7 +468,7 @@ def solve_graph_rank_sharded(
         ra_p = slice_rep(ra)
         rb_p = slice_rep(rb)
         l1 = make_rank_sharded_l1(mesh)
-        fragment, mst = l1(vmin0, ra, rb)
+        fragment, mst = l1(vmin0, parent1, ra)
         fragment, mst_p, fa_p, fb_p, stats = _prefix_level2(fragment, ra_p, rb_p)
         lv2, count = (int(x) for x in jax.device_get(stats))
         lv = 1 + lv2
@@ -505,7 +505,7 @@ def solve_graph_rank_sharded(
         total, cmax = (int(x) for x in jax.device_get(fstats))
     else:
         head = make_rank_sharded_head(mesh)
-        fragment, mst, fa, fb, stats = head(vmin0, ra, rb)
+        fragment, mst, fa, fb, stats = head(vmin0, parent1, ra, rb)
         lv, total, cmax = (int(x) for x in jax.device_get(stats))
     if on_chunk is not None and initial_state is None:
         mst_now = mst

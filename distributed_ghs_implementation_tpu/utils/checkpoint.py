@@ -119,14 +119,14 @@ def solve_graph_checkpointed(
         from distributed_ghs_implementation_tpu.models.rank_solver import (
             _family_params,
             _pick_family,
-            prepare_rank_arrays,
+            prepare_rank_arrays_full,
             solve_rank_filtered,
             solve_rank_resume,
             solve_rank_staged,
             use_filtered_path,
         )
 
-        vmin0, ra, rb = prepare_rank_arrays(graph)
+        vmin0, ra, rb, parent1 = prepare_rank_arrays_full(graph)
         chunks_seen = [0]
 
         def on_chunk(level, fragment, mst_ranks, count):
@@ -151,13 +151,14 @@ def solve_graph_checkpointed(
             # Fresh dense solve: the filter-Kruskal path, same on_chunk
             # contract.
             mst_ranks, fragment, levels = solve_rank_filtered(
-                vmin0, ra, rb, on_chunk=on_chunk
+                vmin0, ra, rb, on_chunk=on_chunk, parent1=parent1
             )
         else:
             mst_ranks, fragment, levels = solve_rank_staged(
                 vmin0, ra, rb,
                 **_family_params(family),
                 on_chunk=on_chunk,
+                parent1=parent1,
             )
     elif strategy == "stepped":
         from distributed_ghs_implementation_tpu.models.boruvka import (

@@ -102,12 +102,12 @@ def _solve_rank_instrumented(graph) -> tuple:
     from distributed_ghs_implementation_tpu.models.rank_solver import (
         _family_params,
         _pick_family,
-        prepare_rank_arrays,
+        prepare_rank_arrays_full,
         solve_rank_staged,
     )
 
     n = graph.num_nodes
-    vmin0, ra, rb = prepare_rank_arrays(graph)
+    vmin0, ra, rb, parent1 = prepare_rank_arrays_full(graph)
     records = []
     frags_before = [n]
     last = [time.perf_counter()]
@@ -132,6 +132,7 @@ def _solve_rank_instrumented(graph) -> tuple:
         vmin0, ra, rb,
         **_family_params(_pick_family(graph)),
         on_chunk=on_chunk,
+        parent1=parent1,
     )
     total = time.perf_counter() - t_start
 

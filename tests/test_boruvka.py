@@ -557,3 +557,34 @@ def test_random_road_network_non_grid():
     assert verify_result(r, oracle="networkx").ok
     rp = minimum_spanning_forest(g, backend="sharded")
     assert np.array_equal(r.edge_ids, rp.edge_ids)
+
+
+@pytest.mark.parametrize("case", [(40, 120, 3), (100, 60, 1), (64, 64, 9)])
+def test_host_level1_matches_device(case):
+    """The host-side level-1 partition must be element-identical to the
+    device computation it replaces (same hook destinations, same mutual
+    break, same roots) — the r4 L1 host-precompute's contract."""
+    import jax.numpy as jnp
+
+    from distributed_ghs_implementation_tpu.models import rank_solver as rs
+
+    n, m, seed = case
+    rng = np.random.default_rng(seed)
+    g = Graph.from_arrays(
+        n,
+        rng.integers(0, n, size=m),
+        rng.integers(0, n, size=m),
+        rng.integers(1, 6, size=m),
+    )
+    if g.num_edges == 0:
+        pytest.skip("degenerate draw")
+    n_pad = rs._bucket_size(g.num_nodes)
+    m_pad = rs._bucket_size(g.num_edges)
+    vmin0 = np.full(n_pad, np.iinfo(np.int32).max, dtype=np.int32)
+    vmin0[: g.num_nodes] = g.first_ranks
+    ra, rb = g.rank_endpoints(pad_to=m_pad)
+    host = rs.host_level1(vmin0, ra, rb)
+    dev = np.asarray(
+        rs._device_level1(jnp.asarray(vmin0), jnp.asarray(ra), jnp.asarray(rb))
+    )
+    assert np.array_equal(host, dev)

@@ -57,8 +57,8 @@ def main():
 
     scale = int(sys.argv[1]) if len(sys.argv) > 1 else 24
     g = read_npz(f"/tmp/rmat{scale}_s24.npz")
-    vmin0, ra, rb = rs.prepare_rank_arrays(g)
-    jax.block_until_ready((vmin0, ra, rb))
+    vmin0, ra, rb, parent1 = rs.prepare_rank_arrays_full(g)
+    jax.block_until_ready((vmin0, ra, rb, parent1))
     n_pad = vmin0.shape[0]
     m_pad = ra.shape[0]
     prefix = rs._prefix_size(n_pad, m_pad, 1)
@@ -66,7 +66,7 @@ def main():
 
     # 1a. The shipped head.
     head = functools.partial(rs._filtered_head, prefix=prefix)
-    dt, (fragment, mst, fa, fb, stats) = t3(head, vmin0, ra, rb)
+    dt, (fragment, mst, fa, fb, stats) = t3(head, vmin0, ra, rb, parent1)
     log(f"head (with full-width mask): {dt:.2f}s")
 
     # 1b. Mask-free variant: identical work minus the m_pad-wide mask.

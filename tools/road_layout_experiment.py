@@ -82,8 +82,8 @@ def solve_instrumented(g, label):
     from distributed_ghs_implementation_tpu.models import rank_solver as rs
 
     t0 = time.perf_counter()
-    vmin0, ra, rb = rs.prepare_rank_arrays(g)
-    jax.block_until_ready((vmin0, ra, rb))
+    vmin0, ra, rb, parent1 = rs.prepare_rank_arrays_full(g)
+    jax.block_until_ready((vmin0, ra, rb, parent1))
     prep = time.perf_counter() - t0
 
     record = []
@@ -109,7 +109,8 @@ def solve_instrumented(g, label):
             record.clear()
             t0 = time.perf_counter()
             mst, frag, lv = rs.solve_rank_staged(
-                vmin0, ra, rb, **rs._family_params(rs._pick_family(g))
+                vmin0, ra, rb, **rs._family_params(rs._pick_family(g)),
+                parent1=parent1,
             )
             jax.block_until_ready((mst, frag))
             dt = time.perf_counter() - t0

@@ -47,8 +47,8 @@ def main():
         write_npz(g, cache)
 
     t0 = time.perf_counter()
-    vmin0, ra, rb = rs.prepare_rank_arrays(g)
-    jax.block_until_ready((vmin0, ra, rb))
+    vmin0, ra, rb, parent1 = rs.prepare_rank_arrays_full(g)
+    jax.block_until_ready((vmin0, ra, rb, parent1))
     t_prep = time.perf_counter() - t0
     log(f"host prep + staging: {t_prep:.1f}s (m_pad={ra.shape[0]:,})")
 
@@ -56,7 +56,7 @@ def main():
     lv = 0
     for i in range(3):
         t0 = time.perf_counter()
-        mst, frag, lv = rs.solve_rank_auto(vmin0, ra, rb, family="dense")
+        mst, frag, lv = rs.solve_rank_auto(vmin0, ra, rb, family="dense", parent1=parent1)
         jax.block_until_ready((mst, frag))
         times.append(time.perf_counter() - t0)
         log(f"solve {i}: {times[-1]:.2f}s levels={lv}")

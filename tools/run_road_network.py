@@ -48,8 +48,8 @@ def main():
     log(f"degree histogram 0..8: {hist.tolist()}")
 
     t0 = time.perf_counter()
-    vmin0, ra, rb = rs.prepare_rank_arrays(g)
-    jax.block_until_ready((vmin0, ra, rb))
+    vmin0, ra, rb, parent1 = rs.prepare_rank_arrays_full(g)
+    jax.block_until_ready((vmin0, ra, rb, parent1))
     t_prep = time.perf_counter() - t0
     log(f"prep+staging {t_prep:.1f}s")
 
@@ -57,7 +57,9 @@ def main():
     lv = 0
     for i in range(3):
         t0 = time.perf_counter()
-        mst, frag, lv = rs.solve_rank_auto(vmin0, ra, rb, family=family)
+        mst, frag, lv = rs.solve_rank_auto(
+            vmin0, ra, rb, family=family, parent1=parent1
+        )
         jax.block_until_ready((mst, frag))
         # Force a real sync (block_until_ready alone returns early on the
         # axon tunnel backend — see tools/probe_head.py).
