@@ -109,11 +109,21 @@ def host_level1(vmin0: np.ndarray, ra: np.ndarray, rb: np.ndarray) -> np.ndarray
     parent = np.where(has1, np.where(a == ids, b, a), ids).astype(np.int32)
     mutual = parent[parent] == ids
     parent = np.where(mutual & (ids < parent), ids, parent)
-    while True:
+    # Hook forests with the mutual pair broken converge in <= ceil(log2 n)+1
+    # jumps; a vmin0 that is NOT the true per-vertex min incident rank can
+    # produce longer cycles — bound the loop so such input cannot hang the
+    # host. Cycles whose length divides a power of two still collapse
+    # silently (squaring maps them to the identity), so this is a hang
+    # guard, not full input validation.
+    for _ in range(max(int(np.ceil(np.log2(max(n, 2)))) + 1, 1)):
         p2 = parent[parent]
         if np.array_equal(p2, parent):
             return parent
         parent = p2
+    raise ValueError(
+        "host_level1 did not converge: vmin0 is not a per-vertex minimum "
+        "incident rank (hook graph has a cycle longer than 2)"
+    )
 
 
 @jax.jit

@@ -352,6 +352,10 @@ def make_rank_sharded_level(mesh: Mesh):
 # off an early checkpoint (most ranks still alive) — the fresh paths arrive
 # here already small.
 _FINISH_GATHER_MAX_SLOTS = 1 << 25
+# Checkpoint cadence inside the capacity-guard level loop (ADVICE r4): a
+# high-diameter graph at capacity can run many in-place levels before the
+# finish; save every K so a preemption there does not lose them all.
+_GUARD_CHECKPOINT_EVERY = 4
 
 
 def _full_mask_host(mesh, mst, m_pad: int, mst_p=None, prefix: int = 0):
@@ -410,7 +414,10 @@ def solve_graph_rank_sharded(
     be degenerate there.
 
     ``on_chunk(level, vertex_fragment, mask_fn, count)`` fires after the
-    head, each prefix-phase chunk, the filter, and the finish. Unlike the
+    head, each prefix-phase chunk, the filter, every
+    ``_GUARD_CHECKPOINT_EVERY`` in-place levels of the capacity-guard loop
+    (high-diameter graphs at capacity can spend many levels there), and
+    the finish. Unlike the
     single-chip contract, the third argument is a ZERO-ARG CALLABLE that
     materializes the full-width mask on the host when invoked — the
     materialization is a collective (packed all-gather) plus a sizeable
@@ -514,13 +521,25 @@ def solve_graph_rank_sharded(
         )
     # Capacity guard before the finish: shrink the alive set with in-place
     # sharded levels while the would-be gathered width exceeds the budget.
+    # A high-diameter graph can spend many levels here, so checkpoint every
+    # _GUARD_CHECKPOINT_EVERY iterations — the decision is a pure function
+    # of the loop counter, hence SPMD-identical across processes (the
+    # harvest inside mask_fn is a collective).
+    guard_iters = 0
     while total > 0 and n_dev * _bucket_size(cmax) > _FINISH_GATHER_MAX_SLOTS:
         level_fn = make_rank_sharded_level(mesh)
         fragment, mst, fa, fb, lstats = level_fn(fragment, mst, fa, fb)
         total, cmax, progressed = (int(x) for x in jax.device_get(lstats))
         lv += 1
+        guard_iters += 1
         if not progressed:
             break  # isolated remainder (disconnected pads); nothing to gather
+        if on_chunk is not None and guard_iters % _GUARD_CHECKPOINT_EVERY == 0:
+            mst_now = mst
+            on_chunk(
+                lv, fragment,
+                lambda: _full_mask_host(mesh, mst_now, m_pad), total,
+            )
     if total > 0:
         fs_local = max(_bucket_size(cmax), 1024)
         finish = make_rank_sharded_finish(mesh, fs_local, _max_levels(n_pad))

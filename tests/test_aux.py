@@ -480,6 +480,81 @@ def test_sharded_resume_capacity_guard(tmp_path, monkeypatch):
     assert np.array_equal(edge_ids, ref_ids)
 
 
+def test_sharded_capacity_guard_checkpoints(tmp_path, monkeypatch):
+    """ADVICE r4: the capacity-guard level loop must itself fire on_chunk
+    periodically — a resume that spends many in-place sharded levels there
+    would otherwise save nothing until the finish. Pin the cadence to 1 and
+    the gather budget tiny, resume off an early checkpoint, and require (a)
+    guard-loop saves with harvestable masks and (b) that resuming from the
+    LAST guard-loop save still lands on the reference MST."""
+    from distributed_ghs_implementation_tpu.graphs.generators import (
+        road_grid_graph,
+    )
+    from distributed_ghs_implementation_tpu.parallel import rank_sharded as rsh
+    from distributed_ghs_implementation_tpu.utils.checkpoint import (
+        graph_fingerprint,
+        load_checkpoint,
+    )
+
+    # High-diameter grid: many in-place guard levels run before the alive
+    # count reaches zero, so mid-loop saves fire with count > 0. (An RMAT
+    # graph at this scale finishes in one guard level, whose save lands
+    # exactly when count hits 0 — indistinguishable from the finish hook.)
+    g = road_grid_graph(40, 40, seed=9)
+    ref_ids, _, _ = solve_graph(g, strategy="rank")
+    p = str(tmp_path / "early.npz")
+    fp = graph_fingerprint(g)
+
+    class Stop(Exception):
+        pass
+
+    def dying_hook(level, fragment, mask_fn, count):
+        save_checkpoint(p, fragment, mask_fn(), level, fingerprint=fp)
+        raise Stop()
+
+    with pytest.raises(Stop):
+        rsh.solve_graph_rank_sharded(g, on_chunk=dying_hook)
+
+    monkeypatch.setattr(rsh, "_FINISH_GATHER_MAX_SLOTS", 64)
+    monkeypatch.setattr(rsh, "_GUARD_CHECKPOINT_EVERY", 1)
+    state = load_checkpoint(p, expect_fingerprint=fp)
+    saves = []
+    p_guard = str(tmp_path / "guard.npz")
+
+    def saving_hook(level, fragment, mask_fn, count):
+        # Only guard-loop saves carry count > 0; the finish-stage hook
+        # (count == 0) always fires and must not satisfy this test.
+        if count > 0:
+            saves.append(level)
+            save_checkpoint(
+                p_guard, fragment, mask_fn(), level, fingerprint=fp
+            )
+
+    edge_ids, _, _ = rsh.solve_graph_rank_sharded(
+        g, initial_state=state, on_chunk=saving_hook
+    )
+    assert np.array_equal(edge_ids, ref_ids)
+    assert len(saves) >= 1, "guard loop fired no periodic checkpoints"
+
+    state2 = load_checkpoint(p_guard, expect_fingerprint=fp)
+    edge_ids2, _, _ = rsh.solve_graph_rank_sharded(g, initial_state=state2)
+    assert np.array_equal(edge_ids2, ref_ids)
+
+
+def test_host_level1_malformed_vmin0_raises():
+    """ADVICE r4: a vmin0 that is not the true per-vertex min incident rank
+    can make the hook graph a cycle longer than 2; host_level1 must error
+    loudly instead of spinning the host forever."""
+    from distributed_ghs_implementation_tpu.models.rank_solver import host_level1
+
+    # Three edges forming a directed 3-cycle of hooks: 0->1->2->0.
+    vmin0 = np.array([0, 1, 2], dtype=np.int32)
+    ra = np.array([0, 1, 2], dtype=np.int32)
+    rb = np.array([1, 2, 0], dtype=np.int32)
+    with pytest.raises(ValueError, match="did not converge"):
+        host_level1(vmin0, ra, rb)
+
+
 def test_instrumented_rank_strategy():
     from distributed_ghs_implementation_tpu.graphs.generators import road_grid_graph
 
