@@ -1,21 +1,27 @@
 """Benchmark: MST throughput on RMAT graphs (BASELINE.json metric).
 
 Prints ONE JSON line:
-  {"metric": ..., "value": N, "unit": "edges/s", "vs_baseline": N}
+  {"metric": ..., "value": N, "unit": "edges/s", "vs_baseline": N, ...}
 
 Baseline: the reference's best measured *correct* run — the 10-node/28-edge
 thread-backend experiment at 0.41 s (BASELINE.md) ≈ 68 edges/s. Its 20-node
 config is already wrong 2/3 of the time, so this is the fastest throughput the
 reference demonstrably sustains.
 
-Default config: RMAT scale-22 (4.2M vertices, ~64M undirected edges after
-dedup), solved on the real TPU chip, verified for weight parity against the
-SciPy MSF oracle — the largest size whose full gen+verify cycle stays in
-single-digit minutes (scale 24's oracle alone is ~15 min; its measured
-numbers live in docs/BASELINE_RUNS.jsonl). Throughput rises with scale
-(the filter-Kruskal path amortizes fixed costs), so this is also a more
-faithful picture of the solver than scale 20 (~17.8M vs ~11.8M edges/s).
-``--scale`` adjusts size; ``--backend sharded`` exercises the mesh path.
+Default config: RMAT scale-24 (16.8M vertices, ~252M undirected edges after
+dedup) — the exact size BASELINE.json's metric names — solved on the real
+TPU chip and verified for weight parity against the RECORDED SciPy oracle
+weight (518,885,017 for seed 24; receipts in docs/BASELINE_RUNS.jsonl — the
+live oracle at this scale costs ~15 min, the weight is deterministic per
+seed, so the recorded value is the same check at zero cost). Unknown
+(scale, seed, edge-factor) combinations fall back to the live SciPy oracle.
+
+Accounting (round-5 contract): BOTH clocks are reported. ``value`` is the
+solve-only throughput (arrays staged, Kruskal-style sort-excluded clock);
+``prep_s`` and ``e2e_edges_per_sec`` put the host prep — rank construction,
+first_ranks, the host level-1 partition, staging — back on the clock.
+``e2e`` uses the best warm solve (XLA compile time is excluded from both
+clocks; the persistent compile cache makes repeat processes warm).
 """
 
 from __future__ import annotations
@@ -27,10 +33,24 @@ import time
 
 BASELINE_EDGES_PER_SEC = 68.0  # reference: 28 edges / 0.41 s (BASELINE.md)
 
+SEED = 24  # ties the generator call and the recorded-weight keys together
+
+# SciPy-oracle MSF weights, recorded in docs/BASELINE_RUNS.jsonl, keyed by
+# (scale, edge_factor, seed) of rmat_graph. Deterministic per key — but only
+# on the NATIVE generator path (the NumPy fallback is a different RNG
+# stream), so the lookup is gated on native availability.
+RECORDED_ORACLE_WEIGHTS = {
+    (20, 16, SEED): 35_737_768,
+    (22, 16, SEED): 136_591_056,
+    (24, 16, SEED): 518_885_017,
+    (25, 16, SEED): 1_008_877_972,
+    (26, 16, SEED): 1_960_349_712,
+}
+
 
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(description=__doc__)
-    p.add_argument("--scale", type=int, default=22, help="RMAT scale (2^scale vertices)")
+    p.add_argument("--scale", type=int, default=24, help="RMAT scale (2^scale vertices)")
     p.add_argument("--edge-factor", type=int, default=16)
     p.add_argument("--repeats", type=int, default=3)
     p.add_argument("--backend", default="device", choices=["device", "sharded"])
@@ -42,7 +62,7 @@ def main(argv=None) -> int:
     from distributed_ghs_implementation_tpu.utils.verify import verify_result
 
     t0 = time.perf_counter()
-    g = rmat_graph(args.scale, args.edge_factor, seed=24)
+    g = rmat_graph(args.scale, args.edge_factor, seed=SEED)
     print(
         f"generated RMAT-{args.scale}: {g.num_nodes:,} nodes, {g.num_edges:,} edges "
         f"in {time.perf_counter() - t0:.1f}s",
@@ -50,8 +70,10 @@ def main(argv=None) -> int:
     )
 
     # Device-resident timing of the kernel that is also the one verified:
-    # arrays staged once, each repeat is solve + scalar sync.
+    # arrays staged once, each repeat is solve + scalar sync. prep_s is the
+    # full host-side cost of getting there from the cold graph.
     times = []
+    prep_s = None
     if args.backend == "device":
         import numpy as np
 
@@ -64,8 +86,9 @@ def main(argv=None) -> int:
 
         t0 = time.perf_counter()
         vmin0, ra, rb, parent1 = prepare_rank_arrays_full(g)
+        prep_s = time.perf_counter() - t0
         print(f"host prep (ranks + first_ranks + L1 + staging): "
-              f"{time.perf_counter() - t0:.1f}s", file=sys.stderr)
+              f"{prep_s:.1f}s", file=sys.stderr)
         fam = _pick_family(g)  # same path production takes
         mst, fragment, levels = solve_rank_auto(
             vmin0, ra, rb, family=fam, parent1=parent1
@@ -98,8 +121,16 @@ def main(argv=None) -> int:
     best = min(times)
     print(f"solve times: {[f'{t:.3f}' for t in times]}", file=sys.stderr)
 
+    # Recorded weights apply only to graphs from the native generator RNG
+    # stream (the graph carries the tag); on a toolchain-less host the
+    # NumPy-stream graph differs, so fall back to the live oracle.
+    recorded = (
+        RECORDED_ORACLE_WEIGHTS.get((args.scale, args.edge_factor, SEED))
+        if g.__dict__.get("generator_path") == "rmat-native"
+        else None
+    )
     if not args.no_verify:
-        v = verify_result(result, oracle="scipy")
+        v = verify_result(result, oracle="scipy", expected_weight=recorded)
         if not v.ok:
             print(f"VERIFICATION FAILED: {v}", file=sys.stderr)
             print(
@@ -113,20 +144,24 @@ def main(argv=None) -> int:
                 )
             )
             return 1
-        print(f"verified: weight {v.actual_weight} = scipy oracle", file=sys.stderr)
+        print(
+            f"verified: weight {v.actual_weight} = {v.oracle} oracle",
+            file=sys.stderr,
+        )
 
     edges_per_sec = g.num_edges / best
     verified = "weight-verified" if not args.no_verify else "unverified"
-    print(
-        json.dumps(
-            {
-                "metric": f"MST edges/sec on RMAT-{args.scale} ({g.num_nodes} nodes, {g.num_edges} edges, {verified})",
-                "value": round(edges_per_sec, 1),
-                "unit": "edges/s",
-                "vs_baseline": round(edges_per_sec / BASELINE_EDGES_PER_SEC, 1),
-            }
-        )
-    )
+    out = {
+        "metric": f"MST edges/sec on RMAT-{args.scale} ({g.num_nodes} nodes, {g.num_edges} edges, {verified}, solve-only)",
+        "value": round(edges_per_sec, 1),
+        "unit": "edges/s",
+        "vs_baseline": round(edges_per_sec / BASELINE_EDGES_PER_SEC, 1),
+        "solve_s": round(best, 3),
+    }
+    if prep_s is not None:
+        out["prep_s"] = round(prep_s, 3)
+        out["e2e_edges_per_sec"] = round(g.num_edges / (prep_s + best), 1)
+    print(json.dumps(out))
     return 0
 
 

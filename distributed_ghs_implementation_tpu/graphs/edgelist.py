@@ -221,12 +221,26 @@ class Graph:
 
     def rank_endpoints(self, *, pad_to: int | None = None) -> Tuple[np.ndarray, np.ndarray]:
         """``(ra, rb)``: endpoints of the rank-``r`` edge, indexed by rank,
-        optionally right-padded with zeros (inert — pads are never chosen)."""
+        optionally right-padded with zeros (inert — pads are never chosen).
+
+        These arrays sit on prep's pre-transfer critical path (the big
+        host->device stagings cannot start before they exist), so the native
+        path fuses gather + int32 cast + pad into one pass."""
         m = self.num_edges
         size = m if pad_to is None else int(pad_to)
         if size < m:
             raise ValueError("pad_to smaller than edge count")
         order = self._rank_order
+        if m:
+            try:
+                from distributed_ghs_implementation_tpu.graphs import native
+
+                if native.native_available():
+                    return native.rank_endpoints_i32_native(
+                        order, self.u, self.v, size
+                    )
+            except Exception:  # noqa: BLE001 — any native issue -> fallback
+                pass
         ra = np.zeros(size, dtype=np.int32)
         rb = np.zeros(size, dtype=np.int32)
         ra[:m] = self.u[order]

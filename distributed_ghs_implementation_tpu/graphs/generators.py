@@ -181,7 +181,13 @@ def rmat_graph(
                 weight_high=weight_high,
             )
             # Already canonical + deduped; skip Graph.from_arrays re-dedup.
-            return Graph(n, u, v, w)
+            g = Graph(n, u, v, w)
+            # Tag which RNG stream produced the graph (frozen dataclass:
+            # write the instance __dict__ as the caches do). Consumers with
+            # per-stream recorded oracle weights key on this instead of
+            # re-deriving the native/NumPy path decision.
+            g.__dict__["generator_path"] = "rmat-native"
+            return g
         if native_required:
             raise RuntimeError("native RMAT requested but library unavailable")
         # auto + no native toolchain: fall through to the NumPy sampler.
@@ -204,7 +210,9 @@ def rmat_graph(
         u = (u << 1) | src_bit
         v = (v << 1) | dst_bit
     w = rng.integers(weight_low, weight_high + 1, size=m, dtype=np.int64)
-    return Graph.from_arrays(n, u, v, w, dedup=dedup)
+    g = Graph.from_arrays(n, u, v, w, dedup=dedup)
+    g.__dict__["generator_path"] = "rmat-numpy"
+    return g
 
 
 def road_grid_graph(

@@ -87,6 +87,15 @@ def get_lib() -> Optional[ctypes.CDLL]:
             ctypes.POINTER(ctypes.c_int32),
         ]
         lib.first_rank.restype = None
+        _I32 = ctypes.POINTER(ctypes.c_int32)
+        lib.first_rank_i32.argtypes = [
+            ctypes.c_int64, ctypes.c_int64, _I32, _I32, _I32,
+        ]
+        lib.first_rank_i32.restype = None
+        lib.rank_endpoints_i32.argtypes = [
+            ctypes.c_int64, ctypes.c_int64, _I64, _I64, _I64, _I32, _I32,
+        ]
+        lib.rank_endpoints_i32.restype = None
         lib.rank_order_counting.argtypes = [
             ctypes.c_int64, _I64, ctypes.c_int64, ctypes.c_int64, _I64,
         ]
@@ -165,6 +174,50 @@ def build_rank_csr_native(
     lib.build_rank_csr(num_nodes, m, _ptr(u), _ptr(v), _ptr(rank),
                        _ptr(indptr), _ptr(adj_dst), _ptr(adj_rank))
     return indptr, adj_dst, adj_rank
+
+
+def first_rank_i32_native(
+    num_nodes: int, ra: np.ndarray, rb: np.ndarray
+) -> np.ndarray:
+    """:func:`first_rank_native` over int32 endpoint arrays (the prep fast
+    path reuses its freshly built padded ``ra``/``rb`` — pass unpadded
+    ``ra[:m]`` views, pads would alias vertex 0)."""
+    lib = get_lib()
+    if lib is None:
+        raise RuntimeError("native library unavailable")
+    assert ra.dtype == np.int32 and ra.flags.c_contiguous
+    assert rb.dtype == np.int32 and rb.flags.c_contiguous
+    _i32p = ctypes.POINTER(ctypes.c_int32)
+    out = np.empty(num_nodes, dtype=np.int32)
+    lib.first_rank_i32(
+        num_nodes, ra.shape[0],
+        ra.ctypes.data_as(_i32p), rb.ctypes.data_as(_i32p),
+        out.ctypes.data_as(_i32p),
+    )
+    return out
+
+
+def rank_endpoints_i32_native(
+    order: np.ndarray, u: np.ndarray, v: np.ndarray, size_pad: int
+) -> Tuple[np.ndarray, np.ndarray]:
+    """Fused ``(u[order].astype(i32), v[order].astype(i32))`` with zero pad to
+    ``size_pad`` — one native pass in place of two int64 fancy-gathers plus
+    casts (the pre-transfer critical path of prep)."""
+    lib = get_lib()
+    if lib is None:
+        raise RuntimeError("native library unavailable")
+    m = order.shape[0]
+    order = np.ascontiguousarray(order, dtype=np.int64)
+    u = np.ascontiguousarray(u, dtype=np.int64)
+    v = np.ascontiguousarray(v, dtype=np.int64)
+    _i32p = ctypes.POINTER(ctypes.c_int32)
+    ra = np.empty(size_pad, dtype=np.int32)
+    rb = np.empty(size_pad, dtype=np.int32)
+    lib.rank_endpoints_i32(
+        m, size_pad, _ptr(order), _ptr(u), _ptr(v),
+        ra.ctypes.data_as(_i32p), rb.ctypes.data_as(_i32p),
+    )
+    return ra, rb
 
 
 def first_rank_native(num_nodes: int, ra: np.ndarray, rb: np.ndarray) -> np.ndarray:

@@ -515,9 +515,12 @@ def solve_graph_rank_sharded(
         fragment, mst, fa, fb, stats = head(vmin0, parent1, ra, rb)
         lv, total, cmax = (int(x) for x in jax.device_get(stats))
     if on_chunk is not None and initial_state is None:
-        mst_now = mst
+        # Bind the buffer per-site (default arg): the hook sites share this
+        # function scope, and a late-binding closure over a rebound local
+        # would silently hand a held mask_fn a LATER level's mask.
         on_chunk(
-            lv, fragment, lambda: _full_mask_host(mesh, mst_now, m_pad), total
+            lv, fragment,
+            lambda mst_=mst: _full_mask_host(mesh, mst_, m_pad), total,
         )
     # Capacity guard before the finish: shrink the alive set with in-place
     # sharded levels while the would-be gathered width exceeds the budget.
@@ -535,10 +538,9 @@ def solve_graph_rank_sharded(
         if not progressed:
             break  # isolated remainder (disconnected pads); nothing to gather
         if on_chunk is not None and guard_iters % _GUARD_CHECKPOINT_EVERY == 0:
-            mst_now = mst
             on_chunk(
                 lv, fragment,
-                lambda: _full_mask_host(mesh, mst_now, m_pad), total,
+                lambda mst_=mst: _full_mask_host(mesh, mst_, m_pad), total,
             )
     if total > 0:
         fs_local = max(_bucket_size(cmax), 1024)
@@ -546,9 +548,9 @@ def solve_graph_rank_sharded(
         fragment, mst, extra = finish(fragment, mst, fa, fb)
         lv += int(extra)
         if on_chunk is not None:
-            mst_fin = mst
             on_chunk(
-                lv, fragment, lambda: _full_mask_host(mesh, mst_fin, m_pad), 0
+                lv, fragment,
+                lambda mst_=mst: _full_mask_host(mesh, mst_, m_pad), 0,
             )
     if jax.process_count() > 1:
         # One packed all-gather makes the rank-block-sharded mask

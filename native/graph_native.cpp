@@ -195,6 +195,37 @@ void first_rank(int64_t n, int64_t m, const int64_t* ra, const int64_t* rb,
   }
 }
 
+// int32 variant over already-built rank endpoints (the prep fast path reuses
+// the padded ra/rb it just produced instead of re-gathering from u/v).
+void first_rank_i32(int64_t n, int64_t m, const int32_t* ra, const int32_t* rb,
+                    int32_t* out) {
+  const int32_t kMax = 0x7fffffff;
+  for (int64_t v = 0; v < n; ++v) out[v] = kMax;
+  for (int64_t r = 0; r < m; ++r) {
+    if (out[ra[r]] == kMax) out[ra[r]] = (int32_t)r;
+    if (out[rb[r]] == kMax) out[rb[r]] = (int32_t)r;
+  }
+}
+
+// Fused rank-endpoint build: ra[r] = (int32)u[order[r]], rb likewise, with the
+// tail zero-padded to size_pad. One pass, int32 writes — replaces two int64
+// NumPy fancy-gathers plus casts plus pad copies (the pre-transfer critical
+// path of prep: the ra/rb staging cannot start before these arrays exist).
+void rank_endpoints_i32(int64_t m, int64_t size_pad, const int64_t* order,
+                        const int64_t* u, const int64_t* v, int32_t* ra,
+                        int32_t* rb) {
+#pragma omp parallel for schedule(static)
+  for (int64_t r = 0; r < m; ++r) {
+    const int64_t e = order[r];
+    ra[r] = (int32_t)u[e];
+    rb[r] = (int32_t)v[e];
+  }
+  if (size_pad > m) {
+    std::memset(ra + m, 0, (size_t)(size_pad - m) * sizeof(int32_t));
+    std::memset(rb + m, 0, (size_t)(size_pad - m) * sizeof(int32_t));
+  }
+}
+
 // Stable counting sort of edge ids by integer weight (ranks ascending by
 // (weight, edge id)) for small weight ranges — the lexsort that dominates
 // host prep at RMAT-24 scale becomes O(m + range).
