@@ -301,6 +301,32 @@ class Graph:
         return out
 
     @functools.cached_property
+    def first_ranks64(self) -> np.ndarray:
+        """:attr:`first_ranks` with int64 ranks and an INT64_MAX isolated
+        sentinel — for the sharded ``rank64`` path, whose rank space can
+        exceed 2^31 (ranks are positions in the (weight, edge id) order, so
+        they outgrow int32 long before vertex ids do)."""
+        int64_max = np.iinfo(np.int64).max
+        m = self.num_edges
+        order = self._rank_order
+        ra = self.u[order]
+        rb = self.v[order]
+        try:
+            from distributed_ghs_implementation_tpu.graphs import native
+
+            if native.native_available():
+                return native.first_rank64_native(self.num_nodes, ra, rb)
+        except Exception:  # noqa: BLE001
+            pass
+        arr = np.empty(2 * m, dtype=np.int64)
+        arr[0::2] = ra
+        arr[1::2] = rb
+        verts, first_pos = np.unique(arr, return_index=True)
+        out = np.full(self.num_nodes, int64_max, dtype=np.int64)
+        out[verts] = first_pos // 2
+        return out
+
+    @functools.cached_property
     def ell_buckets(self):
         """Degree-bucketed ELL layout for the dense-reduction kernel.
 

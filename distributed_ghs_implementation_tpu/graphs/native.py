@@ -92,6 +92,10 @@ def get_lib() -> Optional[ctypes.CDLL]:
             ctypes.c_int64, ctypes.c_int64, _I32, _I32, _I32,
         ]
         lib.first_rank_i32.restype = None
+        lib.first_rank64.argtypes = [
+            ctypes.c_int64, ctypes.c_int64, _I64, _I64, _I64,
+        ]
+        lib.first_rank64.restype = None
         lib.rank_endpoints_i32.argtypes = [
             ctypes.c_int64, ctypes.c_int64, _I64, _I64, _I64, _I32, _I32,
         ]
@@ -174,6 +178,21 @@ def build_rank_csr_native(
     lib.build_rank_csr(num_nodes, m, _ptr(u), _ptr(v), _ptr(rank),
                        _ptr(indptr), _ptr(adj_dst), _ptr(adj_rank))
     return indptr, adj_dst, adj_rank
+
+
+def first_rank64_native(
+    num_nodes: int, ra: np.ndarray, rb: np.ndarray
+) -> np.ndarray:
+    """:func:`first_rank_native` with int64 rank output (INT64_MAX when
+    isolated) — the rank64 regime, where rank ids exceed int32."""
+    lib = get_lib()
+    if lib is None:
+        raise RuntimeError("native library unavailable")
+    ra = np.ascontiguousarray(ra, dtype=np.int64)
+    rb = np.ascontiguousarray(rb, dtype=np.int64)
+    out = np.empty(num_nodes, dtype=np.int64)
+    lib.first_rank64(num_nodes, ra.shape[0], _ptr(ra), _ptr(rb), _ptr(out))
+    return out
 
 
 def first_rank_i32_native(

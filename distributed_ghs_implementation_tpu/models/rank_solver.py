@@ -102,7 +102,9 @@ def host_level1(vmin0: np.ndarray, ra: np.ndarray, rb: np.ndarray) -> np.ndarray
     """
     n = vmin0.shape[0]
     ids = np.arange(n, dtype=np.int32)
-    has1 = vmin0 < INT32_MAX
+    # Sentinel follows the dtype: int32 vmin0 uses INT32_MAX, the sharded
+    # rank64 path stages int64 vmin0 with an INT64_MAX sentinel.
+    has1 = vmin0 < np.iinfo(vmin0.dtype).max
     safe1 = np.where(has1, vmin0, 0)
     a = ra[safe1]
     b = rb[safe1]
@@ -406,16 +408,24 @@ _INT32_RANK_LIMIT = 1 << 31
 def check_rank_envelope(n_pad: int, m_pad: int) -> None:
     """Fail fast — at staging, with the ceiling in the message — instead of
     somewhere deep in the level loop with an overflow-corrupted index.
-    Sharding does not lift this: global rank ids stay int32 on every shard;
-    past-2^31 ranks would need an int64 rank space (unsupported)."""
+
+    This guards the SINGLE-CHIP int32 paths. Past 2^31 ranks the sharded
+    path lifts the envelope with int64 rank keys
+    (``solve_graph_rank_sharded(..., rank64=True)``, auto-enabled at
+    2^31 padded ranks) — keys go int64 on n-sized and survivor-sized
+    arrays only; the edge-sized ``ra``/``rb`` hold vertex ids and stay
+    int32. Per-chip HBM math and the pod ceiling live in docs/SCALING.md
+    ("Past int32"). Vertex counts past 2^31 stay unsupported everywhere."""
     if m_pad >= _INT32_RANK_LIMIT or n_pad >= _INT32_RANK_LIMIT:
         raise ValueError(
             f"graph exceeds the int32 rank envelope: padded sizes "
             f"(nodes {n_pad:,}, ranks {m_pad:,}) must stay below 2^31 = "
-            f"{_INT32_RANK_LIMIT:,}. The measured ceiling is RMAT-26 "
-            f"(~1.05B edges, 2^30 padded ranks); beyond it rank ids no "
-            f"longer index as int32 and the resident rank endpoints alone "
-            f"(8 bytes/rank) exceed a 16 GB chip."
+            f"{_INT32_RANK_LIMIT:,}. The measured single-chip ceiling is "
+            f"RMAT-26 (~1.05B edges, 2^30 padded ranks). Past it, use the "
+            f"mesh path — solve_graph_rank_sharded enables int64 rank "
+            f"keys (rank64) automatically at 2^31 padded ranks; see "
+            f"docs/SCALING.md 'Past int32' for the per-chip HBM budget "
+            f"and the pod-scale ceiling."
         )
 
 

@@ -48,6 +48,7 @@ from distributed_ghs_implementation_tpu.models.rank_solver import (
     _CENSUS_MIN_SPACE,
     _compact_slots,
     _finish_to_fixpoint,
+    _INT32_RANK_LIMIT,
     _level_core,
     _moe_over,
     _pick_family,
@@ -93,6 +94,156 @@ def _sharded_l1_marks(vmin0, mb, k):
     )
 
 
+# ---------------------------------------------------------------------------
+# Split-key (shard, local) rank space — the 2^31+ global-rank regime.
+#
+# Global rank ids outgrow int32 one scale step past RMAT-26, but the block
+# sharding already factors every global rank as k * mb + local with
+# local < mb < 2^31 — so the TOTAL ORDER is the lexicographic order on the
+# int32 pair (shard, local), and no int64 ever needs to touch the device.
+# The one place global ranks are compared across shards (the MOE combine)
+# becomes two sequential int32 pmins: the minimum rank lives in the
+# SMALLEST shard id holding any candidate (blocks partition the order), so
+#   kmin = pmin(k | shard has a candidate)
+#   lmin = pmin(local_moe | k == kmin).
+# Everything else (marks, owner lookups, survivor cranks) is local or
+# derives the shard from position. Measured negative that forces this
+# design: s64 cross-replica reductions do not lower on TPU at all
+# ("Supported lowering only of Sum all reduce" — the int64-key variant
+# fails to compile), and s64 would have doubled the n-sized residents.
+# ---------------------------------------------------------------------------
+
+
+def _sharded_l1_marks_kl(vk, vl, mb, k):
+    """Split-key level-1 marks: vertex ``v``'s min incident rank lives at
+    shard ``vk[v]``, local offset ``vl[v]`` (``vk == INT32_MAX`` when
+    isolated — never equal to a real shard id)."""
+    mine1 = vk == k
+    return jnp.zeros(mb, bool).at[jnp.where(mine1, vl, mb)].max(
+        mine1, mode="drop"
+    )
+
+
+def _combine_kl(local_moe, k, axis):
+    """Lexicographic-min combine of per-shard local MOEs -> global
+    ``(kmin, lmin)`` per fragment, as two int32 pmins."""
+    has_local = local_moe < INT32_MAX
+    kmin = jax.lax.pmin(jnp.where(has_local, k, INT32_MAX), axis)
+    lmin = jax.lax.pmin(
+        jnp.where(has_local & (kmin == k), local_moe, INT32_MAX), axis
+    )
+    return kmin, lmin
+
+
+def _owner_lookup_kl(table, kmin, lmin, has, k, axis):
+    """Split-key owner gather: the shard whose id matches ``kmin`` proposes
+    ``table[lmin]``; pmin selects (table values are vertex ids, int32)."""
+    mine = has & (kmin == k)
+    li = jnp.where(mine, lmin, 0)
+    return jax.lax.pmin(jnp.where(mine, table[li], INT32_MAX), axis), mine, li
+
+
+def _moe_int32(fa, fb, k, mb, n):
+    """MOE strategy, int32 global ranks: segment_min over global slot keys,
+    one pmin combine, owner lookup by rank-block subtraction. Returns
+    ``(has, mine, li, wa, wb)``."""
+    gslot = k * mb + jnp.arange(mb, dtype=jnp.int32)
+    key = jnp.where(fa != fb, gslot, INT32_MAX)
+    moe = jax.lax.pmin(_moe_over(fa, fb, key, n), EDGE_AXIS)
+    has = moe < INT32_MAX
+    wa, mine, li = _owner_lookup(fa, moe, has, k, mb, EDGE_AXIS)
+    wb, _, _ = _owner_lookup(fb, moe, has, k, mb, EDGE_AXIS)
+    return has, mine, li, wa, wb
+
+
+def _moe_kl(fa, fb, k, mb, n):
+    """MOE strategy, split keys: segment_min over LOCAL slot keys, the
+    two-pmin lexicographic combine, split-key owner lookup. Same contract
+    as :func:`_moe_int32`."""
+    lslot = jnp.arange(mb, dtype=jnp.int32)
+    key = jnp.where(fa != fb, lslot, INT32_MAX)
+    local_moe = _moe_over(fa, fb, key, n)
+    kmin, lmin = _combine_kl(local_moe, k, EDGE_AXIS)
+    has = kmin < INT32_MAX
+    wa, mine, li = _owner_lookup_kl(fa, kmin, lmin, has, k, EDGE_AXIS)
+    wb, _, _ = _owner_lookup_kl(fb, kmin, lmin, has, k, EDGE_AXIS)
+    return has, mine, li, wa, wb
+
+
+def _sharded_moe_level(fragment, mst, fa, fb, k, n, moe_fn):
+    """One hook level over relabeled sharded endpoints — the shared body of
+    the int32 and split-key programs; ``moe_fn`` is the only difference.
+    Returns ``(fragment, mst, fa, fb, has)``."""
+    mb = fa.shape[0]
+    ids = jnp.arange(n, dtype=jnp.int32)
+    has, mine, li, wa, wb = moe_fn(fa, fb, k, mb, n)
+    dst = jnp.where(has, jnp.where(wa == ids, wb, wa), ids)
+    fragment, parent = hook_and_compress(has, dst, fragment)
+    mst = mst.at[jnp.where(mine, li, mb)].max(mine, mode="drop")
+    return fragment, mst, parent[fa], parent[fb], has
+
+
+def _rank_sharded_head_kl(vk, vl, parent1, ra, rb):
+    """Split-key per-shard head: levels 1-2 with all-int32 device state.
+    Same contract as ``_rank_sharded_head``."""
+    n = vk.shape[0]
+    mb = ra.shape[0]
+    k = jax.lax.axis_index(EDGE_AXIS).astype(jnp.int32)
+
+    fragment = parent1
+    has1 = vk < INT32_MAX
+    mst = _sharded_l1_marks_kl(vk, vl, mb, k)
+    fa = parent1[ra]
+    fb = parent1[rb]
+    fragment, mst, fa, fb, has2 = _sharded_moe_level(
+        fragment, mst, fa, fb, k, n, _moe_kl
+    )
+
+    lv = jnp.any(has1).astype(jnp.int32) + jnp.any(has2).astype(jnp.int32)
+    local_alive = jnp.sum((fa != fb).astype(jnp.int32))
+    total = jax.lax.psum(local_alive, EDGE_AXIS)
+    cmax = jax.lax.pmax(local_alive, EDGE_AXIS)
+    return fragment, mst, fa, fb, jnp.stack([lv, total, cmax])
+
+
+def _rank_sharded_finish_kl(
+    fragment, mst, fa, fb, *, fs_local: int, max_levels: int
+):
+    """Split-key variant of ``_rank_sharded_finish``: survivor cranks carry
+    LOCAL offsets only; the owning shard of a gathered slot is its block
+    position (``slot // fs_local`` — tiled all_gather concatenates shard
+    blocks in axis order), so global ranks never materialize."""
+    n = fragment.shape[0]
+    mb = fa.shape[0]
+    k = jax.lax.axis_index(EDGE_AXIS).astype(jnp.int32)
+    crank_local = jnp.arange(mb, dtype=jnp.int32)
+    cfa, cfb, crank, _ = _compact_slots(fa, fb, crank_local, fs_local)
+    gfa = jax.lax.all_gather(cfa, EDGE_AXIS, tiled=True)
+    gfb = jax.lax.all_gather(cfb, EDGE_AXIS, tiled=True)
+    gcrank = jax.lax.all_gather(crank, EDGE_AXIS, tiled=True)
+    # Gathered-slot order = (shard block, local compact position) =
+    # ascending global rank among valid entries: a valid tie-break.
+    cslot = jnp.arange(gfa.shape[0], dtype=jnp.int32)
+
+    def cond(s):
+        return s[4] & (s[5] < max_levels)
+
+    def body(s):
+        fragment, mst, gfa, gfb, _, lv = s
+        key = jnp.where(gfa != gfb, cslot, INT32_MAX)
+        fragment, parent, has, safe = _level_core(fragment, gfa, gfb, key, n)
+        owner = safe // fs_local
+        winners = gcrank[safe]
+        mine = has & (owner == k)
+        mst = mst.at[jnp.where(mine, winners, mb)].max(mine, mode="drop")
+        return (fragment, mst, parent[gfa], parent[gfb], jnp.any(has), lv + 1)
+
+    alive = jnp.sum((gfa != gfb).astype(jnp.int32)) > 0
+    state = (fragment, mst, gfa, gfb, alive, jnp.zeros((), jnp.int32))
+    fragment, mst, _, _, _, lv = jax.lax.while_loop(cond, body, state)
+    return fragment, mst, lv
+
+
 def _rank_sharded_head(vmin0, parent1, ra, rb):
     """Per-shard body: levels 1-2 (level-1 partition host-precomputed).
     Returns ``(fragment, mst_local, fa, fb, stats)`` with ``stats =
@@ -100,28 +251,18 @@ def _rank_sharded_head(vmin0, parent1, ra, rb):
     n = vmin0.shape[0]
     mb = ra.shape[0]
     k = jax.lax.axis_index(EDGE_AXIS).astype(jnp.int32)
-    ids = jnp.arange(n, dtype=jnp.int32)
 
     fragment = parent1
     has1 = vmin0 < INT32_MAX
     mst = _sharded_l1_marks(vmin0, mb, k)
 
-    # ---- Relabel the local rank block (the sharded edge-sized work).
+    # ---- Relabel the local rank block (the sharded edge-sized work),
+    # then level 2: per-shard segment_min + one pmin combine.
     fa = parent1[ra]
     fb = parent1[rb]
-
-    # ---- Level 2: per-shard segment_min + one pmin combine.
-    gslot = k * mb + jnp.arange(mb, dtype=jnp.int32)
-    key = jnp.where(fa != fb, gslot, INT32_MAX)
-    moe = jax.lax.pmin(_moe_over(fa, fb, key, n), EDGE_AXIS)
-    has2 = moe < INT32_MAX
-    wa, mine2, li2 = _owner_lookup(fa, moe, has2, k, mb, EDGE_AXIS)
-    wb, _, _ = _owner_lookup(fb, moe, has2, k, mb, EDGE_AXIS)
-    dst2 = jnp.where(has2, jnp.where(wa == ids, wb, wa), ids)
-    fragment, parent2 = hook_and_compress(has2, dst2, fragment)
-    mst = mst.at[jnp.where(mine2, li2, mb)].max(mine2, mode="drop")
-    fa = parent2[fa]
-    fb = parent2[fb]
+    fragment, mst, fa, fb, has2 = _sharded_moe_level(
+        fragment, mst, fa, fb, k, n, _moe_int32
+    )
 
     lv = jnp.any(has1).astype(jnp.int32) + jnp.any(has2).astype(jnp.int32)
     local_alive = jnp.sum((fa != fb).astype(jnp.int32))
@@ -199,29 +340,19 @@ def _rank_resume_relabel(fragment, ra, rb):
     return fa, fb, jnp.stack([total, cmax])
 
 
-def _rank_sharded_level(fragment, mst, fa, fb):
+def _rank_sharded_level(fragment, mst, fa, fb, *, moe_fn=_moe_int32):
     """Per-shard body: ONE Borůvka level over already-relabeled sharded
-    endpoints, in place (per-shard ``segment_min`` + one n-sized ``pmin``,
+    endpoints, in place (per-shard ``segment_min`` + pmin combine,
     endpoints stay block-sharded — no survivor gather). Used when the alive
     set is still too wide for the compact/all-gather finish: each level
     at least halves the fragment count, so a few of these bring any state
-    under the gather budget. Returns updated state + ``[total, cmax,
-    progressed]``."""
+    under the gather budget. ``moe_fn`` selects the int32 or split-key MOE
+    strategy. Returns updated state + ``[total, cmax, progressed]``."""
     n = fragment.shape[0]
-    mb = fa.shape[0]
     k = jax.lax.axis_index(EDGE_AXIS).astype(jnp.int32)
-    ids = jnp.arange(n, dtype=jnp.int32)
-    gslot = k * mb + jnp.arange(mb, dtype=jnp.int32)
-    key = jnp.where(fa != fb, gslot, INT32_MAX)
-    moe = jax.lax.pmin(_moe_over(fa, fb, key, n), EDGE_AXIS)
-    has = moe < INT32_MAX
-    wa, mine, li = _owner_lookup(fa, moe, has, k, mb, EDGE_AXIS)
-    wb, _, _ = _owner_lookup(fb, moe, has, k, mb, EDGE_AXIS)
-    dst = jnp.where(has, jnp.where(wa == ids, wb, wa), ids)
-    fragment, parent = hook_and_compress(has, dst, fragment)
-    mst = mst.at[jnp.where(mine, li, mb)].max(mine, mode="drop")
-    fa = parent[fa]
-    fb = parent[fb]
+    fragment, mst, fa, fb, has = _sharded_moe_level(
+        fragment, mst, fa, fb, k, n, moe_fn
+    )
     local_alive = jnp.sum((fa != fb).astype(jnp.int32))
     total = jax.lax.psum(local_alive, EDGE_AXIS)
     cmax = jax.lax.pmax(local_alive, EDGE_AXIS)
@@ -336,11 +467,25 @@ def make_rank_resume_relabel(mesh: Mesh):
 
 
 @functools.lru_cache(maxsize=32)
-def make_rank_sharded_level(mesh: Mesh):
+def make_rank_sharded_level(mesh: Mesh, rank64: bool = False):
+    fn = functools.partial(
+        _rank_sharded_level, moe_fn=_moe_kl if rank64 else _moe_int32
+    )
     mapped = shard_map_compat(
-        _rank_sharded_level,
+        fn,
         mesh,
         in_specs=(P(), P(EDGE_AXIS), P(EDGE_AXIS), P(EDGE_AXIS)),
+        out_specs=(P(), P(EDGE_AXIS), P(EDGE_AXIS), P(EDGE_AXIS), P()),
+    )
+    return jax.jit(mapped)
+
+
+@functools.lru_cache(maxsize=32)
+def make_rank_sharded_head_kl(mesh: Mesh):
+    mapped = shard_map_compat(
+        _rank_sharded_head_kl,
+        mesh,
+        in_specs=(P(), P(), P(), P(EDGE_AXIS), P(EDGE_AXIS)),
         out_specs=(P(), P(EDGE_AXIS), P(EDGE_AXIS), P(EDGE_AXIS), P()),
     )
     return jax.jit(mapped)
@@ -382,9 +527,12 @@ def make_rank_sharded_head(mesh: Mesh):
 
 
 @functools.lru_cache(maxsize=64)
-def make_rank_sharded_finish(mesh: Mesh, fs_local: int, max_levels: int):
+def make_rank_sharded_finish(
+    mesh: Mesh, fs_local: int, max_levels: int, rank64: bool = False
+):
     fn = functools.partial(
-        _rank_sharded_finish, fs_local=fs_local, max_levels=max_levels
+        _rank_sharded_finish_kl if rank64 else _rank_sharded_finish,
+        fs_local=fs_local, max_levels=max_levels,
     )
     mapped = shard_map_compat(
         fn,
@@ -402,6 +550,7 @@ def solve_graph_rank_sharded(
     filtered: bool | None = None,
     on_chunk=None,
     initial_state: tuple | None = None,
+    rank64: bool | None = None,
 ) -> Tuple[np.ndarray, np.ndarray, int]:
     """Host entry mirroring ``solve_graph_rank`` on a device mesh.
 
@@ -432,6 +581,20 @@ def solve_graph_rank_sharded(
     a checkpoint — exact from any saved partition: the local rank blocks are
     relabeled against the restored partition (two local gathers per shard)
     and the survivors run through the normal compact/all-gather finish.
+
+    ``rank64`` lifts the int32 rank envelope on this path with SPLIT KEYS:
+    every global rank is ``k * mb + local`` under the block sharding, so
+    rank state ships as int32 ``(shard, local)`` pairs and the cross-shard
+    MOE combine becomes two sequential int32 pmins (blocks partition the
+    total order, so the min rank lives in the smallest shard id holding a
+    candidate). No int64 touches the device — s64 cross-replica
+    reductions do not lower on TPU at all (measured; see docs/SCALING.md
+    "Past int32") — and the memory footprint is unchanged. Auto-enabled
+    when the padded rank space reaches 2^31 (the regime the single-chip
+    path refuses); force ``True`` to exercise the split-key program at
+    test widths. Routes through the plain path (``filtered=False`` — the
+    filter split brings no benefit once the suffix no longer fits a chip
+    anyway; the chunked single-chip filter covers up to the envelope).
     """
     if mesh is None:
         mesh = edge_mesh()
@@ -445,19 +608,66 @@ def solve_graph_rank_sharded(
     # byte blocks concatenate into a global packbits (pad slots are inert).
     unit = 8 * n_dev
     m_pad = int(math.ceil(_bucket_size(graph.num_edges) / unit) * unit)
-    check_rank_envelope(n_pad, m_pad)
+    if rank64 is None:
+        rank64 = m_pad >= _INT32_RANK_LIMIT
+    mb = m_pad // n_dev
+    if rank64:
+        # Vertex ids must still index int32 (2^31 vertices is out of scope
+        # for any projected pod); only the rank space is lifted — and the
+        # PER-SHARD block must itself stay under 2^31 (local slot iotas
+        # and offsets are int32).
+        check_rank_envelope(n_pad, 0)
+        if mb >= _INT32_RANK_LIMIT:
+            raise ValueError(
+                f"split-key rank64 needs the per-shard rank block below "
+                f"2^31: {m_pad:,} ranks over {n_dev} device(s) gives "
+                f"mb = {mb:,}. Use a mesh with more devices."
+            )
+        filtered = False
+    else:
+        check_rank_envelope(n_pad, m_pad)
     int32_max = np.iinfo(np.int32).max
-    vmin0_np = np.full(n_pad, int32_max, dtype=np.int32)
-    vmin0_np[:n] = graph.first_ranks
     ra_np, rb_np = graph.rank_endpoints(pad_to=m_pad)
-    parent1_np = host_level1(vmin0_np, ra_np, rb_np)
 
     rep = NamedSharding(mesh, P())
     blk = NamedSharding(mesh, P(EDGE_AXIS))
-    vmin0 = _stage(vmin0_np, rep)
-    parent1 = _stage(parent1_np, rep)
     ra = _stage(ra_np, blk)
     rb = _stage(rb_np, blk)
+    if initial_state is None:
+        # Fresh solve: build the level-1 inputs. A resume never reads them
+        # (the restored partition replaces parent1 and the marks), and at
+        # the rank64 regime first_ranks64 + host_level1 are two O(m) host
+        # passes worth skipping.
+        if rank64:
+            # Host-side rank ids are int64; the device sees only the int32
+            # split keys (shard, local) derived from them.
+            int64_max = np.iinfo(np.int64).max
+            vmin0_np = np.full(n_pad, int64_max, dtype=np.int64)
+            if m_pad >= _INT32_RANK_LIMIT:
+                vmin0_np[:n] = graph.first_ranks64
+            else:
+                # Forced-small validation: widen the int32 first_ranks,
+                # remapping the isolated-vertex sentinel.
+                fr = graph.first_ranks.astype(np.int64)
+                vmin0_np[:n] = np.where(fr == int32_max, int64_max, fr)
+        else:
+            vmin0_np = np.full(n_pad, int32_max, dtype=np.int32)
+            vmin0_np[:n] = graph.first_ranks
+        parent1_np = host_level1(vmin0_np, ra_np, rb_np)
+        parent1 = _stage(parent1_np, rep)
+        if rank64:
+            isolated = vmin0_np == np.iinfo(np.int64).max
+            vk = _stage(
+                np.where(isolated, int32_max, vmin0_np // mb).astype(
+                    np.int32
+                ),
+                rep,
+            )
+            vl = _stage(
+                np.where(isolated, 0, vmin0_np % mb).astype(np.int32), rep
+            )
+        else:
+            vmin0 = _stage(vmin0_np, rep)
 
     prefix = _prefix_size(n_pad, m_pad, mult=1)  # tuned staged default
     if filtered is None:
@@ -510,6 +720,10 @@ def solve_graph_rank_sharded(
         filt = make_rank_filter_relabel(mesh, prefix)
         mst, fa, fb, fstats = filt(fragment, mst_p, mst, ra, rb)
         total, cmax = (int(x) for x in jax.device_get(fstats))
+    elif rank64:
+        head = make_rank_sharded_head_kl(mesh)
+        fragment, mst, fa, fb, stats = head(vk, vl, parent1, ra, rb)
+        lv, total, cmax = (int(x) for x in jax.device_get(stats))
     else:
         head = make_rank_sharded_head(mesh)
         fragment, mst, fa, fb, stats = head(vmin0, parent1, ra, rb)
@@ -530,7 +744,7 @@ def solve_graph_rank_sharded(
     # harvest inside mask_fn is a collective).
     guard_iters = 0
     while total > 0 and n_dev * _bucket_size(cmax) > _FINISH_GATHER_MAX_SLOTS:
-        level_fn = make_rank_sharded_level(mesh)
+        level_fn = make_rank_sharded_level(mesh, rank64)
         fragment, mst, fa, fb, lstats = level_fn(fragment, mst, fa, fb)
         total, cmax, progressed = (int(x) for x in jax.device_get(lstats))
         lv += 1
@@ -544,7 +758,9 @@ def solve_graph_rank_sharded(
             )
     if total > 0:
         fs_local = max(_bucket_size(cmax), 1024)
-        finish = make_rank_sharded_finish(mesh, fs_local, _max_levels(n_pad))
+        finish = make_rank_sharded_finish(
+            mesh, fs_local, _max_levels(n_pad), rank64
+        )
         fragment, mst, extra = finish(fragment, mst, fa, fb)
         lv += int(extra)
         if on_chunk is not None:

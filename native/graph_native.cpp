@@ -195,6 +195,18 @@ void first_rank(int64_t n, int64_t m, const int64_t* ra, const int64_t* rb,
   }
 }
 
+// int64-rank variant for the sharded rank64 path (rank spaces past 2^31;
+// the int32 first_rank's (int32_t)r cast would overflow there).
+void first_rank64(int64_t n, int64_t m, const int64_t* ra, const int64_t* rb,
+                  int64_t* out) {
+  const int64_t kMax = 0x7fffffffffffffffLL;
+  for (int64_t v = 0; v < n; ++v) out[v] = kMax;
+  for (int64_t r = 0; r < m; ++r) {
+    if (out[ra[r]] == kMax) out[ra[r]] = r;
+    if (out[rb[r]] == kMax) out[rb[r]] = r;
+  }
+}
+
 // int32 variant over already-built rank endpoints (the prep fast path reuses
 // the padded ra/rb it just produced instead of re-gathering from u/v).
 void first_rank_i32(int64_t n, int64_t m, const int32_t* ra, const int32_t* rb,

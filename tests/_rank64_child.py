@@ -1,0 +1,86 @@
+"""Child process for the rank64 (split-key) validation test.
+
+Runs in its own interpreter so the forced virtual-CPU device count can't
+collide with the suite's backend state. Validates, on the virtual 8-device
+CPU mesh at forced-small width:
+
+  * the split-key plain sharded path (``rank64=True``) lands byte-identical
+    to the int32 sharded path and the single-chip rank solve, on a dense
+    RMAT graph, a high-diameter grid, and a thinned (disconnected) grid;
+  * the capacity-guard loop under split keys (tiny gather budget);
+  * ``first_ranks64`` agrees with ``first_ranks`` under sentinel remap.
+
+The device program is all-int32 (ranks travel as (shard, local) pairs), so
+no x64 flag is involved — the same program that runs at 2^31+ ranks runs
+here, only with smaller shard ids and offsets. Exits 0 on success.
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+os.environ["XLA_FLAGS"] = (
+    os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
+)
+
+import numpy as np  # noqa: E402
+
+
+def main() -> int:
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    from distributed_ghs_implementation_tpu.graphs.generators import (
+        rmat_graph,
+        road_grid_graph,
+    )
+    from distributed_ghs_implementation_tpu.models.rank_solver import (
+        solve_graph_rank,
+    )
+    from distributed_ghs_implementation_tpu.parallel import rank_sharded as rsh
+
+    assert len(jax.devices()) == 8, jax.devices()
+
+    for g, name in (
+        (rmat_graph(11, 16, seed=9), "rmat11"),
+        (road_grid_graph(40, 40, seed=9), "grid40"),
+        (road_grid_graph(32, 32, seed=3, keep_prob=0.7), "sparse-forest"),
+    ):
+        ref, ref_frag, _ = solve_graph_rank(g)
+        ids32, _, _ = rsh.solve_graph_rank_sharded(g, rank64=False)
+        ids64, frag64, _ = rsh.solve_graph_rank_sharded(g, rank64=True)
+        assert np.array_equal(ids64, ref), f"{name}: rank64 != single-chip"
+        assert np.array_equal(ids64, ids32), f"{name}: rank64 != rank32"
+        assert np.unique(frag64).size == np.unique(ref_frag).size, name
+
+    # Capacity-guard loop under split keys (in-place sharded levels).
+    rsh._FINISH_GATHER_MAX_SLOTS = 64
+    g = road_grid_graph(40, 40, seed=9)
+    ref, _, _ = solve_graph_rank(g)
+    ids, _, _ = rsh.solve_graph_rank_sharded(g, rank64=True)
+    assert np.array_equal(ids, ref), "rank64 capacity-guard diverged"
+
+    # first_ranks64 == first_ranks with the sentinel remapped (isolated
+    # vertices present: num_nodes exceeds the largest endpoint).
+    from distributed_ghs_implementation_tpu.graphs.edgelist import Graph
+
+    gi = Graph.from_arrays(
+        12,
+        np.array([0, 1, 5, 3]),
+        np.array([1, 2, 6, 5]),
+        np.array([4, 1, 9, 2]),
+    )
+    fr32 = gi.first_ranks.astype(np.int64)
+    fr32 = np.where(
+        fr32 == np.iinfo(np.int32).max, np.iinfo(np.int64).max, fr32
+    )
+    assert np.array_equal(gi.first_ranks64, fr32), "first_ranks64 mismatch"
+
+    print("rank64 child ok")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -468,9 +468,9 @@ def test_sharded_resume_capacity_guard(tmp_path, monkeypatch):
     used = []
     orig = rsh.make_rank_sharded_level
 
-    def spying(mesh):
+    def spying(mesh, rank64=False):
         used.append(1)
-        return orig(mesh)
+        return orig(mesh, rank64)
 
     monkeypatch.setattr(rsh, "make_rank_sharded_level", spying)
     monkeypatch.setattr(rsh, "_FINISH_GATHER_MAX_SLOTS", 64)
