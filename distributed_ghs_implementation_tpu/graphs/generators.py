@@ -272,6 +272,7 @@ def random_road_network(
     axis_prob: float = 0.53,
     diag_prob: float = 0.12,
     weight_scale: int = 1000,
+    dead_end_prob: float = 0.0,
 ) -> Graph:
     """Random planar-ish road network — the NON-grid stand-in for BASELINE
     config 5 (USA-road; the real DIMACS file is not obtainable offline, the
@@ -290,6 +291,14 @@ def random_road_network(
     defaults, matching USA-road's ~2.4 (58.3M directed arcs / 23.9M
     nodes); isolated cells come out as singleton components (the solver
     returns the spanning forest, as for any real disconnected road graph).
+
+    ``dead_end_prob`` marks that fraction of cells as dead ends: a dead
+    end keeps only its minimum-weight incident link (an edge survives iff
+    BOTH endpoints accept it). Independent Bernoulli links alone cannot
+    put real mass on degree 1 at road-like means — actual road graphs are
+    full of cul-de-sacs — so this is the knob that lets the histogram
+    matcher (``tools/match_usa_road.py``) hit a target degree-1 share,
+    not just the mean degree.
     """
     rng = np.random.default_rng(seed)
     # float32 draws throughout: every full-lattice temporary is 91 MB at the
@@ -331,6 +340,23 @@ def random_road_network(
     u = np.concatenate(us)
     v = np.concatenate(vs)
     w = np.concatenate(ws)
+    if dead_end_prob > 0.0 and u.size:
+        dead = rng.random(n, dtype=np.float32) < dead_end_prob
+        # Min-weight incident edge per vertex, ties broken by edge id — the
+        # (weight, edge id) pair is encoded into one int64 key below so a
+        # single order-independent minimum carries both criteria.
+        int64_max = np.iinfo(np.int64).max
+        best = np.full(n, int64_max, dtype=np.int64)
+        eid = np.arange(u.size, dtype=np.int64)
+        # Encode (weight, edge id) into one sortable key; weights are
+        # bounded by ~sqrt(2)*weight_scale so the shift is safe.
+        key = w * (eid.size + 1) + eid
+        np.minimum.at(best, u, key)
+        np.minimum.at(best, v, key)
+        keep_u = ~dead[u] | (key == best[u])
+        keep_v = ~dead[v] | (key == best[v])
+        sel = keep_u & keep_v
+        u, v, w = u[sel], v[sel], w[sel]
     return Graph.from_arrays(n, u, v, w)
 
 

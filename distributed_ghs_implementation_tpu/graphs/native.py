@@ -96,6 +96,14 @@ def get_lib() -> Optional[ctypes.CDLL]:
             ctypes.c_int64, ctypes.c_int64, _I64, _I64, _I64,
         ]
         lib.first_rank64.restype = None
+        lib.first_cross_rank.argtypes = [
+            ctypes.c_int64, ctypes.c_int64, _I32, _I32, _I32, _I32,
+        ]
+        lib.first_cross_rank.restype = None
+        lib.first_rank_i32e64.argtypes = [
+            ctypes.c_int64, ctypes.c_int64, _I32, _I32, _I64,
+        ]
+        lib.first_rank_i32e64.restype = None
         lib.rank_endpoints_i32.argtypes = [
             ctypes.c_int64, ctypes.c_int64, _I64, _I64, _I64, _I32, _I32,
         ]
@@ -192,6 +200,50 @@ def first_rank64_native(
     rb = np.ascontiguousarray(rb, dtype=np.int64)
     out = np.empty(num_nodes, dtype=np.int64)
     lib.first_rank64(num_nodes, ra.shape[0], _ptr(ra), _ptr(rb), _ptr(out))
+    return out
+
+
+def first_cross_rank_native(
+    num_nodes: int, ra: np.ndarray, rb: np.ndarray, parent1: np.ndarray
+) -> np.ndarray:
+    """Per-fragment first CROSS rank (level-2 MOE) fused with the fragment
+    relabel — host analog of the device head's full-width level 2. Pass
+    unpadded ``ra[:m]``/``rb[:m]`` views."""
+    lib = get_lib()
+    if lib is None:
+        raise RuntimeError("native library unavailable")
+    assert ra.dtype == np.int32 and ra.flags.c_contiguous
+    assert rb.dtype == np.int32 and rb.flags.c_contiguous
+    parent1 = np.ascontiguousarray(parent1, dtype=np.int32)
+    _i32p = ctypes.POINTER(ctypes.c_int32)
+    out = np.empty(num_nodes, dtype=np.int32)
+    lib.first_cross_rank(
+        num_nodes, ra.shape[0],
+        ra.ctypes.data_as(_i32p), rb.ctypes.data_as(_i32p),
+        parent1.ctypes.data_as(_i32p), out.ctypes.data_as(_i32p),
+    )
+    return out
+
+
+def first_rank_i32_out64_native(
+    num_nodes: int, ra: np.ndarray, rb: np.ndarray
+) -> np.ndarray:
+    """Per-vertex min incident rank with int64 output over int32 endpoint
+    views — the rank64 staging reuses its padded ra/rb (pass unpadded
+    ``ra[:m]`` views) instead of re-gathering int64 endpoints from u/v
+    (two O(m) int64 fancy-gathers, ~34 GB of host temporaries at the
+    RMAT-27 scale the path targets)."""
+    lib = get_lib()
+    if lib is None:
+        raise RuntimeError("native library unavailable")
+    assert ra.dtype == np.int32 and ra.flags.c_contiguous
+    assert rb.dtype == np.int32 and rb.flags.c_contiguous
+    _i32p = ctypes.POINTER(ctypes.c_int32)
+    out = np.empty(num_nodes, dtype=np.int64)
+    lib.first_rank_i32e64(
+        num_nodes, ra.shape[0],
+        ra.ctypes.data_as(_i32p), rb.ctypes.data_as(_i32p), _ptr(out),
+    )
     return out
 
 

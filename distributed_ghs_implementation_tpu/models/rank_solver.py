@@ -109,23 +109,75 @@ def host_level1(vmin0: np.ndarray, ra: np.ndarray, rb: np.ndarray) -> np.ndarray
     a = ra[safe1]
     b = rb[safe1]
     parent = np.where(has1, np.where(a == ids, b, a), ids).astype(np.int32)
+    return _host_break_and_jump(parent, "host_level1")
+
+
+def _host_break_and_jump(parent: np.ndarray, what: str) -> np.ndarray:
+    """Mutual-pair break + bounded pointer jumping — the shared tail of the
+    host level passes (bit-exact numpy replica of ``break_symmetric_hooks``
+    + ``pointer_jump``). Hook forests with the mutual pair broken converge
+    in <= ceil(log2 n)+1 jumps; malformed hook input can produce longer
+    cycles — the bound turns a host hang into a loud error. (Cycles whose
+    length divides a power of two still collapse silently under squaring:
+    this is a hang guard, not full input validation.)"""
+    n = parent.shape[0]
+    ids = np.arange(n, dtype=np.int32)
     mutual = parent[parent] == ids
     parent = np.where(mutual & (ids < parent), ids, parent)
-    # Hook forests with the mutual pair broken converge in <= ceil(log2 n)+1
-    # jumps; a vmin0 that is NOT the true per-vertex min incident rank can
-    # produce longer cycles — bound the loop so such input cannot hang the
-    # host. Cycles whose length divides a power of two still collapse
-    # silently (squaring maps them to the identity), so this is a hang
-    # guard, not full input validation.
     for _ in range(max(int(np.ceil(np.log2(max(n, 2)))) + 1, 1)):
         p2 = parent[parent]
         if np.array_equal(p2, parent):
             return parent
         parent = p2
     raise ValueError(
-        "host_level1 did not converge: vmin0 is not a per-vertex minimum "
-        "incident rank (hook graph has a cycle longer than 2)"
+        f"{what} did not converge: hook input is not a true per-vertex/"
+        f"per-fragment minimum (hook graph has a cycle longer than 2)"
     )
+
+
+def host_level2(parent1: np.ndarray, ra: np.ndarray, rb: np.ndarray, m: int):
+    """Level-2 partition computed on the HOST — one level deeper than
+    :func:`host_level1`, for the road/grid family where the full-width
+    device level 2 is the head's dominant cost (r4 bisection: the 23.9M
+    road grid spends ~9 s of 14.5 s in the L1+L2 head).
+
+    Replicates the device semantics exactly (``_level_core`` over the
+    level-1 fragment space -> ``hook_and_compress``): per-fragment first
+    CROSS rank (native fused relabel+scan; numpy fallback), hook with the
+    mutual-pair break, bounded pointer jump. Returns ``(parent12,
+    l2_ranks)`` — the composed 2-level vertex partition and the sorted
+    MST rank ids level 2 chose (for one device scatter into the mask).
+    ``m`` is the true (unpadded) edge count."""
+    n = parent1.shape[0]
+    int32_max = np.iinfo(np.int32).max
+    moe2 = None
+    try:
+        from distributed_ghs_implementation_tpu.graphs import native
+
+        if native.native_available():
+            moe2 = native.first_cross_rank_native(
+                n, ra[:m], rb[:m], parent1
+            )
+    except Exception:  # noqa: BLE001 — any native issue -> fallback
+        pass
+    if moe2 is None:
+        fa = parent1[ra[:m]]
+        fb = parent1[rb[:m]]
+        cross = np.nonzero(fa != fb)[0]
+        arr = np.empty(2 * cross.size, dtype=np.int64)
+        arr[0::2] = fa[cross]
+        arr[1::2] = fb[cross]
+        frags, first_pos = np.unique(arr, return_index=True)
+        moe2 = np.full(n, int32_max, dtype=np.int32)
+        moe2[frags] = cross[first_pos // 2].astype(np.int32)
+    has = moe2 < int32_max
+    safe = np.where(has, moe2, 0)
+    wa = parent1[ra[safe]]
+    wb = parent1[rb[safe]]
+    ids = np.arange(n, dtype=np.int32)
+    parent = np.where(has, np.where(wa == ids, wb, wa), ids).astype(np.int32)
+    parent = _host_break_and_jump(parent, "host_level2")
+    return parent[parent1], np.unique(moe2[has])
 
 
 @jax.jit
@@ -484,39 +536,37 @@ def _stage_pair_packed24(ra: np.ndarray, rb: np.ndarray):
     return _decode_planes24(jax.device_put(packed))
 
 
-def prepare_rank_arrays_full(graph: Graph):
-    """:func:`prepare_rank_arrays` plus the host-computed level-1 partition:
-    ``(vmin0, ra, rb, parent1)`` staged. The production entries pass
-    ``parent1`` to the solvers so the head starts at the relabel (the
-    r4 L1 host-precompute; :func:`host_level1`).
+def _prep_head(graph: Graph):
+    """The shared prep head of :func:`prepare_rank_arrays_full` and
+    :func:`prepare_rank_arrays_l2`: endpoints built and staged
+    transfer-first, ``vmin0`` and the level-1 partition computed UNDER the
+    transfers. Returns ``(n, m, n_pad, m_pad, ra, rb, vmin0, parent1,
+    sa, sb)`` — host arrays plus the staged (in-flight) endpoint pair.
 
-    Ordering is transfer-first (r5): the two edge-sized stagings (``ra``,
-    ``rb`` — hundreds of MB at bench scales) are dispatched the moment the
-    endpoint arrays exist, and ALL remaining host compute — ``first_ranks``
-    (reusing the just-built endpoints), ``vmin0`` assembly, the level-1
-    union-find — runs underneath them: ``jax.device_put`` is async and the
-    transfer is link-bound, not host-CPU-bound, so the overlap is ~free
-    (measured: 256 MB put returns in 0.3 s, completes in ~12 s, and 10 s of
-    host numpy under it costs +0.8 s total). The function still returns
-    only after a tiny sync fetch per array, so a caller's prep clock
-    honestly includes transfer completion."""
-    cached = graph.__dict__.get("_rank_device_cache")
-    if cached is not None:
-        return cached
+    Ordering rationale (r5): ``jax.device_put`` is async and the transfer
+    is link-bound, not host-CPU-bound, so host compute underneath is ~free
+    (measured: 256 MB put returns in 0.3 s, completes in ~12 s, and 10 s
+    of host numpy under it costs +0.8 s total). The staged endpoint pair
+    is cached on the graph so the full and l2 preps never duplicate the
+    expensive edge-sized transfer."""
     n = graph.num_nodes
     m = graph.num_edges
     n_pad = _bucket_size(n)
     m_pad = _bucket_size(m)
     check_rank_envelope(n_pad, m_pad)
     ra, rb = graph.rank_endpoints(pad_to=m_pad)
-    if n <= (1 << 24):
-        # Endpoint ids fit 24 bits: ship 3 bytes/elem and decode on device
-        # (one fused dispatch) — 25% less wire time on the two arrays that
-        # dominate prep.
-        sa, sb = _stage_pair_packed24(ra, rb)
-    else:
-        sa = jax.device_put(ra)
-        sb = jax.device_put(rb)
+    pair = graph.__dict__.get("_rank_endpoint_stage")
+    if pair is None:
+        if n <= (1 << 24):
+            # Endpoint ids fit 24 bits: ship 3 bytes/elem and decode on
+            # device (one fused dispatch) — 25% less wire time on the two
+            # arrays that dominate prep.
+            pair = _stage_pair_packed24(ra, rb)
+        else:
+            pair = (jax.device_put(ra), jax.device_put(rb))
+        if m_pad <= _STAGE_CACHE_MAX_RANKS:
+            graph.__dict__["_rank_endpoint_stage"] = pair
+    sa, sb = pair
     # --- everything below here overlaps the ra/rb transfers ---
     vmin0 = np.full(n_pad, np.iinfo(np.int32).max, dtype=np.int32)
     if "first_ranks" not in graph.__dict__ and m:
@@ -533,6 +583,21 @@ def prepare_rank_arrays_full(graph: Graph):
             pass
     vmin0[:n] = graph.first_ranks
     parent1 = host_level1(vmin0, ra, rb)
+    return n, m, n_pad, m_pad, ra, rb, vmin0, parent1, sa, sb
+
+
+def prepare_rank_arrays_full(graph: Graph):
+    """:func:`prepare_rank_arrays` plus the host-computed level-1 partition:
+    ``(vmin0, ra, rb, parent1)`` staged — see :func:`_prep_head` for the
+    transfer-overlap design. The production entries pass ``parent1`` to
+    the solvers so the head starts at the relabel (the r4 L1 host
+    precompute; :func:`host_level1`). Returns only after a tiny sync fetch
+    per array, so a caller's prep clock honestly includes transfer
+    completion."""
+    cached = graph.__dict__.get("_rank_device_cache")
+    if cached is not None:
+        return cached
+    n, m, n_pad, m_pad, ra, rb, vmin0, parent1, sa, sb = _prep_head(graph)
     sv = jax.device_put(vmin0)
     sp = jax.device_put(parent1)
     staged = (sv, sa, sb, sp)
@@ -542,6 +607,39 @@ def prepare_rank_arrays_full(graph: Graph):
         # Graph is a frozen dataclass; write the cache the way cached_property
         # does (directly into __dict__, bypassing the frozen __setattr__).
         graph.__dict__["_rank_device_cache"] = staged
+    return staged
+
+
+def prepare_rank_arrays_l2(graph: Graph):
+    """:func:`prepare_rank_arrays_full` with HOST LEVEL 2 (the road/grid
+    family fast path): ``(vmin0, ra, rb, parent12, l2_ranks)`` staged.
+
+    Same transfer-first overlap as the full prep — the extra host pass
+    (:func:`host_level2`) runs underneath the edge-sized stagings, and the
+    extra wire traffic vs the full prep is only the compacted level-2 mark
+    ranks (``parent12`` replaces ``parent1``, same bytes). Measured on the
+    23.9M-node road grid (r5): the device solve drops 14.6 -> 9.7 s
+    (byte-identical, oracle-verified) because the head's full-width level-2
+    relabel + segment_min never runs on device.
+
+    ``l2_ranks`` is padded with ``m_pad`` (out of range — dropped by the
+    head's scatter), so an empty level 2 stays correct."""
+    cached = graph.__dict__.get("_rank_device_cache_l2")
+    if cached is not None:
+        return cached
+    n, m, n_pad, m_pad, ra, rb, vmin0, parent1, sa, sb = _prep_head(graph)
+    parent12, l2r = host_level2(parent1, ra, rb, m)
+    l2_pad = _bucket_size(max(int(l2r.size), 1024))
+    l2_staged = np.full(l2_pad, m_pad, dtype=np.int32)
+    l2_staged[: l2r.size] = l2r
+    sv = jax.device_put(vmin0)
+    sp = jax.device_put(parent12)
+    sl = jax.device_put(l2_staged)
+    staged = (sv, sa, sb, sp, sl)
+    for leaf in staged:
+        _ = np.asarray(leaf[:1])  # sync: prep ends when the data is resident
+    if m_pad <= _STAGE_CACHE_MAX_RANKS:
+        graph.__dict__["_rank_device_cache_l2"] = staged
     return staged
 
 
@@ -706,6 +804,56 @@ def solve_rank_speculative(
     if count <= out_size and count2 == 0:
         return mst2, fragment2, lv + extra
     return None
+
+
+@jax.jit
+def _head_l2(vmin0, ra, rb, parent12, l2_ranks):
+    """Level-3 entry for the host-L2 prep: one relabel by the 2-level host
+    partition plus the L1+L2 mark scatters — no edge-width segment_min.
+    Returns ``(mst, fa, fb, stats)`` with ``stats = [levels, alive]``."""
+    mp = ra.shape[0]
+    fa = parent12[ra]
+    fb = parent12[rb]
+    has1 = vmin0 < INT32_MAX
+    safe1 = jnp.where(has1, vmin0, 0)
+    mst = jnp.zeros(mp, dtype=bool).at[safe1].max(has1)
+    has2 = l2_ranks < mp  # pads carry m_pad and are dropped
+    mst = mst.at[jnp.where(has2, l2_ranks, mp)].max(has2, mode="drop")
+    lv = jnp.any(has1).astype(jnp.int32) + jnp.any(has2).astype(jnp.int32)
+    count = jnp.sum((fa != fb).astype(jnp.int32))
+    return mst, fa, fb, jnp.stack([lv, count])
+
+
+def solve_rank_l2(
+    vmin0,
+    ra,
+    rb,
+    parent12,
+    l2_ranks,
+    *,
+    chunk_levels: int = 2,
+    compact_space: bool = True,
+    on_chunk=None,
+) -> Tuple[jax.Array, jax.Array, int]:
+    """Solve from the host 2-level partition (:func:`prepare_rank_arrays_l2`
+    — the road/grid family path). Bit-identical to ``solve_rank_staged``
+    (pinned by ``tests/test_aux.py::test_host_level2_matches_device_head``
+    and the family parity tests); the head becomes one relabel + two mark
+    scatters, and the first full-width segment_min never runs. Same
+    ``on_chunk`` checkpoint contract as the staged path; resume goes
+    through :func:`solve_rank_resume` (partition-based, path-agnostic)."""
+    n_pad = vmin0.shape[0]
+    m_pad = ra.shape[0]
+    mst, fa, fb, stats = _head_l2(vmin0, ra, rb, parent12, l2_ranks)
+    lv, count = (int(x) for x in jax.device_get(stats))
+    if on_chunk is not None:
+        on_chunk(lv, parent12, mst, count)
+    return _finish_to_fixpoint(
+        parent12, mst, fa, fb, jnp.arange(m_pad, dtype=jnp.int32),
+        lv=lv, count=count, space=n_pad, max_levels=lv + _max_levels(n_pad),
+        chunk_levels=chunk_levels, compact_space=compact_space,
+        on_chunk=on_chunk,
+    )
 
 
 def solve_rank_staged(
@@ -1352,13 +1500,34 @@ def fetch_mst_edge_ids(graph: Graph, mst) -> np.ndarray:
     return packed_to_edge_ids(graph, packed, w)
 
 
+def use_l2_path(family: str) -> bool:
+    """Single routing predicate for the host-L2 (level-3 device entry)
+    path — shared by ``solve_graph_rank``, the checkpoint path,
+    ``bench.py``, and the instrumented metrics, so a retune cannot route
+    production down a different kernel than the one benchmarked. Measured
+    r5 (byte-identical, oracle-verified): grid 14.6 -> 9.3 s, sparse
+    (config-5 road network) 10.1 -> 4.4 s; dense keeps filter-Kruskal
+    (its prefix already does level 2 at ~2n width)."""
+    return family in ("grid", "sparse")
+
+
 def solve_graph_rank(graph: Graph) -> Tuple[np.ndarray, np.ndarray, int]:
     """Host entry matching ``models.boruvka.solve_graph``'s contract."""
     n = graph.num_nodes
     if n == 0 or graph.num_edges == 0:
         return np.zeros(0, dtype=np.int64), np.arange(n, dtype=np.int32), 0
-    vmin0, ra, rb, parent1 = prepare_rank_arrays_full(graph)
-    mst, fragment, levels = solve_rank_auto(
-        vmin0, ra, rb, family=_pick_family(graph), parent1=parent1
-    )
+    family = _pick_family(graph)
+    if use_l2_path(family):
+        # Road families: host levels 1+2, device starts at the level-3
+        # relabel (r5 — the head's L2 work was the dominant cost on both:
+        # the 23.9M grid drops 14.6 -> 9.3 s and the config-5 road
+        # network 10.1 -> 4.4 s, byte-identical, with the host pass
+        # hidden under the staging transfer).
+        vmin0, ra, rb, parent12, l2_ranks = prepare_rank_arrays_l2(graph)
+        mst, fragment, levels = solve_rank_l2(vmin0, ra, rb, parent12, l2_ranks)
+    else:
+        vmin0, ra, rb, parent1 = prepare_rank_arrays_full(graph)
+        mst, fragment, levels = solve_rank_auto(
+            vmin0, ra, rb, family=family, parent1=parent1
+        )
     return fetch_mst_edge_ids(graph, mst), np.asarray(fragment)[:n], levels

@@ -612,11 +612,16 @@ def solve_graph_rank_sharded(
         rank64 = m_pad >= _INT32_RANK_LIMIT
     mb = m_pad // n_dev
     if rank64:
-        # Vertex ids must still index int32 (2^31 vertices is out of scope
-        # for any projected pod); only the rank space is lifted — and the
-        # PER-SHARD block must itself stay under 2^31 (local slot iotas
-        # and offsets are int32).
-        check_rank_envelope(n_pad, 0)
+        # Only the rank space is lifted: vertex ids must still index int32
+        # (2^31 vertices is out of scope for any projected pod), and the
+        # PER-SHARD block must stay under 2^31 (local slot iotas and
+        # offsets are int32).
+        if n_pad >= _INT32_RANK_LIMIT:
+            raise ValueError(
+                f"rank64 lifts only the RANK space: padded vertex count "
+                f"{n_pad:,} must stay below 2^31 (vertex ids are int32 "
+                f"everywhere; no projected pod needs more)."
+            )
         if mb >= _INT32_RANK_LIMIT:
             raise ValueError(
                 f"split-key rank64 needs the per-shard rank block below "
@@ -644,7 +649,26 @@ def solve_graph_rank_sharded(
             int64_max = np.iinfo(np.int64).max
             vmin0_np = np.full(n_pad, int64_max, dtype=np.int64)
             if m_pad >= _INT32_RANK_LIMIT:
-                vmin0_np[:n] = graph.first_ranks64
+                fr64 = None
+                try:
+                    from distributed_ghs_implementation_tpu.graphs import (
+                        native,
+                    )
+
+                    if native.native_available():
+                        # Reuse the padded int32 endpoints just built —
+                        # first_ranks64 would re-gather int64 endpoints
+                        # from u/v (~34 GB of host temporaries at the
+                        # RMAT-27 scale this branch targets).
+                        m = graph.num_edges
+                        fr64 = native.first_rank_i32_out64_native(
+                            n, ra_np[:m], rb_np[:m]
+                        )
+                except Exception:  # noqa: BLE001 — fallback below
+                    pass
+                vmin0_np[:n] = (
+                    fr64 if fr64 is not None else graph.first_ranks64
+                )
             else:
                 # Forced-small validation: widen the int32 first_ranks,
                 # remapping the isolated-vertex sentinel.

@@ -117,16 +117,19 @@ def solve_graph_checkpointed(
 
     if strategy == "rank":
         from distributed_ghs_implementation_tpu.models.rank_solver import (
+            _bucket_size,
             _family_params,
             _pick_family,
             prepare_rank_arrays_full,
+            prepare_rank_arrays_l2,
             solve_rank_filtered,
+            solve_rank_l2,
             solve_rank_resume,
             solve_rank_staged,
             use_filtered_path,
+            use_l2_path,
         )
 
-        vmin0, ra, rb, parent1 = prepare_rank_arrays_full(graph)
         chunks_seen = [0]
 
         def on_chunk(level, fragment, mst_ranks, count):
@@ -144,16 +147,26 @@ def solve_graph_checkpointed(
             # picks the chunked endpoint rebuild at widths where a
             # full-width relabel would not fit (the capacity regime the
             # chunked filter exists for).
+            vmin0, ra, rb, _parent1 = prepare_rank_arrays_full(graph)
             mst_ranks, fragment, levels = solve_rank_resume(
                 vmin0, ra, rb, initial_state, family=family, on_chunk=on_chunk
             )
-        elif use_filtered_path(family, ra.shape[0]):
+        elif use_l2_path(family):
+            # Road families: host levels 1+2 (same routing as
+            # solve_graph_rank), same on_chunk contract.
+            vmin0, ra, rb, parent12, l2_ranks = prepare_rank_arrays_l2(graph)
+            mst_ranks, fragment, levels = solve_rank_l2(
+                vmin0, ra, rb, parent12, l2_ranks, on_chunk=on_chunk
+            )
+        elif use_filtered_path(family, _bucket_size(graph.num_edges)):
             # Fresh dense solve: the filter-Kruskal path, same on_chunk
             # contract.
+            vmin0, ra, rb, parent1 = prepare_rank_arrays_full(graph)
             mst_ranks, fragment, levels = solve_rank_filtered(
                 vmin0, ra, rb, on_chunk=on_chunk, parent1=parent1
             )
         else:
+            vmin0, ra, rb, parent1 = prepare_rank_arrays_full(graph)
             mst_ranks, fragment, levels = solve_rank_staged(
                 vmin0, ra, rb,
                 **_family_params(family),

@@ -103,11 +103,14 @@ def _solve_rank_instrumented(graph) -> tuple:
         _family_params,
         _pick_family,
         prepare_rank_arrays_full,
+        prepare_rank_arrays_l2,
+        solve_rank_l2,
         solve_rank_staged,
+        use_l2_path,
     )
 
     n = graph.num_nodes
-    vmin0, ra, rb, parent1 = prepare_rank_arrays_full(graph)
+    family = _pick_family(graph)
     records = []
     frags_before = [n]
     last = [time.perf_counter()]
@@ -128,12 +131,25 @@ def _solve_rank_instrumented(graph) -> tuple:
         last[0] = now
 
     t_start = time.perf_counter()
-    mst_ranks, fragment, levels = solve_rank_staged(
-        vmin0, ra, rb,
-        **_family_params(_pick_family(graph)),
-        on_chunk=on_chunk,
-        parent1=parent1,
-    )
+    if use_l2_path(family):
+        # Same routing as solve_graph_rank: the instrumented path must
+        # measure the kernel production runs.
+        vmin0, ra, rb, parent12, l2_ranks = prepare_rank_arrays_l2(graph)
+        last[0] = time.perf_counter()
+        t_start = last[0]
+        mst_ranks, fragment, levels = solve_rank_l2(
+            vmin0, ra, rb, parent12, l2_ranks, on_chunk=on_chunk
+        )
+    else:
+        vmin0, ra, rb, parent1 = prepare_rank_arrays_full(graph)
+        last[0] = time.perf_counter()
+        t_start = last[0]
+        mst_ranks, fragment, levels = solve_rank_staged(
+            vmin0, ra, rb,
+            **_family_params(family),
+            on_chunk=on_chunk,
+            parent1=parent1,
+        )
     total = time.perf_counter() - t_start
 
     ranks_chosen = np.nonzero(np.asarray(mst_ranks))[0]

@@ -219,6 +219,36 @@ void first_rank_i32(int64_t n, int64_t m, const int32_t* ra, const int32_t* rb,
   }
 }
 
+// int32 endpoints, int64 rank output: the rank64 staging path reuses the
+// padded int32 ra/rb it just built (ranks exceed int32 there, vertex ids
+// never do) instead of re-gathering int64 endpoints from u/v.
+void first_rank_i32e64(int64_t n, int64_t m, const int32_t* ra,
+                       const int32_t* rb, int64_t* out) {
+  const int64_t kMax = 0x7fffffffffffffffLL;
+  for (int64_t v = 0; v < n; ++v) out[v] = kMax;
+  for (int64_t r = 0; r < m; ++r) {
+    if (out[ra[r]] == kMax) out[ra[r]] = r;
+    if (out[rb[r]] == kMax) out[rb[r]] = r;
+  }
+}
+
+// Level-2 MOE on the host: per-FRAGMENT first cross rank, fused with the
+// fragment relabel (fa = parent1[ra]) so the m-sized relabeled arrays never
+// materialize. One O(m) pass over rank-ascending endpoints.
+void first_cross_rank(int64_t n, int64_t m, const int32_t* ra,
+                      const int32_t* rb, const int32_t* parent1,
+                      int32_t* out) {
+  const int32_t kMax = 0x7fffffff;
+  for (int64_t v = 0; v < n; ++v) out[v] = kMax;
+  for (int64_t r = 0; r < m; ++r) {
+    const int32_t fa = parent1[ra[r]];
+    const int32_t fb = parent1[rb[r]];
+    if (fa == fb) continue;
+    if (out[fa] == kMax) out[fa] = (int32_t)r;
+    if (out[fb] == kMax) out[fb] = (int32_t)r;
+  }
+}
+
 // Fused rank-endpoint build: ra[r] = (int32)u[order[r]], rb likewise, with the
 // tail zero-padded to size_pad. One pass, int32 writes — replaces two int64
 // NumPy fancy-gathers plus casts plus pad copies (the pre-transfer critical

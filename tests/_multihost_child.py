@@ -57,6 +57,10 @@ def main() -> int:
 
     rank_ids, _, _ = solve_graph_sharded(g, mesh=mesh, strategy="rank")
     filt_ids, _, _ = solve_graph_rank_sharded(g, mesh=mesh, filtered=True)
+    # Split-key rank64 program across two real processes (the 2^31+-rank
+    # device program at test width; its two-pmin combine and local-crank
+    # finish must agree with the int32 path on every process).
+    r64_ids, _, _ = solve_graph_rank_sharded(g, mesh=mesh, rank64=True)
 
     # Checkpointed sharded solve with PER-PROCESS checkpoint dirs (the
     # non-shared-filesystem shape): only the primary writes; the resume
@@ -83,6 +87,7 @@ def main() -> int:
         "expected_weight": float(networkx_mst_weight(g)),
         "rank_edge_ids": [int(x) for x in rank_ids],
         "filtered_edge_ids": [int(x) for x in filt_ids],
+        "rank64_edge_ids": [int(x) for x in r64_ids],
         "ckpt_edge_ids": [int(x) for x in ck_ids],
         "ckpt_resume_edge_ids": [int(x) for x in ck_ids2],
         "ckpt_file_exists": os.path.exists(ck),

@@ -598,3 +598,94 @@ def test_checkpoint_every_stride_on_rank_path(tmp_path):
     # With a huge stride only the count==0 boundary save plus the final
     # explicit save happen.
     assert len(sparse_saves) <= 2, sparse_saves
+
+
+def test_host_level2_matches_device_head():
+    """host_level2 (the road-family host precompute of level 2) must be a
+    bit-exact replica of the device head's 2-level partition and MST
+    marks, on both a grid and an RMAT graph."""
+    import jax.numpy as jnp
+
+    from distributed_ghs_implementation_tpu.graphs.generators import (
+        rmat_graph,
+        road_grid_graph,
+    )
+    from distributed_ghs_implementation_tpu.models import rank_solver as rs
+
+    int32_max = np.iinfo(np.int32).max
+    for g in (road_grid_graph(50, 50, seed=3), rmat_graph(10, 8, seed=4)):
+        m_pad = rs._bucket_size(g.num_edges)
+        n_pad = rs._bucket_size(g.num_nodes)
+        vmin0 = np.full(n_pad, int32_max, np.int32)
+        vmin0[: g.num_nodes] = g.first_ranks
+        ra, rb = g.rank_endpoints(pad_to=m_pad)
+        parent1 = rs.host_level1(vmin0, ra, rb)
+        parent12, l2_ranks = rs.host_level2(parent1, ra, rb, g.num_edges)
+        frag_dev, mst_dev, _fa, _fb, _stats = rs._rank_head(
+            jnp.asarray(vmin0), jnp.asarray(ra), jnp.asarray(rb),
+            jnp.asarray(parent1), compact_after=2,
+        )
+        assert np.array_equal(np.asarray(frag_dev), parent12)
+        l1marks = np.zeros(m_pad, bool)
+        has1 = vmin0 < int32_max
+        l1marks[vmin0[has1]] = True
+        l2_dev = np.nonzero(np.asarray(mst_dev) & ~l1marks)[0]
+        l2_only = l2_ranks[~np.isin(l2_ranks, np.nonzero(l1marks)[0])]
+        assert np.array_equal(np.sort(l2_dev), np.sort(l2_only))
+
+
+def test_road_network_dead_end_prob():
+    """dead_end_prob keeps exactly one (min-weight) incident edge at each
+    dead-end cell and raises the degree-1 share."""
+    from distributed_ghs_implementation_tpu.graphs.generators import (
+        random_road_network,
+    )
+
+    g0 = random_road_network(60, 60, seed=7)
+    g1 = random_road_network(60, 60, seed=7, dead_end_prob=0.35)
+    d0 = g0.degrees()
+    d1 = g1.degrees()
+    share0 = (d0 == 1).mean()
+    share1 = (d1 == 1).mean()
+    assert share1 > share0 + 0.05, (share0, share1)
+    assert g1.num_edges < g0.num_edges
+
+
+@pytest.mark.parametrize("family_case", ["grid", "sparse"])
+def test_solve_rank_l2_production_parity(tmp_path, family_case):
+    """Both road families' production routing (host L1+L2, level-3 device
+    entry) must be byte-identical to the staged path and survive a
+    checkpoint round trip. The sparse staged reference uniquely uses
+    compact_after=1 (no device level 2) — the L2 path must match it too."""
+    from distributed_ghs_implementation_tpu.graphs.generators import (
+        random_road_network,
+        road_grid_graph,
+    )
+    from distributed_ghs_implementation_tpu.models import rank_solver as rs
+
+    if family_case == "grid":
+        g = road_grid_graph(60, 60, seed=11)
+    else:
+        g = random_road_network(
+            55, 55, seed=11, axis_prob=0.7, diag_prob=0.2, dead_end_prob=0.2
+        )
+    assert rs._pick_family(g) == family_case
+    assert rs.use_l2_path(family_case)
+    # Staged reference (explicit, bypassing the new routing).
+    vmin0, ra, rb, parent1 = rs.prepare_rank_arrays_full(g)
+    mst_ref, frag_ref, _ = rs.solve_rank_staged(
+        vmin0, ra, rb, **rs._family_params(family_case), parent1=parent1
+    )
+    ref_ids = rs.fetch_mst_edge_ids(g, mst_ref)
+    # Production routing.
+    ids, frag, _ = rs.solve_graph_rank(g)
+    assert np.array_equal(ids, ref_ids)
+    assert np.array_equal(
+        np.unique(np.asarray(frag_ref)[: g.num_nodes]), np.unique(frag)
+    )
+    # Checkpointed solve routes through solve_rank_l2 and resumes.
+    p = str(tmp_path / "l2.npz")
+    ck_ids, _, _ = solve_graph_checkpointed(g, p, strategy="rank")
+    assert np.array_equal(ck_ids, ref_ids)
+    ck_ids2, _, _ = solve_graph_checkpointed(g, p, strategy="rank")
+    assert np.array_equal(ck_ids2, ref_ids)

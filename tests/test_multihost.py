@@ -84,6 +84,8 @@ def test_two_process_distributed_solve(tmp_path):
     for r in records:
         assert r["rank_edge_ids"] == expected
         assert r["filtered_edge_ids"] == expected
+        # Split-key rank64 program, two real processes (VERDICT r4 item 6).
+        assert r["rank64_edge_ids"] == expected
         # Checkpointed sharded solve + broadcast-agreed resume.
         assert r["ckpt_edge_ids"] == expected
         assert r["ckpt_resume_edge_ids"] == expected

@@ -76,3 +76,21 @@ def test_csr_native():
     assert indptr.tolist() == [0, 2, 4, 6]
     assert sorted(adj[0:2].tolist()) == [1, 2]
     assert sorted(adjw[4:6].tolist()) == [6, 7]
+
+
+def test_first_rank_i32_out64_matches_first_ranks64():
+    """The rank64 staging's endpoint-reusing native pass must agree with
+    the Graph.first_ranks64 property (which re-gathers from u/v)."""
+    import numpy as np
+
+    from distributed_ghs_implementation_tpu.graphs import native
+    from distributed_ghs_implementation_tpu.graphs.generators import rmat_graph
+
+    if not native.native_available():
+        import pytest
+
+        pytest.skip("native library unavailable")
+    g = rmat_graph(9, 8, seed=6)
+    ra, rb = g.rank_endpoints()
+    out = native.first_rank_i32_out64_native(g.num_nodes, ra, rb)
+    assert np.array_equal(out, g.first_ranks64)

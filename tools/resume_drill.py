@@ -1,0 +1,66 @@
+"""On-chip interrupt+resume drill at the capacity regime (VERDICT r4 item 4).
+
+Usage:
+  python tools/resume_drill.py run    <ckpt.npz>   # gen RMAT-25, checkpointed
+                                                   # solve (saves per chunk)
+  python tools/resume_drill.py resume <ckpt.npz>   # same command, fresh
+                                                   # process: resumes + verifies
+
+The driver (a shell around this) watches for the checkpoint file to appear,
+SIGKILLs the `run` process mid-solve, then invokes `resume` in a fresh
+process — exactly the operator flow (re-run the same command after a
+preemption). RMAT-25 is the regime ADVICE r3 flagged: on resume the chunked
+endpoint rebuild must not re-materialize full-width arrays next to the
+4.3 GB resident ra/rb (utils/checkpoint.py chunked-rebuild path) — a
+failure only the real 16 GB chip can produce. Oracle weight (scale 25,
+ef 16, seed 24): 1,008,877,972 (docs/BASELINE_RUNS.jsonl).
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+ORACLE_WEIGHT = 1_008_877_972
+SCALE = 25
+
+
+def main() -> int:
+    mode, path = sys.argv[1], sys.argv[2]
+
+    from distributed_ghs_implementation_tpu.graphs.generators import rmat_graph
+    from distributed_ghs_implementation_tpu.utils.checkpoint import (
+        load_checkpoint,
+        solve_graph_checkpointed,
+    )
+
+    t0 = time.perf_counter()
+    g = rmat_graph(SCALE, 16, seed=24)
+    print(f"gen: {time.perf_counter()-t0:.1f}s  m={g.num_edges:,}", flush=True)
+
+    if mode == "resume":
+        state = load_checkpoint(path)
+        print(f"resuming from saved level={state[2]}", flush=True)
+
+    t0 = time.perf_counter()
+    edge_ids, fragment, levels = solve_graph_checkpointed(
+        g, path, strategy="rank"
+    )
+    wall = time.perf_counter() - t0
+    w = int(g.w[edge_ids].sum())
+    ok = w == ORACLE_WEIGHT
+    print(
+        f"{mode.upper()} {'OK' if ok else 'WEIGHT MISMATCH'}: weight={w} "
+        f"(oracle {ORACLE_WEIGHT}) wall_s={wall:.1f} (prep-inclusive) "
+        f"levels={levels}", flush=True,
+    )
+    if mode == "run":
+        print("solve completed uninterrupted (kill came too late)", flush=True)
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
