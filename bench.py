@@ -80,7 +80,7 @@ def main(argv=None) -> int:
         from distributed_ghs_implementation_tpu.api import MSTResult
         from distributed_ghs_implementation_tpu.models.rank_solver import (
             _pick_family,
-            prepare_rank_arrays_full,
+            prepare_rank_arrays_filtered,
             prepare_rank_arrays_l2,
             solve_rank_auto,
             solve_rank_l2,
@@ -97,14 +97,17 @@ def main(argv=None) -> int:
             def solve():
                 return solve_rank_l2(vmin0, ra, rb, parent12, l2_ranks)
         else:
-            vmin0, ra, rb, parent1 = prepare_rank_arrays_full(g)
+            vmin0, ra, rb, parent1, parent12, l2_ranks, _prefix = (
+                prepare_rank_arrays_filtered(g)
+            )
 
             def solve():
                 return solve_rank_auto(
-                    vmin0, ra, rb, family=fam, parent1=parent1
+                    vmin0, ra, rb, family=fam, parent1=parent1,
+                    parent12=parent12, l2_ranks=l2_ranks,
                 )
         prep_s = time.perf_counter() - t0
-        print(f"host prep (ranks + first_ranks + L1 + staging): "
+        print(f"host prep (ranks + first_ranks + L1/L2 + staging): "
               f"{prep_s:.1f}s", file=sys.stderr)
         mst, fragment, levels = solve()
         _ = np.asarray(mst.ravel()[0])  # warm + sync

@@ -180,6 +180,18 @@ def host_level2(parent1: np.ndarray, ra: np.ndarray, rb: np.ndarray, m: int):
     return parent[parent1], np.unique(moe2[has])
 
 
+def _pad_l2_ranks(l2r: np.ndarray, m_pad: int) -> np.ndarray:
+    """Pad the level-2 mark ranks for staging. The pad value ``m_pad`` is
+    load-bearing: every consumer tests ``l2_ranks < width`` and drops the
+    rest, so the sentinel must exceed any real rank; the 1024 floor keeps
+    tiny graphs off degenerate bucket sizes. One helper so the
+    single-chip, sharded, and measurement paths cannot desynchronize."""
+    l2_pad = _bucket_size(max(int(l2r.size), 1024))
+    out = np.full(l2_pad, m_pad, dtype=np.int32)
+    out[: l2r.size] = l2r
+    return out
+
+
 @jax.jit
 def _device_level1(vmin0, ra, rb):
     """On-device fallback for callers that stage raw arrays without the
@@ -629,9 +641,7 @@ def prepare_rank_arrays_l2(graph: Graph):
         return cached
     n, m, n_pad, m_pad, ra, rb, vmin0, parent1, sa, sb = _prep_head(graph)
     parent12, l2r = host_level2(parent1, ra, rb, m)
-    l2_pad = _bucket_size(max(int(l2r.size), 1024))
-    l2_staged = np.full(l2_pad, m_pad, dtype=np.int32)
-    l2_staged[: l2r.size] = l2r
+    l2_staged = _pad_l2_ranks(l2r, m_pad)
     sv = jax.device_put(vmin0)
     sp = jax.device_put(parent12)
     sl = jax.device_put(l2_staged)
@@ -1230,9 +1240,81 @@ def _prefix_size(n_pad: int, m_pad: int, mult: int = 2) -> int:
     return _bucket_size(min(mult * n_pad, m_pad))
 
 
+def _prefix_plan(n_pad: int, m_pad: int) -> Tuple[int, bool]:
+    """The filter split decision ``(prefix, force_chunked)`` — extracted so
+    prep (:func:`prepare_rank_arrays_filtered`) and the solver
+    (:func:`solve_rank_filtered`) cannot disagree on the prefix the host
+    level-2 pass was computed for. mult=1 wherever the single-pass filter
+    fits; mult=2 in the chunked-filter capacity regime (see
+    :func:`_prefix_size` for the measured rationale)."""
+    suffix1 = m_pad - _prefix_size(n_pad, m_pad, 1)
+    force_chunked = 8 * suffix1 > _FILTER_CHUNK_BYTES
+    return _prefix_size(n_pad, m_pad, 2 if force_chunked else 1), force_chunked
+
+
+@functools.partial(jax.jit, static_argnames=("prefix",))
+def _filtered_head_l2(vmin0, ra, rb, parent12, l2_ranks, *, prefix: int):
+    """:func:`_filtered_head` with the prefix level 2 host-precomputed
+    (:func:`host_level2` over the prefix ranks): one prefix relabel plus
+    the L1/L2 mark scatters — the prefix-width segment_min and hook never
+    run on device. Same return contract."""
+    mp = ra.shape[0]
+    has1 = vmin0 < INT32_MAX
+    safe1 = jnp.where(has1, vmin0, 0)
+    mst = jnp.zeros(mp, dtype=bool).at[safe1].max(has1)
+    has2 = l2_ranks < prefix  # pads carry m_pad and are dropped
+    mst = mst.at[jnp.where(has2, l2_ranks, mp)].max(has2, mode="drop")
+    fa = parent12[ra[:prefix]]
+    fb = parent12[rb[:prefix]]
+    count = jnp.sum((fa != fb).astype(jnp.int32))
+    lv = jnp.asarray(1, jnp.int32) + jnp.any(has2).astype(jnp.int32)
+    return parent12, mst, fa, fb, jnp.stack([lv, count])
+
+
+def prepare_rank_arrays_filtered(graph: Graph):
+    """:func:`prepare_rank_arrays_full` plus the host level-2 pass over the
+    FILTER PREFIX (the dense-family production prep): ``(vmin0, ra, rb,
+    parent1, parent12, l2_ranks, prefix)`` staged. ``parent12``/``l2_ranks``
+    are ``None`` when the filter split is degenerate (``2*prefix > m_pad``
+    — the solver falls back to the staged path, which wants ``parent1``).
+    The extra host pass (first-cross-rank over the prefix) hides under the
+    edge-sized transfers like the rest of prep."""
+    cached = graph.__dict__.get("_rank_device_cache_filtered")
+    if cached is not None:
+        return cached
+    n_pad = _bucket_size(graph.num_nodes)
+    m_pad = _bucket_size(graph.num_edges)
+    prefix, _force_chunked = _prefix_plan(n_pad, m_pad)
+    if (
+        2 * prefix > m_pad
+        or not use_filtered_path("dense", m_pad)
+        or n_pad < _CENSUS_MIN_SPACE
+    ):
+        # The consuming path won't run _filtered_head_l2 (degenerate
+        # split, below filter scale, or the small-dense speculative
+        # regime): don't pay the host pass and the extra transfers.
+        full = prepare_rank_arrays_full(graph)
+        return full[:4] + (None, None, prefix)
+    n, m, n_pad, m_pad, ra, rb, vmin0, parent1, sa, sb = _prep_head(graph)
+    # Pad slots in [m, prefix) are self-edges (ra == rb == 0): no cross
+    # ranks, so scanning to `prefix` is safe even when prefix > m.
+    parent12, l2r = host_level2(parent1, ra, rb, prefix)
+    l2_staged = _pad_l2_ranks(l2r, m_pad)
+    sv = jax.device_put(vmin0)
+    sp1 = jax.device_put(parent1)
+    sp12 = jax.device_put(parent12)
+    sl = jax.device_put(l2_staged)
+    staged = (sv, sa, sb, sp1, sp12, sl, prefix)
+    for leaf in staged[:6]:
+        _ = np.asarray(leaf[:1])
+    if m_pad <= _STAGE_CACHE_MAX_RANKS:
+        graph.__dict__["_rank_device_cache_filtered"] = staged
+    return staged
+
+
 def solve_rank_filtered(
     vmin0, ra, rb, *, chunk_levels: int = 3, prefix_mult: int | None = None,
-    on_chunk=None, parent1=None,
+    on_chunk=None, parent1=None, parent12=None, l2_ranks=None,
 ) -> Tuple[jax.Array, jax.Array, int]:
     """Filter-Kruskal solve: prefix Borůvka, one-pass suffix filter, survivor
     finish. Same contract and bit-identical results as
@@ -1247,23 +1329,27 @@ def solve_rank_filtered(
     consume-during-the-call rule (the mask buffer is donated to the next
     chunk dispatch; see :func:`solve_rank_staged`). Resume goes through
     :func:`solve_rank_resume`, exact from any saved partition.
+
+    ``parent12``/``l2_ranks`` (from :func:`prepare_rank_arrays_filtered`)
+    carry the host-precomputed PREFIX level 2: the head becomes one prefix
+    relabel plus mark scatters (r5; only valid with ``prefix_mult=None``
+    — the host pass was computed for :func:`_prefix_plan`'s prefix).
     """
     n_pad = vmin0.shape[0]
     m_pad = ra.shape[0]
     force_chunked = False
     if prefix_mult is None:
         # mult=1 measured best where everything fits (RMAT-24 13.44 ->
-        # 12.53 s; wash at 20/22/25). In the chunked-filter capacity
-        # regime (RMAT-26 class) keep mult=2 — the configuration the
-        # billion-edge result was measured and verified under. The chunk
-        # decision below derives from the SAME test: choosing mult=2 here
-        # forces the chunked filter even if the (larger) mult=2 prefix
-        # pulls the remaining suffix back under the byte threshold — the
-        # borderline single-pass/mult=2 combination ships nowhere.
-        suffix1 = m_pad - _prefix_size(n_pad, m_pad, 1)
-        force_chunked = 8 * suffix1 > _FILTER_CHUNK_BYTES
-        prefix_mult = 2 if force_chunked else 1
-    prefix = _prefix_size(n_pad, m_pad, prefix_mult)
+        # 12.53 s; wash at 20/22/25); mult=2 in the chunked-filter capacity
+        # regime — see _prefix_plan/_prefix_size for the full rationale.
+        prefix, force_chunked = _prefix_plan(n_pad, m_pad)
+    else:
+        if parent12 is not None:
+            raise ValueError(
+                "parent12/l2_ranks were computed for _prefix_plan's prefix; "
+                "pass prefix_mult=None with them"
+            )
+        prefix = _prefix_size(n_pad, m_pad, prefix_mult)
     if 2 * prefix > m_pad:
         # Not enough suffix to pay for the split — plain staged solve.
         return solve_rank_staged(
@@ -1272,10 +1358,15 @@ def solve_rank_filtered(
         )
 
     compact_space = n_pad >= _CENSUS_MIN_SPACE
-    parent1 = _ensure_parent1(vmin0, ra, rb, parent1)
-    fragment, mst, fa, fb, stats = _filtered_head(
-        vmin0, ra, rb, parent1, prefix=prefix
-    )
+    if parent12 is not None:
+        fragment, mst, fa, fb, stats = _filtered_head_l2(
+            vmin0, ra, rb, parent12, l2_ranks, prefix=prefix
+        )
+    else:
+        parent1 = _ensure_parent1(vmin0, ra, rb, parent1)
+        fragment, mst, fa, fb, stats = _filtered_head(
+            vmin0, ra, rb, parent1, prefix=prefix
+        )
     lv, count = (int(x) for x in jax.device_get(stats))
     if on_chunk is not None:
         on_chunk(lv, fragment, mst, count)
@@ -1428,11 +1519,16 @@ def use_filtered_path(family: str, num_ranks: int) -> bool:
     return family == "dense" and num_ranks >= _FILTER_MIN_RANKS
 
 
-def solve_rank_auto(vmin0, ra, rb, *, family: str = "dense", parent1=None):
+def solve_rank_auto(
+    vmin0, ra, rb, *, family: str = "dense", parent1=None, parent12=None,
+    l2_ranks=None,
+):
     """Dispatch policy shared by ``solve_graph_rank`` and ``bench.py`` —
     see :func:`_pick_family` for the per-family rationale. Chunk length 2
     beats 3 on many-level graphs (measured 12.1 s vs 13.2 s on a 4096^2
-    grid; 1 loses to dispatch overhead at 14.1 s)."""
+    grid; 1 loses to dispatch overhead at 14.1 s). ``parent12``/``l2_ranks``
+    (from :func:`prepare_rank_arrays_filtered`) route the filtered path
+    through the host-precomputed prefix level 2."""
     n_pad = vmin0.shape[0]
     parent1 = _ensure_parent1(vmin0, ra, rb, parent1)
     if use_filtered_path(family, ra.shape[0]):
@@ -1446,7 +1542,10 @@ def solve_rank_auto(vmin0, ra, rb, *, family: str = "dense", parent1=None):
             )
             if result is not None:
                 return result
-        return solve_rank_filtered(vmin0, ra, rb, parent1=parent1)
+        return solve_rank_filtered(
+            vmin0, ra, rb, parent1=parent1, parent12=parent12,
+            l2_ranks=l2_ranks,
+        )
     if family == "dense" and n_pad < _CENSUS_MIN_SPACE:
         # Below the census threshold the finish is one chunk and the fetch
         # overhead dominates: speculate the survivor width at m/8 (2x the
@@ -1526,8 +1625,14 @@ def solve_graph_rank(graph: Graph) -> Tuple[np.ndarray, np.ndarray, int]:
         vmin0, ra, rb, parent12, l2_ranks = prepare_rank_arrays_l2(graph)
         mst, fragment, levels = solve_rank_l2(vmin0, ra, rb, parent12, l2_ranks)
     else:
-        vmin0, ra, rb, parent1 = prepare_rank_arrays_full(graph)
+        # Dense: the filtered path's prefix level 2 is host-precomputed
+        # too (r5; parent12/l2_ranks are None when the split is
+        # degenerate and the staged fallback takes parent1).
+        vmin0, ra, rb, parent1, parent12, l2_ranks, _prefix = (
+            prepare_rank_arrays_filtered(graph)
+        )
         mst, fragment, levels = solve_rank_auto(
-            vmin0, ra, rb, family=family, parent1=parent1
+            vmin0, ra, rb, family=family, parent1=parent1,
+            parent12=parent12, l2_ranks=l2_ranks,
         )
     return fetch_mst_edge_ids(graph, mst), np.asarray(fragment)[:n], levels

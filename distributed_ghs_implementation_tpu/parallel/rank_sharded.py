@@ -51,13 +51,14 @@ from distributed_ghs_implementation_tpu.models.rank_solver import (
     _INT32_RANK_LIMIT,
     _level_core,
     _moe_over,
+    _pad_l2_ranks,
     _pick_family,
-    _prefix_level2_core,
     _PACKBITS_CHUNK,
     _prefix_size,
     _restore_state_host,
     check_rank_envelope,
     host_level1,
+    host_level2,
     fetch_mst_edge_ids,
     packed_to_edge_ids,
     use_filtered_path,
@@ -362,14 +363,20 @@ def _rank_sharded_level(fragment, mst, fa, fb, *, moe_fn=_moe_int32):
 
 
 @jax.jit
-def _prefix_level2(fragment, ra_p, rb_p):
-    """Replicated level 2 over the prefix block (the level-1 partition is the
-    vertex->fragment map, so relabeling endpoints through it is exact)."""
-    fa = fragment[ra_p]
-    fb = fragment[rb_p]
-    fragment, fa, fb, has2, safe2, count = _prefix_level2_core(fragment, fa, fb)
-    mst_p = jnp.zeros(ra_p.shape[0], dtype=bool).at[safe2].max(has2)
-    return fragment, mst_p, fa, fb, jnp.stack(
+def _prefix_relabel_l2(parent12, ra_p, rb_p, l2_ranks):
+    """:func:`_prefix_level2` with the prefix level 2 host-precomputed
+    (``host_level2`` over the prefix ranks, staged replicated): one
+    relabel plus the mark scatter — the replicated segment_min and hook
+    never run. Same return contract."""
+    prefix = ra_p.shape[0]
+    fa = parent12[ra_p]
+    fb = parent12[rb_p]
+    has2 = l2_ranks < prefix  # pads carry m_pad and are dropped
+    mst_p = jnp.zeros(prefix, dtype=bool).at[
+        jnp.where(has2, l2_ranks, prefix)
+    ].max(has2, mode="drop")
+    count = jnp.sum((fa != fb).astype(jnp.int32))
+    return parent12, mst_p, fa, fb, jnp.stack(
         [jnp.any(has2).astype(jnp.int32), count]
     )
 
@@ -710,7 +717,15 @@ def solve_graph_rank_sharded(
         rb_p = slice_rep(rb)
         l1 = make_rank_sharded_l1(mesh)
         fragment, mst = l1(vmin0, parent1, ra)
-        fragment, mst_p, fa_p, fb_p, stats = _prefix_level2(fragment, ra_p, rb_p)
+        # Host prefix-L2 (r5): the replicated level 2 becomes one relabel
+        # plus a mark scatter — the n-space segment_min/hook never run on
+        # device. parent12/l2 ride replicated (n-sized + compacted marks).
+        parent12_np, l2r = host_level2(parent1_np, ra_np, rb_np, prefix)
+        parent12 = _stage(parent12_np, rep)
+        l2_staged = _stage(_pad_l2_ranks(l2r, m_pad), rep)
+        fragment, mst_p, fa_p, fb_p, stats = _prefix_relabel_l2(
+            parent12, ra_p, rb_p, l2_staged
+        )
         lv2, count = (int(x) for x in jax.device_get(stats))
         lv = 1 + lv2
         hook = None

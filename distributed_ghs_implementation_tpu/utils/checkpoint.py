@@ -120,6 +120,7 @@ def solve_graph_checkpointed(
             _bucket_size,
             _family_params,
             _pick_family,
+            prepare_rank_arrays_filtered,
             prepare_rank_arrays_full,
             prepare_rank_arrays_l2,
             solve_rank_filtered,
@@ -159,11 +160,14 @@ def solve_graph_checkpointed(
                 vmin0, ra, rb, parent12, l2_ranks, on_chunk=on_chunk
             )
         elif use_filtered_path(family, _bucket_size(graph.num_edges)):
-            # Fresh dense solve: the filter-Kruskal path, same on_chunk
-            # contract.
-            vmin0, ra, rb, parent1 = prepare_rank_arrays_full(graph)
+            # Fresh dense solve: the filter-Kruskal path with the
+            # host-precomputed prefix level 2, same on_chunk contract.
+            vmin0, ra, rb, parent1, parent12, l2_ranks, _prefix = (
+                prepare_rank_arrays_filtered(graph)
+            )
             mst_ranks, fragment, levels = solve_rank_filtered(
-                vmin0, ra, rb, on_chunk=on_chunk, parent1=parent1
+                vmin0, ra, rb, on_chunk=on_chunk, parent1=parent1,
+                parent12=parent12, l2_ranks=l2_ranks,
             )
         else:
             vmin0, ra, rb, parent1 = prepare_rank_arrays_full(graph)

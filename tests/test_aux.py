@@ -689,3 +689,36 @@ def test_solve_rank_l2_production_parity(tmp_path, family_case):
     assert np.array_equal(ck_ids, ref_ids)
     ck_ids2, _, _ = solve_graph_checkpointed(g, p, strategy="rank")
     assert np.array_equal(ck_ids2, ref_ids)
+
+
+def test_filtered_head_l2_parity():
+    """The dense filtered path with the host-precomputed prefix level 2
+    (prepare_rank_arrays_filtered -> _filtered_head_l2) must be
+    byte-identical to the device-head filtered path and the staged path."""
+    from distributed_ghs_implementation_tpu.graphs.generators import rmat_graph
+    from distributed_ghs_implementation_tpu.models import rank_solver as rs
+
+    for seed in (3, 9):
+        g = rmat_graph(10, 16, seed=seed)
+        # Production gates the L2 prep off below _CENSUS_MIN_SPACE (the
+        # speculative regime never consumes it); build the inputs directly
+        # to pin the kernel itself at test width.
+        vmin0, ra, rb, parent1 = rs.prepare_rank_arrays_full(g)
+        n_pad, m_pad = vmin0.shape[0], ra.shape[0]
+        prefix, _ = rs._prefix_plan(n_pad, m_pad)
+        assert 2 * prefix <= m_pad, "filter split degenerate at test size"
+        ra_h, rb_h = g.rank_endpoints(pad_to=m_pad)
+        p1_np = np.asarray(parent1)
+        p12_np, l2r = rs.host_level2(p1_np, ra_h, rb_h, prefix)
+        import jax
+
+        parent12 = jax.device_put(p12_np)
+        l2_ranks = jax.device_put(rs._pad_l2_ranks(l2r, m_pad))
+        mst_ref, _, _ = rs.solve_rank_filtered(vmin0, ra, rb, parent1=parent1)
+        mst_l2, frag_l2, _ = rs.solve_rank_filtered(
+            vmin0, ra, rb, parent1=parent1, parent12=parent12,
+            l2_ranks=l2_ranks,
+        )
+        assert np.array_equal(np.asarray(mst_ref), np.asarray(mst_l2))
+        mst_st, _, _ = rs.solve_rank_staged(vmin0, ra, rb, parent1=parent1)
+        assert np.array_equal(np.asarray(mst_st), np.asarray(mst_l2))

@@ -106,14 +106,24 @@ def main() -> int:
     res["t_l1_s"], (frag1, mst_blk) = t(l1, vmin0, parent1, ra_blk)
 
     # --- T_prefix: the replicated prefix solve, exactly as the sharded path
-    # runs it (slice + level 2 + finish chunks; host trips included) --------
+    # runs it (r5: host prefix-L2 + relabel + finish chunks; host trips
+    # included). The host_level2 pass is prep-time work — timed separately
+    # below as t_prefix_host_s (in production it overlaps staging) --------
     ra_p = jax.jit(lambda x: x[:prefix])(ra)
     rb_p = jax.jit(lambda x: x[:prefix])(rb)
     _force((ra_p, rb_p))
+    ra_h, rb_h = g.rank_endpoints(pad_to=m_pad)
+    parent1_np = np.asarray(parent1)
+    t0 = time.perf_counter()
+    parent12_np, l2r = rs.host_level2(parent1_np, ra_h, rb_h, prefix)
+    res["t_prefix_host_s"] = time.perf_counter() - t0
+    parent12 = jax.device_put(parent12_np)
+    l2_staged = jax.device_put(rs._pad_l2_ranks(l2r, m_pad))
+    _force((parent12, l2_staged))
 
     def prefix_phase():
-        fragment, mst_p, fa_p, fb_p, stats = rsh._prefix_level2(
-            parent1, ra_p, rb_p
+        fragment, mst_p, fa_p, fb_p, stats = rsh._prefix_relabel_l2(
+            parent12, ra_p, rb_p, l2_staged
         )
         lv2, count = (int(x) for x in jax.device_get(stats))
         mst_p, fragment, lv = rs._finish_to_fixpoint(
