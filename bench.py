@@ -145,7 +145,9 @@ def main(argv=None) -> int:
         else None
     )
     if not args.no_verify:
-        v = verify_result(result, oracle="scipy", expected_weight=recorded)
+        # Recorded weight when known; otherwise the live auto oracle (the
+        # native Kruskal pass — fast enough at any bench scale).
+        v = verify_result(result, oracle="auto", expected_weight=recorded)
         if not v.ok:
             print(f"VERIFICATION FAILED: {v}", file=sys.stderr)
             print(

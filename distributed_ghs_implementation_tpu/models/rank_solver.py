@@ -1274,9 +1274,12 @@ def _filtered_head_l2(vmin0, ra, rb, parent12, l2_ranks, *, prefix: int):
 def prepare_rank_arrays_filtered(graph: Graph):
     """:func:`prepare_rank_arrays_full` plus the host level-2 pass over the
     FILTER PREFIX (the dense-family production prep): ``(vmin0, ra, rb,
-    parent1, parent12, l2_ranks, prefix)`` staged. ``parent12``/``l2_ranks``
-    are ``None`` when the filter split is degenerate (``2*prefix > m_pad``
-    — the solver falls back to the staged path, which wants ``parent1``).
+    parent1, parent12, l2_ranks, prefix)`` staged — EXACTLY ONE of
+    ``parent1``/``parent12`` is non-None. ``parent12``/``l2_ranks`` are
+    ``None`` when the consuming path won't run the L2 head (degenerate
+    split, below filter scale, speculative regime — those want
+    ``parent1``); otherwise ``parent1`` is ``None`` (the L2 head never
+    reads it on device, so staging it would waste an n-sized transfer).
     The extra host pass (first-cross-rank over the prefix) hides under the
     edge-sized transfers like the rest of prep."""
     cached = graph.__dict__.get("_rank_device_cache_filtered")
@@ -1301,11 +1304,13 @@ def prepare_rank_arrays_filtered(graph: Graph):
     parent12, l2r = host_level2(parent1, ra, rb, prefix)
     l2_staged = _pad_l2_ranks(l2r, m_pad)
     sv = jax.device_put(vmin0)
-    sp1 = jax.device_put(parent1)
     sp12 = jax.device_put(parent12)
     sl = jax.device_put(l2_staged)
-    staged = (sv, sa, sb, sp1, sp12, sl, prefix)
-    for leaf in staged[:6]:
+    # parent1 is NOT staged on this path: the L2 head never reads it on
+    # device (host_level2 consumed the host copy); the degenerate branch
+    # above is the one that returns a staged parent1.
+    staged = (sv, sa, sb, None, sp12, sl, prefix)
+    for leaf in (sv, sa, sb, sp12, sl):
         _ = np.asarray(leaf[:1])
     if m_pad <= _STAGE_CACHE_MAX_RANKS:
         graph.__dict__["_rank_device_cache_filtered"] = staged
@@ -1530,8 +1535,15 @@ def solve_rank_auto(
     (from :func:`prepare_rank_arrays_filtered`) route the filtered path
     through the host-precomputed prefix level 2."""
     n_pad = vmin0.shape[0]
-    parent1 = _ensure_parent1(vmin0, ra, rb, parent1)
     if use_filtered_path(family, ra.shape[0]):
+        if n_pad >= _CENSUS_MIN_SPACE and parent12 is not None:
+            # The L2 head never reads parent1 on device — don't force the
+            # device-level-1 fallback for an unused array.
+            return solve_rank_filtered(
+                vmin0, ra, rb, parent1=parent1, parent12=parent12,
+                l2_ranks=l2_ranks,
+            )
+        parent1 = _ensure_parent1(vmin0, ra, rb, parent1)
         if n_pad < _CENSUS_MIN_SPACE:
             # Small-dense: one dispatch with compacted inner loops beats the
             # staged sequence (RMAT-20: 1.31 s vs 1.41 s staged, same
@@ -1542,10 +1554,8 @@ def solve_rank_auto(
             )
             if result is not None:
                 return result
-        return solve_rank_filtered(
-            vmin0, ra, rb, parent1=parent1, parent12=parent12,
-            l2_ranks=l2_ranks,
-        )
+        return solve_rank_filtered(vmin0, ra, rb, parent1=parent1)
+    parent1 = _ensure_parent1(vmin0, ra, rb, parent1)
     if family == "dense" and n_pad < _CENSUS_MIN_SPACE:
         # Below the census threshold the finish is one chunk and the fetch
         # overhead dominates: speculate the survivor width at m/8 (2x the

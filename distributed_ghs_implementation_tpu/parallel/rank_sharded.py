@@ -364,10 +364,11 @@ def _rank_sharded_level(fragment, mst, fa, fb, *, moe_fn=_moe_int32):
 
 @jax.jit
 def _prefix_relabel_l2(parent12, ra_p, rb_p, l2_ranks):
-    """:func:`_prefix_level2` with the prefix level 2 host-precomputed
+    """Replicated prefix phase entry with level 2 host-precomputed
     (``host_level2`` over the prefix ranks, staged replicated): one
     relabel plus the mark scatter — the replicated segment_min and hook
-    never run. Same return contract."""
+    never run on device. Returns ``(fragment, mst_p, fa, fb, stats)``
+    with ``stats = [levels_past_1, prefix_alive]``."""
     prefix = ra_p.shape[0]
     fa = parent12[ra_p]
     fb = parent12[rb_p]

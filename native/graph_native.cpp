@@ -268,6 +268,50 @@ void rank_endpoints_i32(int64_t m, int64_t size_pad, const int64_t* order,
   }
 }
 
+// Kruskal MSF over edges in ascending (weight, edge id) order — the oracle
+// fast path: the rank order already exists natively, so one union-find pass
+// verifies a solve at C speed (SciPy's csgraph oracle costs ~890 s at
+// RMAT-24; this is O(m alpha(n))). Writes [total_weight, edge_count] to out.
+static int64_t uf_find(int64_t* p, int64_t x) {
+  while (p[x] != x) {
+    p[x] = p[p[x]];  // path halving
+    x = p[x];
+  }
+  return x;
+}
+
+// Validates `order` instead of trusting it: the solver under test consumes
+// the SAME precomputed order, so an independent oracle must prove (a) the
+// order is a permutation of [0, m) and (b) weights are non-decreasing along
+// it — given both, Kruskal's weight equals the true MSF weight regardless
+// of how ties were broken. On violation writes out[1] = -1 (caller falls
+// back to the independently-sorted SciPy oracle).
+void kruskal_msf(int64_t n, int64_t m, const int64_t* order, const int64_t* u,
+                 const int64_t* v, const int64_t* w, int64_t* out) {
+  std::vector<int64_t> parent((size_t)n);
+  for (int64_t i = 0; i < n; ++i) parent[i] = i;
+  std::vector<uint8_t> seen((size_t)m, 0);
+  int64_t total = 0, count = 0, prev_w = 0;
+  for (int64_t r = 0; r < m; ++r) {
+    const int64_t e = order[r];
+    if (e < 0 || e >= m || seen[e] || (r > 0 && w[e] < prev_w)) {
+      out[0] = 0;
+      out[1] = -1;  // not a non-decreasing permutation: order is corrupt
+      return;
+    }
+    seen[e] = 1;
+    prev_w = w[e];
+    const int64_t ru = uf_find(parent.data(), u[e]);
+    const int64_t rv = uf_find(parent.data(), v[e]);
+    if (ru == rv) continue;
+    parent[ru] = rv;
+    total += w[e];
+    ++count;
+  }
+  out[0] = total;
+  out[1] = count;
+}
+
 // Stable counting sort of edge ids by integer weight (ranks ascending by
 // (weight, edge id)) for small weight ranges — the lexsort that dominates
 // host prep at RMAT-24 scale becomes O(m + range).

@@ -6,7 +6,7 @@ measurable at its actual per-shard width (mb = m_pad/8 = 2^25 for
 RMAT-24/8), because the per-chip work contains no edge-width collectives:
 
   T_l1       level-1 marks on one rank block        (make_rank_sharded_l1, mb)
-  T_prefix   the REPLICATED prefix solve            (_prefix_level2 +
+  T_prefix   the REPLICATED prefix solve            (_prefix_relabel_l2 +
              _finish_to_fixpoint at prefix = 2^24, exactly as
              solve_graph_rank_sharded runs it)
   T_filter   the per-shard filter relabel           (make_rank_filter_relabel,
