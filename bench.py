@@ -79,33 +79,13 @@ def main(argv=None) -> int:
 
         from distributed_ghs_implementation_tpu.api import MSTResult
         from distributed_ghs_implementation_tpu.models.rank_solver import (
-            _pick_family,
-            prepare_rank_arrays_filtered,
-            prepare_rank_arrays_l2,
-            solve_rank_auto,
-            solve_rank_l2,
-            use_l2_path,
+            make_production_solver,
         )
 
-        # Same routing as solve_graph_rank (shared use_l2_path predicate):
-        # the bench must measure the kernel production runs.
-        fam = _pick_family(g)
+        # make_production_solver is the single routing source shared with
+        # solve_graph_rank: the bench measures the kernel production runs.
         t0 = time.perf_counter()
-        if use_l2_path(fam):
-            vmin0, ra, rb, parent12, l2_ranks = prepare_rank_arrays_l2(g)
-
-            def solve():
-                return solve_rank_l2(vmin0, ra, rb, parent12, l2_ranks)
-        else:
-            vmin0, ra, rb, parent1, parent12, l2_ranks, _prefix = (
-                prepare_rank_arrays_filtered(g)
-            )
-
-            def solve():
-                return solve_rank_auto(
-                    vmin0, ra, rb, family=fam, parent1=parent1,
-                    parent12=parent12, l2_ranks=l2_ranks,
-                )
+        solve = make_production_solver(g)
         prep_s = time.perf_counter() - t0
         print(f"host prep (ranks + first_ranks + L1/L2 + staging): "
               f"{prep_s:.1f}s", file=sys.stderr)

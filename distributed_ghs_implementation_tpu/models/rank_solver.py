@@ -1620,29 +1620,62 @@ def use_l2_path(family: str) -> bool:
     return family in ("grid", "sparse")
 
 
+def make_production_solver(graph: Graph):
+    """Stage the graph's production arrays (prep — the transfer-overlapped
+    host passes happen HERE, so callers can clock prep separately) and
+    return ``solve(on_chunk=None) -> (mst, fragment, levels)``.
+
+    This is the SINGLE routing source — ``solve_graph_rank``, the
+    checkpoint path, ``bench.py``, and the instrumented metrics all call
+    it, so a retune cannot route production down a different kernel than
+    the ones benchmarked/instrumented. Routing (r5): road families
+    (``use_l2_path``) -> host L1+L2 + :func:`solve_rank_l2`; dense at
+    filter scale -> host L1 + prefix-L2 + the filter-Kruskal path (the
+    speculative single-dispatch variant only when no ``on_chunk`` is
+    requested — it has no chunk boundaries); everything else -> the
+    staged path."""
+    family = _pick_family(graph)
+    if use_l2_path(family):
+        vmin0, ra, rb, parent12, l2_ranks = prepare_rank_arrays_l2(graph)
+
+        def solve(on_chunk=None):
+            return solve_rank_l2(
+                vmin0, ra, rb, parent12, l2_ranks, on_chunk=on_chunk
+            )
+    elif use_filtered_path(family, _bucket_size(graph.num_edges)):
+        vmin0, ra, rb, parent1, parent12, l2_ranks, _prefix = (
+            prepare_rank_arrays_filtered(graph)
+        )
+
+        def solve(on_chunk=None):
+            if on_chunk is None:
+                return solve_rank_auto(
+                    vmin0, ra, rb, family=family, parent1=parent1,
+                    parent12=parent12, l2_ranks=l2_ranks,
+                )
+            return solve_rank_filtered(
+                vmin0, ra, rb, on_chunk=on_chunk, parent1=parent1,
+                parent12=parent12, l2_ranks=l2_ranks,
+            )
+    else:
+        vmin0, ra, rb, parent1 = prepare_rank_arrays_full(graph)
+
+        def solve(on_chunk=None):
+            if on_chunk is None:
+                return solve_rank_auto(
+                    vmin0, ra, rb, family=family, parent1=parent1
+                )
+            return solve_rank_staged(
+                vmin0, ra, rb, **_family_params(family),
+                on_chunk=on_chunk, parent1=parent1,
+            )
+    return solve
+
+
 def solve_graph_rank(graph: Graph) -> Tuple[np.ndarray, np.ndarray, int]:
     """Host entry matching ``models.boruvka.solve_graph``'s contract."""
     n = graph.num_nodes
     if n == 0 or graph.num_edges == 0:
         return np.zeros(0, dtype=np.int64), np.arange(n, dtype=np.int32), 0
-    family = _pick_family(graph)
-    if use_l2_path(family):
-        # Road families: host levels 1+2, device starts at the level-3
-        # relabel (r5 — the head's L2 work was the dominant cost on both:
-        # the 23.9M grid drops 14.6 -> 9.3 s and the config-5 road
-        # network 10.1 -> 4.4 s, byte-identical, with the host pass
-        # hidden under the staging transfer).
-        vmin0, ra, rb, parent12, l2_ranks = prepare_rank_arrays_l2(graph)
-        mst, fragment, levels = solve_rank_l2(vmin0, ra, rb, parent12, l2_ranks)
-    else:
-        # Dense: the filtered path's prefix level 2 is host-precomputed
-        # too (r5; parent12/l2_ranks are None when the split is
-        # degenerate and the staged fallback takes parent1).
-        vmin0, ra, rb, parent1, parent12, l2_ranks, _prefix = (
-            prepare_rank_arrays_filtered(graph)
-        )
-        mst, fragment, levels = solve_rank_auto(
-            vmin0, ra, rb, family=family, parent1=parent1,
-            parent12=parent12, l2_ranks=l2_ranks,
-        )
+    mst, fragment, levels = make_production_solver(graph)()
     return fetch_mst_edge_ids(graph, mst), np.asarray(fragment)[:n], levels

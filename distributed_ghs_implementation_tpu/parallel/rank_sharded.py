@@ -272,16 +272,13 @@ def _rank_sharded_head(vmin0, parent1, ra, rb):
     return fragment, mst, fa, fb, jnp.stack([lv, total, cmax])
 
 
-def _rank_sharded_finish(fragment, mst, fa, fb, *, fs_local: int, max_levels: int):
-    """Per-shard body: compact local survivors, all-gather, run the remaining
-    levels replicated (each shard marks only its own rank block)."""
+def _finish_gathered_loop(fragment, mst, cfa, cfb, crank, k, mb, max_levels):
+    """All-gather per-shard compacted survivors and run the remaining levels
+    replicated (each shard marks only its own rank block) — the shared tail
+    of :func:`_rank_sharded_finish` and :func:`_rank_sharded_finish_pre`.
+    Shard-block concatenation keeps ascending global-rank order among the
+    valid entries, so the gathered slot index is a valid tie-break order."""
     n = fragment.shape[0]
-    mb = fa.shape[0]
-    k = jax.lax.axis_index(EDGE_AXIS).astype(jnp.int32)
-    crank_local = k * mb + jnp.arange(mb, dtype=jnp.int32)
-    cfa, cfb, crank, _ = _compact_slots(fa, fb, crank_local, fs_local)
-    # Shard-block concatenation keeps ascending global-rank order among the
-    # valid entries, so the gathered slot index is a valid tie-break order.
     gfa = jax.lax.all_gather(cfa, EDGE_AXIS, tiled=True)
     gfb = jax.lax.all_gather(cfb, EDGE_AXIS, tiled=True)
     gcrank = jax.lax.all_gather(crank, EDGE_AXIS, tiled=True)
@@ -303,6 +300,28 @@ def _rank_sharded_finish(fragment, mst, fa, fb, *, fs_local: int, max_levels: in
     state = (fragment, mst, gfa, gfb, alive, jnp.zeros((), jnp.int32))
     fragment, mst, _, _, _, lv = jax.lax.while_loop(cond, body, state)
     return fragment, mst, lv
+
+
+def _rank_sharded_finish(fragment, mst, fa, fb, *, fs_local: int, max_levels: int):
+    """Per-shard body: compact local survivors, all-gather, run the remaining
+    levels replicated (each shard marks only its own rank block)."""
+    mb = fa.shape[0]
+    k = jax.lax.axis_index(EDGE_AXIS).astype(jnp.int32)
+    crank_local = k * mb + jnp.arange(mb, dtype=jnp.int32)
+    cfa, cfb, crank, _ = _compact_slots(fa, fb, crank_local, fs_local)
+    return _finish_gathered_loop(
+        fragment, mst, cfa, cfb, crank, k, mb, max_levels
+    )
+
+
+def _rank_sharded_finish_pre(fragment, mst, cfa, cfb, crank, *, max_levels: int):
+    """Per-shard body for ALREADY-COMPACTED survivors (the fused
+    filter+compact path): all-gather + replicated levels only."""
+    mb = mst.shape[0]
+    k = jax.lax.axis_index(EDGE_AXIS).astype(jnp.int32)
+    return _finish_gathered_loop(
+        fragment, mst, cfa, cfb, crank, k, mb, max_levels
+    )
 
 
 # ---------------------------------------------------------------------------
@@ -418,6 +437,60 @@ def make_rank_sharded_l1(mesh: Mesh):
         mesh,
         in_specs=(P(), P(), P(EDGE_AXIS)),
         out_specs=(P(), P(EDGE_AXIS)),
+    )
+    return jax.jit(mapped)
+
+
+def _rank_filter_compact(
+    fragment, prefix_mask, mst, ra, rb, *, prefix: int, fs_local: int
+):
+    """Fused per-shard filter + survivor compaction (r5): one dispatch, no
+    mb-wide ``fa``/``fb`` HBM round trip between the filter and the finish
+    (the sharded analog of the single-chip ``_filter_suffix_fused``;
+    measured 0.98 + 0.55 s as two steps at RMAT-24/8 width).
+    ``fs_local`` is speculative — callers read ``cmax`` from the stats and
+    fall back to the two-step path on overflow. ``crank`` carries global
+    ranks, so the output feeds ``_rank_sharded_finish_pre`` directly."""
+    mb = ra.shape[0]
+    k = jax.lax.axis_index(EDGE_AXIS).astype(jnp.int32)
+    gi = k * mb + jnp.arange(mb, dtype=jnp.int32)
+    fa = fragment[ra]
+    fb = fragment[rb]
+    in_prefix = gi < prefix
+    mst = mst | (in_prefix & prefix_mask[jnp.minimum(gi, prefix - 1)])
+    cfa, cfb, crank, _ = _compact_slots(fa, fb, gi, fs_local)
+    local_alive = jnp.sum((fa != fb).astype(jnp.int32))
+    total = jax.lax.psum(local_alive, EDGE_AXIS)
+    cmax = jax.lax.pmax(local_alive, EDGE_AXIS)
+    return mst, cfa, cfb, crank, jnp.stack([total, cmax])
+
+
+@functools.lru_cache(maxsize=64)
+def make_rank_filter_compact(mesh: Mesh, prefix: int, fs_local: int):
+    fn = functools.partial(
+        _rank_filter_compact, prefix=prefix, fs_local=fs_local
+    )
+    mapped = shard_map_compat(
+        fn,
+        mesh,
+        in_specs=(P(), P(), P(EDGE_AXIS), P(EDGE_AXIS), P(EDGE_AXIS)),
+        out_specs=(
+            P(EDGE_AXIS), P(EDGE_AXIS), P(EDGE_AXIS), P(EDGE_AXIS), P(),
+        ),
+    )
+    return jax.jit(mapped)
+
+
+@functools.lru_cache(maxsize=32)
+def make_rank_sharded_finish_pre(mesh: Mesh, max_levels: int):
+    fn = functools.partial(_rank_sharded_finish_pre, max_levels=max_levels)
+    mapped = shard_map_compat(
+        fn,
+        mesh,
+        in_specs=(
+            P(), P(EDGE_AXIS), P(EDGE_AXIS), P(EDGE_AXIS), P(EDGE_AXIS),
+        ),
+        out_specs=(P(), P(EDGE_AXIS), P()),
     )
     return jax.jit(mapped)
 
@@ -706,6 +779,7 @@ def solve_graph_rank_sharded(
         filtered = (
             use_filtered_path(_pick_family(graph), m_pad) and 2 * prefix <= m_pad
         )
+    fused = None  # set by the filtered branch when its fused compact fits
     if initial_state is not None:
         frag_np, mask_np, lv = _restore_state_host(initial_state, n_pad, m_pad)
         fragment = _stage(frag_np, rep)
@@ -757,9 +831,27 @@ def solve_graph_rank_sharded(
             chunk_levels=3, compact_space=n_pad >= _CENSUS_MIN_SPACE,
             on_chunk=hook,
         )
-        filt = make_rank_filter_relabel(mesh, prefix)
-        mst, fa, fb, fstats = filt(fragment, mst_p, mst, ra, rb)
+        # Fused filter + compaction (speculative survivor width; the
+        # gathered width is clamped under the finish budget so the
+        # capacity guard is never needed on this path). Overflow falls
+        # back to the exact two-step filter (re-merging the prefix marks
+        # is idempotent).
+        fs_spec = min(
+            max(_bucket_size(mb // 128), 1024),
+            _FINISH_GATHER_MAX_SLOTS // n_dev,
+        )
+        fc = make_rank_filter_compact(mesh, prefix, fs_spec)
+        mst, cfa, cfb, crank, fstats = fc(fragment, mst_p, mst, ra, rb)
         total, cmax = (int(x) for x in jax.device_get(fstats))
+        if cmax <= fs_spec:
+            fused = (cfa, cfb, crank)
+            fa = fb = None
+        else:
+            fused = None
+            del cfa, cfb, crank
+            filt = make_rank_filter_relabel(mesh, prefix)
+            mst, fa, fb, fstats = filt(fragment, mst_p, mst, ra, rb)
+            total, cmax = (int(x) for x in jax.device_get(fstats))
     elif rank64:
         head = make_rank_sharded_head_kl(mesh)
         fragment, mst, fa, fb, stats = head(vk, vl, parent1, ra, rb)
@@ -776,38 +868,52 @@ def solve_graph_rank_sharded(
             lv, fragment,
             lambda mst_=mst: _full_mask_host(mesh, mst_, m_pad), total,
         )
-    # Capacity guard before the finish: shrink the alive set with in-place
-    # sharded levels while the would-be gathered width exceeds the budget.
-    # A high-diameter graph can spend many levels here, so checkpoint every
-    # _GUARD_CHECKPOINT_EVERY iterations — the decision is a pure function
-    # of the loop counter, hence SPMD-identical across processes (the
-    # harvest inside mask_fn is a collective).
-    guard_iters = 0
-    while total > 0 and n_dev * _bucket_size(cmax) > _FINISH_GATHER_MAX_SLOTS:
-        level_fn = make_rank_sharded_level(mesh, rank64)
-        fragment, mst, fa, fb, lstats = level_fn(fragment, mst, fa, fb)
-        total, cmax, progressed = (int(x) for x in jax.device_get(lstats))
-        lv += 1
-        guard_iters += 1
-        if not progressed:
-            break  # isolated remainder (disconnected pads); nothing to gather
-        if on_chunk is not None and guard_iters % _GUARD_CHECKPOINT_EVERY == 0:
-            on_chunk(
-                lv, fragment,
-                lambda mst_=mst: _full_mask_host(mesh, mst_, m_pad), total,
+    if fused is not None:
+        # Fused filtered path: survivors arrive pre-compacted and the
+        # gathered width is under the finish budget by construction — no
+        # capacity guard.
+        if total > 0:
+            finish = make_rank_sharded_finish_pre(mesh, _max_levels(n_pad))
+            fragment, mst, extra = finish(fragment, mst, *fused)
+            lv += int(extra)
+            if on_chunk is not None:
+                on_chunk(
+                    lv, fragment,
+                    lambda mst_=mst: _full_mask_host(mesh, mst_, m_pad), 0,
+                )
+    else:
+        # Capacity guard before the finish: shrink the alive set with
+        # in-place sharded levels while the would-be gathered width exceeds
+        # the budget. A high-diameter graph can spend many levels here, so
+        # checkpoint every _GUARD_CHECKPOINT_EVERY iterations — the decision
+        # is a pure function of the loop counter, hence SPMD-identical
+        # across processes (the harvest inside mask_fn is a collective).
+        guard_iters = 0
+        while total > 0 and n_dev * _bucket_size(cmax) > _FINISH_GATHER_MAX_SLOTS:
+            level_fn = make_rank_sharded_level(mesh, rank64)
+            fragment, mst, fa, fb, lstats = level_fn(fragment, mst, fa, fb)
+            total, cmax, progressed = (int(x) for x in jax.device_get(lstats))
+            lv += 1
+            guard_iters += 1
+            if not progressed:
+                break  # isolated remainder (disconnected pads)
+            if on_chunk is not None and guard_iters % _GUARD_CHECKPOINT_EVERY == 0:
+                on_chunk(
+                    lv, fragment,
+                    lambda mst_=mst: _full_mask_host(mesh, mst_, m_pad), total,
+                )
+        if total > 0:
+            fs_local = max(_bucket_size(cmax), 1024)
+            finish = make_rank_sharded_finish(
+                mesh, fs_local, _max_levels(n_pad), rank64
             )
-    if total > 0:
-        fs_local = max(_bucket_size(cmax), 1024)
-        finish = make_rank_sharded_finish(
-            mesh, fs_local, _max_levels(n_pad), rank64
-        )
-        fragment, mst, extra = finish(fragment, mst, fa, fb)
-        lv += int(extra)
-        if on_chunk is not None:
-            on_chunk(
-                lv, fragment,
-                lambda mst_=mst: _full_mask_host(mesh, mst_, m_pad), 0,
-            )
+            fragment, mst, extra = finish(fragment, mst, fa, fb)
+            lv += int(extra)
+            if on_chunk is not None:
+                on_chunk(
+                    lv, fragment,
+                    lambda mst_=mst: _full_mask_host(mesh, mst_, m_pad), 0,
+                )
     if jax.process_count() > 1:
         # One packed all-gather makes the rank-block-sharded mask
         # addressable on every process.

@@ -117,18 +117,10 @@ def solve_graph_checkpointed(
 
     if strategy == "rank":
         from distributed_ghs_implementation_tpu.models.rank_solver import (
-            _bucket_size,
-            _family_params,
             _pick_family,
-            prepare_rank_arrays_filtered,
+            make_production_solver,
             prepare_rank_arrays_full,
-            prepare_rank_arrays_l2,
-            solve_rank_filtered,
-            solve_rank_l2,
             solve_rank_resume,
-            solve_rank_staged,
-            use_filtered_path,
-            use_l2_path,
         )
 
         chunks_seen = [0]
@@ -142,7 +134,6 @@ def solve_graph_checkpointed(
                     checkpoint_path, fragment, mst_ranks, level, fingerprint=fp
                 )
 
-        family = _pick_family(graph)
         if initial_state is not None:
             # Resume is exact from any saved partition; solve_rank_resume
             # picks the chunked endpoint rebuild at widths where a
@@ -150,32 +141,14 @@ def solve_graph_checkpointed(
             # chunked filter exists for).
             vmin0, ra, rb, _parent1 = prepare_rank_arrays_full(graph)
             mst_ranks, fragment, levels = solve_rank_resume(
-                vmin0, ra, rb, initial_state, family=family, on_chunk=on_chunk
-            )
-        elif use_l2_path(family):
-            # Road families: host levels 1+2 (same routing as
-            # solve_graph_rank), same on_chunk contract.
-            vmin0, ra, rb, parent12, l2_ranks = prepare_rank_arrays_l2(graph)
-            mst_ranks, fragment, levels = solve_rank_l2(
-                vmin0, ra, rb, parent12, l2_ranks, on_chunk=on_chunk
-            )
-        elif use_filtered_path(family, _bucket_size(graph.num_edges)):
-            # Fresh dense solve: the filter-Kruskal path with the
-            # host-precomputed prefix level 2, same on_chunk contract.
-            vmin0, ra, rb, parent1, parent12, l2_ranks, _prefix = (
-                prepare_rank_arrays_filtered(graph)
-            )
-            mst_ranks, fragment, levels = solve_rank_filtered(
-                vmin0, ra, rb, on_chunk=on_chunk, parent1=parent1,
-                parent12=parent12, l2_ranks=l2_ranks,
+                vmin0, ra, rb, initial_state, family=_pick_family(graph),
+                on_chunk=on_chunk,
             )
         else:
-            vmin0, ra, rb, parent1 = prepare_rank_arrays_full(graph)
-            mst_ranks, fragment, levels = solve_rank_staged(
-                vmin0, ra, rb,
-                **_family_params(family),
-                on_chunk=on_chunk,
-                parent1=parent1,
+            # Fresh solve: the production routing, with the checkpoint
+            # hook (make_production_solver is the single routing source).
+            mst_ranks, fragment, levels = make_production_solver(graph)(
+                on_chunk=on_chunk
             )
     elif strategy == "stepped":
         from distributed_ghs_implementation_tpu.models.boruvka import (

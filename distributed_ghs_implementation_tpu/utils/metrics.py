@@ -100,17 +100,10 @@ def _solve_rank_instrumented(graph) -> tuple:
     """Rank-solver instrumentation via its ``on_chunk`` hook (chunk-boundary
     granularity; the alive count there is undirected already)."""
     from distributed_ghs_implementation_tpu.models.rank_solver import (
-        _family_params,
-        _pick_family,
-        prepare_rank_arrays_full,
-        prepare_rank_arrays_l2,
-        solve_rank_l2,
-        solve_rank_staged,
-        use_l2_path,
+        make_production_solver,
     )
 
     n = graph.num_nodes
-    family = _pick_family(graph)
     records = []
     frags_before = [n]
     last = [time.perf_counter()]
@@ -130,26 +123,14 @@ def _solve_rank_instrumented(graph) -> tuple:
         frags_before[0] = frags_after
         last[0] = now
 
-    t_start = time.perf_counter()
-    if use_l2_path(family):
-        # Same routing as solve_graph_rank: the instrumented path must
-        # measure the kernel production runs.
-        vmin0, ra, rb, parent12, l2_ranks = prepare_rank_arrays_l2(graph)
-        last[0] = time.perf_counter()
-        t_start = last[0]
-        mst_ranks, fragment, levels = solve_rank_l2(
-            vmin0, ra, rb, parent12, l2_ranks, on_chunk=on_chunk
-        )
-    else:
-        vmin0, ra, rb, parent1 = prepare_rank_arrays_full(graph)
-        last[0] = time.perf_counter()
-        t_start = last[0]
-        mst_ranks, fragment, levels = solve_rank_staged(
-            vmin0, ra, rb,
-            **_family_params(family),
-            on_chunk=on_chunk,
-            parent1=parent1,
-        )
+    # make_production_solver is the single routing source shared with
+    # solve_graph_rank: the instrumented path measures the kernels
+    # production runs (passing on_chunk selects the chunked forms — the
+    # speculative single-dispatch variant has no boundaries to instrument).
+    solve = make_production_solver(graph)
+    last[0] = time.perf_counter()
+    t_start = last[0]
+    mst_ranks, fragment, levels = solve(on_chunk=on_chunk)
     total = time.perf_counter() - t_start
 
     ranks_chosen = np.nonzero(np.asarray(mst_ranks))[0]

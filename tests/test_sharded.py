@@ -222,3 +222,26 @@ def test_rank64_split_key_child():
     )
     assert proc.returncode == 0, proc.stdout + proc.stderr
     assert "rank64 child ok" in proc.stdout
+
+
+def test_filtered_fused_overflow_fallback(monkeypatch):
+    """The fused filter+compact speculates the per-shard survivor width;
+    when a shard overflows it must fall back to the exact two-step filter
+    (and from there the capacity guard), landing on the identical MST."""
+    from distributed_ghs_implementation_tpu.parallel import rank_sharded as rsh
+
+    g = rmat_graph(11, 16, seed=9)
+    ref = np.sort(minimum_spanning_forest(g, backend="device").edge_ids)
+    used = []
+    orig = rsh.make_rank_filter_relabel
+
+    def spying(mesh, prefix):
+        used.append(1)
+        return orig(mesh, prefix)
+
+    monkeypatch.setattr(rsh, "make_rank_filter_relabel", spying)
+    # Tiny gather budget -> tiny speculative width -> guaranteed overflow.
+    monkeypatch.setattr(rsh, "_FINISH_GATHER_MAX_SLOTS", 64)
+    ids, _, _ = rsh.solve_graph_rank_sharded(g, filtered=True)
+    assert used, "overflow did not reach the two-step fallback"
+    assert np.array_equal(np.sort(ids), ref)

@@ -167,6 +167,13 @@ def main() -> int:
         compact, fa_blk, fb_blk, crank_blk, out_size=fs_local
     )
 
+    # --- T_filter_compact: the FUSED per-shard filter+compaction (the
+    # production path; the two separate terms above are its fallback) ------
+    fc = rsh.make_rank_filter_compact(mesh1, 8, fs_local)
+    res["t_filter_compact_fused_s"], _out = t(
+        fc, fragment_f, stub_mask, mst_blk, ra_blk, rb_blk
+    )
+
     # --- T_finish: survivor finish at the gathered width. Emulate the
     # all-gather output: per-shard compactions concatenated in block order
     # (that IS what all_gather produces), then finish replicated ------------
