@@ -401,23 +401,35 @@ def _prefix_relabel_l2(parent12, ra_p, rb_p, l2_ranks):
     )
 
 
-def _rank_filter_relabel(fragment, prefix_mask, mst, ra, rb, *, prefix: int):
-    """Per-shard body: the one edge-width pass. Relabels the local rank block
-    against the final prefix partition (dropped slots are exactly the edges
-    the cycle rule excludes) and merges the replicated prefix MST marks into
-    the shard that owns them."""
+def _filter_core(fragment, prefix_mask, mst, ra, rb, prefix, k):
+    """The shared filter body of ``_rank_filter_relabel`` (two-step) and
+    ``_rank_filter_compact`` (fused): relabel the local rank block against
+    the final prefix partition (dropped slots are exactly the edges the
+    cycle rule excludes; prefix slots are all intra-fragment by now and
+    fall out of ``alive`` with no special-casing) and merge the replicated
+    prefix MST marks into the shard that owns them. One body so the fused
+    path and its overflow fallback cannot diverge semantically. Returns
+    ``(mst, fa, fb, gi, total, cmax)``."""
     mb = ra.shape[0]
-    k = jax.lax.axis_index(EDGE_AXIS).astype(jnp.int32)
     gi = k * mb + jnp.arange(mb, dtype=jnp.int32)
     fa = fragment[ra]
     fb = fragment[rb]
     in_prefix = gi < prefix
     mst = mst | (in_prefix & prefix_mask[jnp.minimum(gi, prefix - 1)])
-    # Prefix slots are all intra-fragment by now; they fall out of `alive`
-    # with no special-casing.
     local_alive = jnp.sum((fa != fb).astype(jnp.int32))
     total = jax.lax.psum(local_alive, EDGE_AXIS)
     cmax = jax.lax.pmax(local_alive, EDGE_AXIS)
+    return mst, fa, fb, gi, total, cmax
+
+
+def _rank_filter_relabel(fragment, prefix_mask, mst, ra, rb, *, prefix: int):
+    """Per-shard body: the one edge-width pass (two-step form — the fused
+    :func:`_rank_filter_compact` is the production path; this is its
+    overflow fallback and the resume-adjacent entry)."""
+    k = jax.lax.axis_index(EDGE_AXIS).astype(jnp.int32)
+    mst, fa, fb, _gi, total, cmax = _filter_core(
+        fragment, prefix_mask, mst, ra, rb, prefix, k
+    )
     return mst, fa, fb, jnp.stack([total, cmax])
 
 
@@ -451,17 +463,11 @@ def _rank_filter_compact(
     ``fs_local`` is speculative — callers read ``cmax`` from the stats and
     fall back to the two-step path on overflow. ``crank`` carries global
     ranks, so the output feeds ``_rank_sharded_finish_pre`` directly."""
-    mb = ra.shape[0]
     k = jax.lax.axis_index(EDGE_AXIS).astype(jnp.int32)
-    gi = k * mb + jnp.arange(mb, dtype=jnp.int32)
-    fa = fragment[ra]
-    fb = fragment[rb]
-    in_prefix = gi < prefix
-    mst = mst | (in_prefix & prefix_mask[jnp.minimum(gi, prefix - 1)])
+    mst, fa, fb, gi, total, cmax = _filter_core(
+        fragment, prefix_mask, mst, ra, rb, prefix, k
+    )
     cfa, cfb, crank, _ = _compact_slots(fa, fb, gi, fs_local)
-    local_alive = jnp.sum((fa != fb).astype(jnp.int32))
-    total = jax.lax.psum(local_alive, EDGE_AXIS)
-    cmax = jax.lax.pmax(local_alive, EDGE_AXIS)
     return mst, cfa, cfb, crank, jnp.stack([total, cmax])
 
 

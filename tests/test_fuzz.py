@@ -196,3 +196,32 @@ def test_filtered_resume_from_every_boundary(stop_at):
     ranks = np.nonzero(np.asarray(mst_r))[0]
     ids_r = np.sort(g.edge_id_of_rank(ranks))
     assert np.array_equal(ids_r, ref_ids), f"resume from boundary {stop_at}"
+
+
+@pytest.mark.parametrize("seed", range(8))
+def test_production_routing_fuzz(seed):
+    """solve_graph_rank's production routing (host L1/L2 per family, the
+    r5 paths) vs the plain Borůvka reference, across random densities that
+    straddle every family-policy boundary (sparse <=3 < grid <=8 < dense)
+    plus disconnection and isolated vertices."""
+    from distributed_ghs_implementation_tpu.models.boruvka import solve_graph
+    from distributed_ghs_implementation_tpu.models.rank_solver import (
+        _pick_family,
+        solve_graph_rank,
+    )
+
+    rng = np.random.default_rng(1000 + seed)
+    n = int(rng.integers(30, 400))
+    # Density sweeps the family policy: avg degree in [1, 12].
+    m = int(n * rng.uniform(0.5, 6.0))
+    g = Graph.from_arrays(
+        n + int(rng.integers(0, 5)),  # a few isolated vertices
+        rng.integers(0, n, m),
+        rng.integers(0, n, m),
+        rng.integers(1, int(rng.choice([5, 1000])), m),  # tie-heavy or wide
+    )
+    fam = _pick_family(g)
+    ids, frag, _ = solve_graph_rank(g)
+    ref_ids, ref_frag, _ = solve_graph(g)
+    assert np.array_equal(ids, ref_ids), fam
+    assert np.unique(frag).size == np.unique(ref_frag).size
