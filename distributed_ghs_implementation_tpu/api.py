@@ -12,6 +12,9 @@ spawning threads. ``backend`` selects the execution path:
     replacement for the MPI backend (C3/C5/C7).
   * ``"protocol"`` — the message-level GHS state machine on the deterministic
     event-queue transport (protocol-parity backend, C1/C4/C5).
+  * ``"host"`` — native single-core Kruskal over the precomputed rank order
+    (byte-identical; the measured CPU baseline and a no-accelerator escape
+    hatch — integer weights + native toolchain required).
 """
 
 from __future__ import annotations
@@ -98,7 +101,15 @@ def _solve(graph: Graph, backend: str) -> Tuple[np.ndarray, np.ndarray, int]:
         except ImportError as e:
             raise NotImplementedError("protocol backend unavailable") from e
         return solve_graph_protocol(graph)
-    raise ValueError(f"unknown backend {backend!r}; expected device|sharded|protocol")
+    if backend == "host":
+        from distributed_ghs_implementation_tpu.models.rank_solver import (
+            solve_graph_kruskal_host,
+        )
+
+        return solve_graph_kruskal_host(graph)
+    raise ValueError(
+        f"unknown backend {backend!r}; expected device|sharded|protocol|host"
+    )
 
 
 def minimum_spanning_forest(

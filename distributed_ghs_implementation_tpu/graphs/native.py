@@ -108,6 +108,11 @@ def get_lib() -> Optional[ctypes.CDLL]:
             ctypes.c_int64, ctypes.c_int64, _I64, _I64, _I64, _I64, _I64,
         ]
         lib.kruskal_msf.restype = None
+        lib.kruskal_msf_solve.argtypes = [
+            ctypes.c_int64, ctypes.c_int64, _I64, _I64, _I64, _I64, _I64,
+            _I64,
+        ]
+        lib.kruskal_msf_solve.restype = ctypes.c_int64
         lib.rank_endpoints_i32.argtypes = [
             ctypes.c_int64, ctypes.c_int64, _I64, _I64, _I64, _I32, _I32,
         ]
@@ -221,6 +226,38 @@ def kruskal_msf_native(
             "rank order is not a non-decreasing permutation of the edges"
         )
     return int(out[0]), int(out[1])
+
+
+def kruskal_msf_solve_native(
+    num_nodes: int, order: np.ndarray, u: np.ndarray, v: np.ndarray,
+    w: np.ndarray
+) -> Tuple[np.ndarray, np.ndarray]:
+    """Full Kruskal solve over the precomputed rank order: ``(edge_ids,
+    labels)`` — the chosen MSF edges (ascending rank order) and the final
+    per-vertex component label. Same order validation as
+    :func:`kruskal_msf_native` (raises ``ValueError`` on corruption).
+    Because ranks make the weight order total, the edge set is THE unique
+    MSF — byte-identical to every device backend."""
+    lib = get_lib()
+    if lib is None:
+        raise RuntimeError("native library unavailable")
+    order = np.ascontiguousarray(order, dtype=np.int64)
+    u = np.ascontiguousarray(u, dtype=np.int64)
+    v = np.ascontiguousarray(v, dtype=np.int64)
+    w = np.ascontiguousarray(w, dtype=np.int64)
+    out_edges = np.empty(max(num_nodes, 1), dtype=np.int64)
+    labels = np.empty(max(num_nodes, 1), dtype=np.int64)
+    count = int(
+        lib.kruskal_msf_solve(
+            num_nodes, order.shape[0], _ptr(order), _ptr(u), _ptr(v),
+            _ptr(w), _ptr(out_edges), _ptr(labels),
+        )
+    )
+    if count < 0:
+        raise ValueError(
+            "rank order is not a non-decreasing permutation of the edges"
+        )
+    return out_edges[:count], labels[:num_nodes]
 
 
 def first_rank64_native(

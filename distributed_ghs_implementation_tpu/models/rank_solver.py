@@ -1672,6 +1672,37 @@ def make_production_solver(graph: Graph):
     return solve
 
 
+def solve_graph_kruskal_host(graph: Graph) -> Tuple[np.ndarray, np.ndarray, int]:
+    """Host-native Kruskal over the precomputed rank order (the
+    ``backend="host"`` entry): one C union-find pass, byte-identical to
+    every device backend (ranks make the weight order total, so the MSF
+    is unique). Measured against the device paths (r5,
+    docs/BENCH_NOTES.md): the DEVICE wins on every family — RMAT-22
+    2.53 s vs 6.46 s host (2.6x), config-5 road network 4.36 vs 4.64 s,
+    23.9M road grid 9.28 vs 13.47 s — i.e. after the host-L1/L2 work the
+    TPU path beats the single-core Kruskal baseline even on the
+    gather-bound road graphs. This entry exists as that measured
+    baseline (the reference never had one), as the oracle's solve form,
+    and as an escape hatch for CPU-only hosts; production routing stays
+    on the device paths, which also own checkpointing, sharding, and the
+    instrumented observability. ``levels`` is reported as 0 (no Borůvka
+    levels run). Integer weights only (the rank order is the native
+    counting sort); float weights raise ``NotImplementedError``."""
+    n = graph.num_nodes
+    if n == 0 or graph.num_edges == 0:
+        return np.zeros(0, dtype=np.int64), np.arange(n, dtype=np.int32), 0
+    if not graph.is_integer_weighted:
+        raise NotImplementedError("host backend needs integer weights")
+    from distributed_ghs_implementation_tpu.graphs import native
+
+    if not native.native_available():
+        raise NotImplementedError("host backend needs the native toolchain")
+    edge_ids, labels = native.kruskal_msf_solve_native(
+        n, graph._rank_order, graph.u, graph.v, graph.w
+    )
+    return np.sort(edge_ids), labels.astype(np.int32), 0
+
+
 def solve_graph_rank(graph: Graph) -> Tuple[np.ndarray, np.ndarray, int]:
     """Host entry matching ``models.boruvka.solve_graph``'s contract."""
     n = graph.num_nodes

@@ -280,34 +280,55 @@ static int64_t uf_find(int64_t* p, int64_t x) {
   return x;
 }
 
-// Validates `order` instead of trusting it: the solver under test consumes
-// the SAME precomputed order, so an independent oracle must prove (a) the
-// order is a permutation of [0, m) and (b) weights are non-decreasing along
-// it — given both, Kruskal's weight equals the true MSF weight regardless
-// of how ties were broken. On violation writes out[1] = -1 (caller falls
-// back to the independently-sorted SciPy oracle).
-void kruskal_msf(int64_t n, int64_t m, const int64_t* order, const int64_t* u,
-                 const int64_t* v, const int64_t* w, int64_t* out) {
+// Full Kruskal SOLVE over edges in the given (weight, edge id) order:
+// emits the chosen edge ids (ascending rank order) and the final
+// per-vertex component label (fully path-compressed). Validates `order`
+// instead of trusting it: the solver under test consumes the SAME
+// precomputed order, so an independent check must prove (a) the order is
+// a permutation of [0, m) and (b) weights are non-decreasing along it —
+// given both, the result is the true unique MSF regardless of how ties
+// were broken. Returns the MSF edge count, or -1 on a corrupt order.
+int64_t kruskal_msf_solve(int64_t n, int64_t m, const int64_t* order,
+                          const int64_t* u, const int64_t* v,
+                          const int64_t* w, int64_t* out_edges,
+                          int64_t* labels) {
   std::vector<int64_t> parent((size_t)n);
   for (int64_t i = 0; i < n; ++i) parent[i] = i;
   std::vector<uint8_t> seen((size_t)m, 0);
-  int64_t total = 0, count = 0, prev_w = 0;
+  int64_t count = 0, prev_w = 0;
   for (int64_t r = 0; r < m; ++r) {
     const int64_t e = order[r];
-    if (e < 0 || e >= m || seen[e] || (r > 0 && w[e] < prev_w)) {
-      out[0] = 0;
-      out[1] = -1;  // not a non-decreasing permutation: order is corrupt
-      return;
-    }
+    if (e < 0 || e >= m || seen[e] || (r > 0 && w[e] < prev_w)) return -1;
     seen[e] = 1;
     prev_w = w[e];
     const int64_t ru = uf_find(parent.data(), u[e]);
     const int64_t rv = uf_find(parent.data(), v[e]);
     if (ru == rv) continue;
     parent[ru] = rv;
-    total += w[e];
-    ++count;
+    out_edges[count++] = e;
   }
+  for (int64_t i = 0; i < n; ++i) labels[i] = uf_find(parent.data(), i);
+  return count;
+}
+
+// Weight-only oracle form: one body with kruskal_msf_solve (a divergence
+// between the oracle and the host solve would be the quiet kind of bug —
+// share the loop). Writes [total_weight, edge_count]; edge_count = -1 on
+// a corrupt order (caller falls back to the independently-sorted SciPy
+// oracle).
+void kruskal_msf(int64_t n, int64_t m, const int64_t* order, const int64_t* u,
+                 const int64_t* v, const int64_t* w, int64_t* out) {
+  std::vector<int64_t> edges((size_t)(n > 0 ? n : 1));
+  std::vector<int64_t> labels((size_t)(n > 0 ? n : 1));
+  const int64_t count =
+      kruskal_msf_solve(n, m, order, u, v, w, edges.data(), labels.data());
+  if (count < 0) {
+    out[0] = 0;
+    out[1] = -1;
+    return;
+  }
+  int64_t total = 0;
+  for (int64_t i = 0; i < count; ++i) total += w[edges[i]];
   out[0] = total;
   out[1] = count;
 }

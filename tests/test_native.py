@@ -173,3 +173,32 @@ def test_kruskal_native_rejects_corrupt_order():
     g.__dict__["_rank_order"] = bad
     w = native_mst_weight(g)
     assert w is None or abs(w - scipy_mst_weight(g)) < 1e-6
+
+
+@pytest.mark.parametrize("seed", range(4))
+def test_host_backend_byte_identical(seed):
+    """backend='host' (native Kruskal solve) must produce the byte-identical
+    MSF edge set and component structure as the device backend."""
+    import numpy as np
+
+    from distributed_ghs_implementation_tpu.api import minimum_spanning_forest
+    from distributed_ghs_implementation_tpu.graphs import native
+    from distributed_ghs_implementation_tpu.graphs.generators import (
+        erdos_renyi_graph,
+        rmat_graph,
+        road_grid_graph,
+    )
+
+    if not native.native_available():
+        pytest.skip("native library unavailable")
+    graphs = [
+        erdos_renyi_graph(150, 0.05, seed=seed),
+        rmat_graph(10, 8, seed=seed),
+        road_grid_graph(25, 25, seed=seed, keep_prob=0.7),
+    ]
+    for g in graphs:
+        rh = minimum_spanning_forest(g, backend="host")
+        rd = minimum_spanning_forest(g, backend="device")
+        assert np.array_equal(rh.edge_ids, rd.edge_ids)
+        assert rh.num_components == rd.num_components
+        assert rh.total_weight == rd.total_weight
