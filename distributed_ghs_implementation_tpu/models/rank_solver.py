@@ -1274,12 +1274,20 @@ def _filtered_head_l2(vmin0, ra, rb, parent12, l2_ranks, *, prefix: int):
 def prepare_rank_arrays_filtered(graph: Graph):
     """:func:`prepare_rank_arrays_full` plus the host level-2 pass over the
     FILTER PREFIX (the dense-family production prep): ``(vmin0, ra, rb,
-    parent1, parent12, l2_ranks, prefix)`` staged — EXACTLY ONE of
-    ``parent1``/``parent12`` is non-None. ``parent12``/``l2_ranks`` are
-    ``None`` when the consuming path won't run the L2 head (degenerate
-    split, below filter scale, speculative regime — those want
-    ``parent1``); otherwise ``parent1`` is ``None`` (the L2 head never
-    reads it on device, so staging it would waste an n-sized transfer).
+    parent1, parent12, l2_ranks, prefix)`` staged.
+
+    Which partitions are staged follows the consuming path:
+    * degenerate split / below filter scale: ``parent1`` only (the staged
+      fallback runs the device head);
+    * speculative regime (``n_pad < _CENSUS_MIN_SPACE``): BOTH —
+      ``parent12`` is computed for the speculative program's mult-2
+      prefix (the returned ``prefix``), ``parent1`` backs the
+      misprediction fallback and any chunked (``on_chunk``) form, which
+      use :func:`_prefix_plan`'s prefix and must not see this
+      ``parent12``;
+    * chunked filtered regime: ``parent12`` only (for
+      :func:`_prefix_plan`'s prefix; the L2 head never reads ``parent1``
+      on device, so staging it would waste an n-sized transfer).
     The extra host pass (first-cross-rank over the prefix) hides under the
     edge-sized transfers like the rest of prep."""
     cached = graph.__dict__.get("_rank_device_cache_filtered")
@@ -1288,30 +1296,43 @@ def prepare_rank_arrays_filtered(graph: Graph):
     n_pad = _bucket_size(graph.num_nodes)
     m_pad = _bucket_size(graph.num_edges)
     prefix, _force_chunked = _prefix_plan(n_pad, m_pad)
-    if (
-        2 * prefix > m_pad
-        or not use_filtered_path("dense", m_pad)
-        or n_pad < _CENSUS_MIN_SPACE
-    ):
-        # The consuming path won't run _filtered_head_l2 (degenerate
-        # split, below filter scale, or the small-dense speculative
-        # regime): don't pay the host pass and the extra transfers.
+    if 2 * prefix > m_pad or not use_filtered_path("dense", m_pad):
+        # The consuming path won't run any L2 head (degenerate split or
+        # below filter scale): don't pay the host pass/extra transfers.
         full = prepare_rank_arrays_full(graph)
         return full[:4] + (None, None, prefix)
+    if n_pad < _CENSUS_MIN_SPACE:
+        # Small-dense speculative regime: the single-dispatch program uses
+        # the mult-2 prefix (its measured configuration), so the host L2
+        # is computed for THAT prefix; parent1 stays staged for the
+        # misprediction fallback (which runs the device head).
+        prefix_spec = _prefix_size(n_pad, m_pad, 2)
+        if 2 * prefix_spec > m_pad:
+            full = prepare_rank_arrays_full(graph)
+            return full[:4] + (None, None, prefix)
+        return _stage_filtered(graph, prefix_spec, include_parent1=True)
+    return _stage_filtered(graph, prefix, include_parent1=False)
+
+
+def _stage_filtered(graph: Graph, prefix: int, *, include_parent1: bool):
+    """Shared staging tail of :func:`prepare_rank_arrays_filtered`: host
+    level-2 over ``prefix`` ranks (pad slots in ``[m, prefix)`` are
+    self-edges with ``ra == rb == 0`` — no cross ranks, so scanning past
+    ``m`` is safe) and the device puts. ``include_parent1`` stages the
+    fallback partition too (the speculative regime needs it; the chunked
+    regime's L2 head never reads it on device, so staging it there would
+    waste an n-sized transfer)."""
     n, m, n_pad, m_pad, ra, rb, vmin0, parent1, sa, sb = _prep_head(graph)
-    # Pad slots in [m, prefix) are self-edges (ra == rb == 0): no cross
-    # ranks, so scanning to `prefix` is safe even when prefix > m.
     parent12, l2r = host_level2(parent1, ra, rb, prefix)
     l2_staged = _pad_l2_ranks(l2r, m_pad)
     sv = jax.device_put(vmin0)
+    sp1 = jax.device_put(parent1) if include_parent1 else None
     sp12 = jax.device_put(parent12)
     sl = jax.device_put(l2_staged)
-    # parent1 is NOT staged on this path: the L2 head never reads it on
-    # device (host_level2 consumed the host copy); the degenerate branch
-    # above is the one that returns a staged parent1.
-    staged = (sv, sa, sb, None, sp12, sl, prefix)
-    for leaf in (sv, sa, sb, sp12, sl):
-        _ = np.asarray(leaf[:1])
+    staged = (sv, sa, sb, sp1, sp12, sl, prefix)
+    for leaf in staged[:6]:
+        if leaf is not None:
+            _ = np.asarray(leaf[:1])
     if m_pad <= _STAGE_CACHE_MAX_RANKS:
         graph.__dict__["_rank_device_cache_filtered"] = staged
     return staged
@@ -1320,6 +1341,7 @@ def prepare_rank_arrays_filtered(graph: Graph):
 def solve_rank_filtered(
     vmin0, ra, rb, *, chunk_levels: int = 3, prefix_mult: int | None = None,
     on_chunk=None, parent1=None, parent12=None, l2_ranks=None,
+    l2_prefix: int | None = None,
 ) -> Tuple[jax.Array, jax.Array, int]:
     """Filter-Kruskal solve: prefix Borůvka, one-pass suffix filter, survivor
     finish. Same contract and bit-identical results as
@@ -1337,8 +1359,11 @@ def solve_rank_filtered(
 
     ``parent12``/``l2_ranks`` (from :func:`prepare_rank_arrays_filtered`)
     carry the host-precomputed PREFIX level 2: the head becomes one prefix
-    relabel plus mark scatters (r5; only valid with ``prefix_mult=None``
-    — the host pass was computed for :func:`_prefix_plan`'s prefix).
+    relabel plus mark scatters (r5). ``l2_prefix`` is the prefix the host
+    pass was computed for — REQUIRED with ``parent12`` and verified
+    against this call's own prefix, because a mismatched partition would
+    silently drop the L2 marks past the smaller prefix (merged but
+    unmarked edges -> a wrong forest with no error).
     """
     n_pad = vmin0.shape[0]
     m_pad = ra.shape[0]
@@ -1349,12 +1374,16 @@ def solve_rank_filtered(
         # regime — see _prefix_plan/_prefix_size for the full rationale.
         prefix, force_chunked = _prefix_plan(n_pad, m_pad)
     else:
-        if parent12 is not None:
-            raise ValueError(
-                "parent12/l2_ranks were computed for _prefix_plan's prefix; "
-                "pass prefix_mult=None with them"
-            )
         prefix = _prefix_size(n_pad, m_pad, prefix_mult)
+    if parent12 is not None and l2_prefix != prefix:
+        raise ValueError(
+            f"parent12/l2_ranks were computed for prefix {l2_prefix} but "
+            f"this call runs prefix {prefix}. In the speculative regime "
+            f"prep computes them for the mult-2 prefix, which only the "
+            f"speculative program may consume — route through "
+            f"solve_rank_auto/make_production_solver, or drop parent12 "
+            f"and pass parent1."
+        )
     if 2 * prefix > m_pad:
         # Not enough suffix to pay for the split — plain staged solve.
         return solve_rank_staged(
@@ -1447,6 +1476,41 @@ def _filtered_speculative_program(
     fragment, mst, fa, fb, stats0 = _filtered_head(
         vmin0, ra, rb, parent1, prefix=prefix
     )
+    return _speculative_tail(
+        fragment, mst, fa, fb, stats0, ra, rb,
+        prefix=prefix, prefix_out=prefix_out, out_size=out_size,
+        max_levels=max_levels,
+    )
+
+
+@functools.partial(
+    jax.jit, static_argnames=("prefix", "prefix_out", "out_size", "max_levels")
+)
+def _filtered_speculative_program_l2(
+    vmin0, ra, rb, parent12, l2_ranks, *, prefix: int, prefix_out: int,
+    out_size: int, max_levels: int
+):
+    """:func:`_filtered_speculative_program` with the prefix level 2
+    host-precomputed (``host_level2`` over THIS program's mult-2 prefix):
+    the in-dispatch head becomes one prefix relabel + mark scatters
+    (measured 0.214 -> 0.097 s at RMAT-20 width). Same contract."""
+    fragment, mst, fa, fb, stats0 = _filtered_head_l2(
+        vmin0, ra, rb, parent12, l2_ranks, prefix=prefix
+    )
+    return _speculative_tail(
+        fragment, mst, fa, fb, stats0, ra, rb,
+        prefix=prefix, prefix_out=prefix_out, out_size=out_size,
+        max_levels=max_levels,
+    )
+
+
+def _speculative_tail(
+    fragment, mst, fa, fb, stats0, ra, rb, *, prefix: int, prefix_out: int,
+    out_size: int, max_levels: int
+):
+    """The shared post-head body of the speculative programs (compact
+    prefix survivors -> levels -> suffix filter -> compact -> levels ->
+    combined stats)."""
     prefix_count = stats0[1]
     rank_p = jnp.arange(prefix, dtype=jnp.int32)
     cfa_p, cfb_p, crank_p, _ = _compact_slots(fa, fb, rank_p, prefix_out)
@@ -1477,27 +1541,47 @@ def solve_rank_filtered_speculative(
     prefix_out: int | None = None,
     out_size: int | None = None,
     parent1=None,
+    parent12=None,
+    l2_ranks=None,
+    l2_prefix: int | None = None,
 ) -> Tuple[jax.Array, jax.Array, int] | None:
     """Single-round-trip filtered solve; ``None`` on misprediction (caller
     falls back to :func:`solve_rank_filtered`). Default speculative widths:
     ``prefix/8`` for prefix survivors (measured 5.3% alive after the head)
-    and ``m/128`` for filter survivors (measured 0.21% of the suffix)."""
+    and ``m/128`` for filter survivors (measured 0.21% of the suffix).
+    ``parent12``/``l2_ranks`` carry the host prefix-L2; ``l2_prefix`` (the
+    prefix it was computed for) is REQUIRED with them and verified against
+    this program's own prefix — a mismatch would silently drop L2 marks
+    past the smaller prefix."""
     n_pad = vmin0.shape[0]
     m_pad = ra.shape[0]
     prefix = _prefix_size(n_pad, m_pad, prefix_mult)
     if 2 * prefix > m_pad:
         return None
+    if parent12 is not None and l2_prefix != prefix:
+        raise ValueError(
+            f"parent12/l2_ranks were computed for prefix {l2_prefix} but "
+            f"the speculative program runs prefix {prefix} "
+            f"(prefix_mult={prefix_mult}); pass the matching l2_prefix"
+        )
     if prefix_out is None:
         prefix_out = max(_bucket_size(prefix // 8), _COMPACT_MIN_SLOTS)
     if out_size is None:
         out_size = max(_bucket_size(m_pad // 128), _COMPACT_MIN_SLOTS)
     max_levels = _max_levels(n_pad)
-    parent1 = _ensure_parent1(vmin0, ra, rb, parent1)
-    fragment, mst, stats = _filtered_speculative_program(
-        vmin0, ra, rb, parent1,
-        prefix=prefix, prefix_out=prefix_out, out_size=out_size,
-        max_levels=max_levels,
-    )
+    if parent12 is not None:
+        fragment, mst, stats = _filtered_speculative_program_l2(
+            vmin0, ra, rb, parent12, l2_ranks,
+            prefix=prefix, prefix_out=prefix_out, out_size=out_size,
+            max_levels=max_levels,
+        )
+    else:
+        parent1 = _ensure_parent1(vmin0, ra, rb, parent1)
+        fragment, mst, stats = _filtered_speculative_program(
+            vmin0, ra, rb, parent1,
+            prefix=prefix, prefix_out=prefix_out, out_size=out_size,
+            max_levels=max_levels,
+        )
     lv, prefix_count, prefix_alive, filter_count, survivor_alive = (
         int(x) for x in jax.device_get(stats)
     )
@@ -1526,14 +1610,16 @@ def use_filtered_path(family: str, num_ranks: int) -> bool:
 
 def solve_rank_auto(
     vmin0, ra, rb, *, family: str = "dense", parent1=None, parent12=None,
-    l2_ranks=None,
+    l2_ranks=None, l2_prefix=None,
 ):
     """Dispatch policy shared by ``solve_graph_rank`` and ``bench.py`` —
     see :func:`_pick_family` for the per-family rationale. Chunk length 2
     beats 3 on many-level graphs (measured 12.1 s vs 13.2 s on a 4096^2
-    grid; 1 loses to dispatch overhead at 14.1 s). ``parent12``/``l2_ranks``
-    (from :func:`prepare_rank_arrays_filtered`) route the filtered path
-    through the host-precomputed prefix level 2."""
+    grid; 1 loses to dispatch overhead at 14.1 s).
+    ``parent12``/``l2_ranks``/``l2_prefix`` (from
+    :func:`prepare_rank_arrays_filtered`) route the filtered path through
+    the host-precomputed prefix level 2; the consumers verify
+    ``l2_prefix`` against their own prefix."""
     n_pad = vmin0.shape[0]
     if use_filtered_path(family, ra.shape[0]):
         if n_pad >= _CENSUS_MIN_SPACE and parent12 is not None:
@@ -1541,19 +1627,23 @@ def solve_rank_auto(
             # device-level-1 fallback for an unused array.
             return solve_rank_filtered(
                 vmin0, ra, rb, parent1=parent1, parent12=parent12,
-                l2_ranks=l2_ranks,
+                l2_ranks=l2_ranks, l2_prefix=l2_prefix,
             )
-        parent1 = _ensure_parent1(vmin0, ra, rb, parent1)
         if n_pad < _CENSUS_MIN_SPACE:
             # Small-dense: one dispatch with compacted inner loops beats the
             # staged sequence (RMAT-20: 1.31 s vs 1.41 s staged, same
-            # session). Falls back to the exact staged path on any width
-            # misprediction.
+            # session). parent12 here is computed for the SPECULATIVE
+            # (mult-2) prefix and is only valid inside that program (its
+            # l2_prefix check enforces it); the misprediction fallback
+            # below runs the device head off parent1 (ensured lazily —
+            # the accepted L2 speculation never reads it).
             result = solve_rank_filtered_speculative(
-                vmin0, ra, rb, parent1=parent1
+                vmin0, ra, rb, parent1=parent1, parent12=parent12,
+                l2_ranks=l2_ranks, l2_prefix=l2_prefix,
             )
             if result is not None:
                 return result
+        parent1 = _ensure_parent1(vmin0, ra, rb, parent1)
         return solve_rank_filtered(vmin0, ra, rb, parent1=parent1)
     parent1 = _ensure_parent1(vmin0, ra, rb, parent1)
     if family == "dense" and n_pad < _CENSUS_MIN_SPACE:
@@ -1643,19 +1733,31 @@ def make_production_solver(graph: Graph):
                 vmin0, ra, rb, parent12, l2_ranks, on_chunk=on_chunk
             )
     elif use_filtered_path(family, _bucket_size(graph.num_edges)):
-        vmin0, ra, rb, parent1, parent12, l2_ranks, _prefix = (
+        vmin0, ra, rb, parent1, parent12, l2_ranks, l2_prefix = (
             prepare_rank_arrays_filtered(graph)
         )
+        # The chunked filtered form (used whenever on_chunk is requested)
+        # runs _prefix_plan's prefix; hand it the host L2 only when prep
+        # computed it for exactly that prefix (in the speculative regime
+        # it was computed for the mult-2 prefix instead — the prefix
+        # comparison, not a re-derived regime predicate, decides).
+        plan_prefix, _ = _prefix_plan(
+            _bucket_size(graph.num_nodes), _bucket_size(graph.num_edges)
+        )
+        chunk_p12 = parent12 if l2_prefix == plan_prefix else None
+        chunk_l2 = l2_ranks if l2_prefix == plan_prefix else None
 
         def solve(on_chunk=None):
             if on_chunk is None:
                 return solve_rank_auto(
                     vmin0, ra, rb, family=family, parent1=parent1,
                     parent12=parent12, l2_ranks=l2_ranks,
+                    l2_prefix=l2_prefix,
                 )
             return solve_rank_filtered(
                 vmin0, ra, rb, on_chunk=on_chunk, parent1=parent1,
-                parent12=parent12, l2_ranks=l2_ranks,
+                parent12=chunk_p12, l2_ranks=chunk_l2,
+                l2_prefix=l2_prefix if chunk_p12 is not None else None,
             )
     else:
         vmin0, ra, rb, parent1 = prepare_rank_arrays_full(graph)

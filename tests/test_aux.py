@@ -717,8 +717,68 @@ def test_filtered_head_l2_parity():
         mst_ref, _, _ = rs.solve_rank_filtered(vmin0, ra, rb, parent1=parent1)
         mst_l2, frag_l2, _ = rs.solve_rank_filtered(
             vmin0, ra, rb, parent1=parent1, parent12=parent12,
-            l2_ranks=l2_ranks,
+            l2_ranks=l2_ranks, l2_prefix=prefix,
         )
         assert np.array_equal(np.asarray(mst_ref), np.asarray(mst_l2))
         mst_st, _, _ = rs.solve_rank_staged(vmin0, ra, rb, parent1=parent1)
         assert np.array_equal(np.asarray(mst_st), np.asarray(mst_l2))
+
+
+def test_speculative_l2_parity(monkeypatch):
+    """The speculative program with the host mult-2-prefix L2 must accept
+    and match the device-head speculative and the staged reference. The
+    filter-scale floor is pinned down so the speculative regime engages at
+    test width."""
+    from distributed_ghs_implementation_tpu.graphs.generators import rmat_graph
+    from distributed_ghs_implementation_tpu.models import rank_solver as rs
+
+    monkeypatch.setattr(rs, "_FILTER_MIN_RANKS", 1024)
+    for seed in (3, 9):
+        g = rmat_graph(10, 16, seed=seed)
+        vmin0, ra, rb, parent1, parent12, l2_ranks, prefix = (
+            rs.prepare_rank_arrays_filtered(g)
+        )
+        assert parent12 is not None and parent1 is not None
+        assert prefix == rs._prefix_size(vmin0.shape[0], ra.shape[0], 2)
+        r_l2 = rs.solve_rank_filtered_speculative(
+            vmin0, ra, rb, parent1=parent1, parent12=parent12,
+            l2_ranks=l2_ranks, l2_prefix=prefix,
+        )
+        # A mismatched l2_prefix must fail loudly, never silently drop marks.
+        with pytest.raises(ValueError, match="computed for prefix"):
+            rs.solve_rank_filtered_speculative(
+                vmin0, ra, rb, parent1=parent1, parent12=parent12,
+                l2_ranks=l2_ranks, l2_prefix=prefix // 2,
+            )
+        r_dev = rs.solve_rank_filtered_speculative(
+            vmin0, ra, rb, parent1=parent1
+        )
+        mst_st, _, _ = rs.solve_rank_staged(vmin0, ra, rb, parent1=parent1)
+        # Pin acceptance so the parity checks can never go silently vacuous
+        # under a future width retune.
+        assert r_l2 is not None and r_dev is not None
+        assert np.array_equal(np.asarray(r_l2[0]), np.asarray(r_dev[0]))
+        assert np.array_equal(np.asarray(r_l2[0]), np.asarray(mst_st))
+
+
+def test_production_solver_chunked_spec_regime(monkeypatch):
+    """make_production_solver's chunked (on_chunk) form in the speculative
+    regime must NOT consume the mult-2-prefix parent12 (the prefix
+    comparison quarantines it) and must still land on the staged MST —
+    pinning the receipt's 'quarantine is test-pinned' claim."""
+    from distributed_ghs_implementation_tpu.graphs.generators import rmat_graph
+    from distributed_ghs_implementation_tpu.models import rank_solver as rs
+
+    monkeypatch.setattr(rs, "_FILTER_MIN_RANKS", 1024)
+    g = rmat_graph(10, 16, seed=3)
+    vmin0, ra, rb, parent1 = rs.prepare_rank_arrays_full(g)
+    mst_ref, _, _ = rs.solve_rank_staged(vmin0, ra, rb, parent1=parent1)
+    calls = []
+
+    def hook(level, fragment, mst, count):
+        calls.append(level)
+
+    solve = rs.make_production_solver(g)
+    mst, frag, _ = solve(on_chunk=hook)
+    assert calls, "chunked form fired no on_chunk"
+    assert np.array_equal(np.asarray(mst), np.asarray(mst_ref))
