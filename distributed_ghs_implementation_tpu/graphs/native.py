@@ -117,6 +117,11 @@ def get_lib() -> Optional[ctypes.CDLL]:
             ctypes.c_int64, ctypes.c_int64, _I64, _I64, _I64, _I32, _I32,
         ]
         lib.rank_endpoints_i32.restype = None
+        lib.rank_endpoints_i32_planes.argtypes = [
+            ctypes.c_int64, ctypes.c_int64, _I64, _I64, _I64, _I32, _I32,
+            ctypes.POINTER(ctypes.c_uint8),
+        ]
+        lib.rank_endpoints_i32_planes.restype = None
         lib.rank_order_counting.argtypes = [
             ctypes.c_int64, _I64, ctypes.c_int64, ctypes.c_int64, _I64,
         ]
@@ -361,6 +366,34 @@ def rank_endpoints_i32_native(
         ra.ctypes.data_as(_i32p), rb.ctypes.data_as(_i32p),
     )
     return ra, rb
+
+
+def rank_endpoints_i32_planes_native(
+    order: np.ndarray, u: np.ndarray, v: np.ndarray, size_pad: int
+) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """:func:`rank_endpoints_i32_native` fused with the 24-bit planar wire
+    packing: returns ``(ra, rb, planes)`` where ``planes`` is the
+    six-byte-plane uint8 buffer the packed transfer ships (see
+    ``models.rank_solver._stage_pair_packed24``). One pass instead of
+    gather-then-repack — this sits on prep's pre-transfer critical path.
+    Caller guarantees endpoint ids < 2^24."""
+    lib = get_lib()
+    if lib is None:
+        raise RuntimeError("native library unavailable")
+    m = order.shape[0]
+    order = np.ascontiguousarray(order, dtype=np.int64)
+    u = np.ascontiguousarray(u, dtype=np.int64)
+    v = np.ascontiguousarray(v, dtype=np.int64)
+    _i32p = ctypes.POINTER(ctypes.c_int32)
+    ra = np.empty(size_pad, dtype=np.int32)
+    rb = np.empty(size_pad, dtype=np.int32)
+    planes = np.empty(6 * size_pad, dtype=np.uint8)
+    lib.rank_endpoints_i32_planes(
+        m, size_pad, _ptr(order), _ptr(u), _ptr(v),
+        ra.ctypes.data_as(_i32p), rb.ctypes.data_as(_i32p),
+        planes.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+    )
+    return ra, rb, planes
 
 
 def first_rank_native(num_nodes: int, ra: np.ndarray, rb: np.ndarray) -> np.ndarray:

@@ -566,18 +566,42 @@ def _prep_head(graph: Graph):
     n_pad = _bucket_size(n)
     m_pad = _bucket_size(m)
     check_rank_envelope(n_pad, m_pad)
-    ra, rb = graph.rank_endpoints(pad_to=m_pad)
     pair = graph.__dict__.get("_rank_endpoint_stage")
+    ra = rb = None
+    if pair is None and n <= (1 << 24) and m:
+        # Endpoint ids fit 24 bits: ship 3 bytes/elem and decode on device
+        # — 25% less wire time on the two arrays that dominate prep. The
+        # fused native pass emits the int32 endpoints (for the host
+        # levels) AND the byte-plane wire buffer in one sweep, skipping a
+        # full re-read/re-write of both arrays on the pre-transfer
+        # critical path.
+        planes = None
+        try:
+            from distributed_ghs_implementation_tpu.graphs import native
+
+            if native.native_available():
+                ra, rb, planes = native.rank_endpoints_i32_planes_native(
+                    graph._rank_order, graph.u, graph.v, m_pad
+                )
+        except Exception:  # noqa: BLE001 — any native issue -> fallback
+            ra = rb = planes = None
+        if planes is not None:
+            # Outside the try: a JAX/device failure here should surface
+            # from THIS path (and the valid ra/rb are kept either way),
+            # not be masked by a doomed equally-sized retry below.
+            pair = _decode_planes24(jax.device_put(planes))
+    if ra is None:
+        ra, rb = graph.rank_endpoints(pad_to=m_pad)
     if pair is None:
         if n <= (1 << 24):
-            # Endpoint ids fit 24 bits: ship 3 bytes/elem and decode on
-            # device (one fused dispatch) — 25% less wire time on the two
-            # arrays that dominate prep.
             pair = _stage_pair_packed24(ra, rb)
         else:
             pair = (jax.device_put(ra), jax.device_put(rb))
-        if m_pad <= _STAGE_CACHE_MAX_RANKS:
-            graph.__dict__["_rank_endpoint_stage"] = pair
+    if (
+        "_rank_endpoint_stage" not in graph.__dict__
+        and m_pad <= _STAGE_CACHE_MAX_RANKS
+    ):
+        graph.__dict__["_rank_endpoint_stage"] = pair
     sa, sb = pair
     # --- everything below here overlaps the ra/rb transfers ---
     vmin0 = np.full(n_pad, np.iinfo(np.int32).max, dtype=np.int32)

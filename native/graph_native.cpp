@@ -268,6 +268,39 @@ void rank_endpoints_i32(int64_t m, int64_t size_pad, const int64_t* order,
   }
 }
 
+// rank_endpoints_i32 fused with the 24-bit planar wire packing: one pass
+// emits the int32 endpoint arrays (the host levels consume them) AND the
+// six little-endian byte-planes of the packed transfer buffer
+// (planes[k*size_pad + r] = byte k of ra[r] for k<3, of rb[r] for k>=3) —
+// replacing a separate strided re-read/re-write of both arrays on prep's
+// pre-transfer critical path. Caller guarantees endpoint ids < 2^24.
+void rank_endpoints_i32_planes(int64_t m, int64_t size_pad,
+                               const int64_t* order, const int64_t* u,
+                               const int64_t* v, int32_t* ra, int32_t* rb,
+                               uint8_t* planes) {
+#pragma omp parallel for schedule(static)
+  for (int64_t r = 0; r < m; ++r) {
+    const int64_t e = order[r];
+    const uint32_t a = (uint32_t)u[e];
+    const uint32_t b = (uint32_t)v[e];
+    ra[r] = (int32_t)a;
+    rb[r] = (int32_t)b;
+    planes[r] = (uint8_t)(a & 0xff);
+    planes[size_pad + r] = (uint8_t)((a >> 8) & 0xff);
+    planes[2 * size_pad + r] = (uint8_t)((a >> 16) & 0xff);
+    planes[3 * size_pad + r] = (uint8_t)(b & 0xff);
+    planes[4 * size_pad + r] = (uint8_t)((b >> 8) & 0xff);
+    planes[5 * size_pad + r] = (uint8_t)((b >> 16) & 0xff);
+  }
+  if (size_pad > m) {
+    const size_t pad = (size_t)(size_pad - m);
+    std::memset(ra + m, 0, pad * sizeof(int32_t));
+    std::memset(rb + m, 0, pad * sizeof(int32_t));
+    for (int k = 0; k < 6; ++k)
+      std::memset(planes + (size_t)k * size_pad + m, 0, pad);
+  }
+}
+
 // Kruskal MSF over edges in ascending (weight, edge id) order — the oracle
 // fast path: the rank order already exists natively, so one union-find pass
 // verifies a solve at C speed (SciPy's csgraph oracle costs ~890 s at

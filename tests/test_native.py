@@ -202,3 +202,31 @@ def test_host_backend_byte_identical(seed):
         assert np.array_equal(rh.edge_ids, rd.edge_ids)
         assert rh.num_components == rd.num_components
         assert rh.total_weight == rd.total_weight
+
+
+def test_fused_endpoint_planes_parity():
+    """The fused endpoints+planes pass must emit the identical int32 arrays
+    and the identical wire buffer as the two-step gather-then-pack form."""
+    import numpy as np
+
+    from distributed_ghs_implementation_tpu.graphs import native
+    from distributed_ghs_implementation_tpu.graphs.generators import rmat_graph
+    from distributed_ghs_implementation_tpu.models.rank_solver import (
+        _bucket_size,
+    )
+
+    if not native.native_available():
+        pytest.skip("native library unavailable")
+    g = rmat_graph(11, 8, seed=7)
+    m_pad = _bucket_size(g.num_edges)
+    ra_ref, rb_ref = g.rank_endpoints(pad_to=m_pad)
+    ra, rb, planes = native.rank_endpoints_i32_planes_native(
+        g._rank_order, g.u, g.v, m_pad
+    )
+    assert np.array_equal(ra, ra_ref) and np.array_equal(rb, rb_ref)
+    ref_planes = np.empty(6 * m_pad, dtype=np.uint8)
+    for i, (arr, base) in enumerate(((ra_ref, 0), (rb_ref, 3 * m_pad))):
+        b_ = arr.view(np.uint8)
+        for k in range(3):
+            ref_planes[base + k * m_pad:base + (k + 1) * m_pad] = b_[k::4]
+    assert np.array_equal(planes, ref_planes)
