@@ -21,7 +21,7 @@ from __future__ import annotations
 
 import dataclasses
 import time
-from typing import Iterable, List, Optional, Sequence, Tuple
+from typing import Iterable, List, Optional, Tuple
 
 import numpy as np
 
@@ -42,6 +42,9 @@ class MSTResult:
     wall_time_s: float
     backend: str
     num_components: int
+    # Populated by supervised solves only: the structured attempt/fallback
+    # record (``utils.resilience.IncidentLog``).
+    incidents: Optional[object] = None
 
     @property
     def edges(self) -> List[Tuple[int, int]]:
@@ -113,11 +116,36 @@ def _solve(graph: Graph, backend: str) -> Tuple[np.ndarray, np.ndarray, int]:
 
 
 def minimum_spanning_forest(
-    graph: Graph, *, backend: str = "device"
+    graph: Graph,
+    *,
+    backend: str = "device",
+    supervised: bool = False,
+    supervisor=None,
 ) -> MSTResult:
-    """Compute the minimum spanning forest (tree per component) of ``graph``."""
+    """Compute the minimum spanning forest (tree per component) of ``graph``.
+
+    ``supervised=True`` runs the solve under the self-healing supervisor
+    (``utils.resilience``): watchdog deadline, bounded retry with backoff on
+    transient device errors, and the ``sharded -> device -> stepped -> host``
+    degradation ladder, starting at ``backend`` (backends outside the ladder,
+    e.g. ``"protocol"``, enter at ``"device"``). The result's ``backend``
+    then reads ``"supervised/<rung-that-succeeded>"`` and ``incidents``
+    carries the structured attempt log. Pass a preconfigured
+    ``utils.resilience.Supervisor`` as ``supervisor`` to control the policy
+    (passing one implies ``supervised=True``).
+    """
     t0 = time.perf_counter()
-    edge_ids, fragment, levels = _solve(graph, backend)
+    incidents = None
+    supervised = supervised or supervisor is not None
+    if supervised:
+        from distributed_ghs_implementation_tpu.utils.resilience import Supervisor
+
+        sup = supervisor or Supervisor()
+        edge_ids, fragment, levels, incidents = sup.solve(graph, entry=backend)
+        backend_label = f"supervised/{incidents.final_rung or backend}"
+    else:
+        edge_ids, fragment, levels = _solve(graph, backend)
+        backend_label = backend
     wall = time.perf_counter() - t0
     num_components = int(np.unique(fragment).size) if graph.num_nodes else 0
     return MSTResult(
@@ -125,8 +153,9 @@ def minimum_spanning_forest(
         edge_ids=edge_ids,
         num_levels=levels,
         wall_time_s=wall,
-        backend=backend,
+        backend=backend_label,
         num_components=num_components,
+        incidents=incidents,
     )
 
 

@@ -88,6 +88,12 @@ def _cmd_run(args) -> int:
     if args.checkpoint:
         if args.backend not in ("device", "sharded"):
             raise SystemExit("--checkpoint requires --backend device or sharded")
+        if args.supervised:
+            raise SystemExit(
+                "--supervised and --checkpoint are separate recovery paths; "
+                "pick one (checkpointed solves already self-heal via the "
+                ".bak generation fallback)"
+            )
         import numpy as np
 
         from distributed_ghs_implementation_tpu.api import MSTResult
@@ -114,9 +120,22 @@ def _cmd_run(args) -> int:
             num_components=int(np.unique(fragment).size),
         )
     else:
-        result = minimum_spanning_forest(g, backend=args.backend)
+        supervisor = None
+        if args.supervised and args.deadline_s is not None:
+            from distributed_ghs_implementation_tpu.utils.resilience import (
+                Supervisor,
+                SupervisorConfig,
+            )
+
+            supervisor = Supervisor(SupervisorConfig(deadline_s=args.deadline_s))
+        result = minimum_spanning_forest(
+            g, backend=args.backend, supervised=args.supervised,
+            supervisor=supervisor,
+        )
     if not primary:
         return 0  # artifacts are written by process 0 only
+    if result.incidents is not None and len(result.incidents):
+        print(f"supervisor: {result.incidents.summary()}", file=sys.stderr)
     print(json.dumps(result_to_dict(result), indent=2))
     if args.output:
         write_result_json(result, args.output)
@@ -191,6 +210,15 @@ def _cmd_experiments(args) -> int:
     return 0 if all(r["is_correct"] for r in records) else 1
 
 
+def _cmd_chaos(args) -> int:
+    from distributed_ghs_implementation_tpu.utils import chaos
+
+    report = chaos.run_chaos_drill(
+        fast=not args.full, include_solver=not args.no_solver
+    )
+    return chaos.emit_report(report, args.output)
+
+
 def _cmd_bench(args) -> int:
     import bench as bench_mod  # repo-root bench.py
 
@@ -245,6 +273,19 @@ def build_parser() -> argparse.ArgumentParser:
     r.add_argument(
         "--checkpoint-every", type=int, default=1, help="levels between checkpoints"
     )
+    r.add_argument(
+        "--supervised",
+        action="store_true",
+        help="self-healing solve: watchdog + retry/backoff + the "
+        "sharded->device->stepped->host degradation ladder "
+        "(utils/resilience.py)",
+    )
+    r.add_argument(
+        "--deadline-s",
+        type=float,
+        help="with --supervised: watchdog deadline per attempt, checked at "
+        "chunk/level boundaries",
+    )
     r.set_defaults(fn=_cmd_run)
 
     v = sub.add_parser("verify", help="print the oracle MST for a graph dir")
@@ -260,6 +301,16 @@ def build_parser() -> argparse.ArgumentParser:
     e.add_argument("--output", default="ghs_experiments.json")
     e.add_argument("--visualize-dir")
     e.set_defaults(fn=_cmd_experiments)
+
+    c = sub.add_parser(
+        "chaos",
+        help="fault-injection drill: lossy transport + induced solver faults "
+        "+ torn checkpoint writes, all checked against the MST oracle",
+    )
+    c.add_argument("--full", action="store_true", help="full fault matrix")
+    c.add_argument("--no-solver", action="store_true")
+    c.add_argument("--output", help="write the JSON report here")
+    c.set_defaults(fn=_cmd_chaos)
 
     b = sub.add_parser("bench", help="run the benchmark (see bench.py)")
     b.add_argument("--scale", type=int, default=22)

@@ -48,7 +48,6 @@ from distributed_ghs_implementation_tpu.models.boruvka import (
     _COMPACT_MIN_SLOTS,
     _bucket_size,
     _max_levels,
-    _next_pow2,
 )
 from distributed_ghs_implementation_tpu.ops.segment_ops import INT32_MAX
 from distributed_ghs_implementation_tpu.ops.union_find import hook_and_compress

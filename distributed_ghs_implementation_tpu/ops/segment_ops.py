@@ -14,7 +14,6 @@ identification into a single int32 reduction.
 
 from __future__ import annotations
 
-import functools
 from typing import Tuple
 
 import jax

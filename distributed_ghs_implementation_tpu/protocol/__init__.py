@@ -17,6 +17,11 @@ The batched Borůvka kernel (``models/boruvka.py``) is the production path;
 this backend exists for protocol parity, testing, and teaching.
 """
 
+from distributed_ghs_implementation_tpu.protocol.faults import (
+    FaultSpec,
+    FaultyTransport,
+    ReliableTransport,
+)
 from distributed_ghs_implementation_tpu.protocol.messages import (
     EdgeState,
     Message,
@@ -32,10 +37,13 @@ from distributed_ghs_implementation_tpu.protocol.transport import SimTransport
 
 __all__ = [
     "EdgeState",
+    "FaultSpec",
+    "FaultyTransport",
     "GHSNode",
     "Message",
     "MessageType",
     "NodeState",
+    "ReliableTransport",
     "SimTransport",
     "run_protocol",
     "solve_graph_protocol",

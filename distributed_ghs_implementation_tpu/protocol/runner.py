@@ -47,15 +47,21 @@ def run_protocol(
     return nodes, transport
 
 
-def solve_graph_protocol(graph: Graph) -> Tuple[np.ndarray, np.ndarray, int]:
-    """Backend entry matching ``models.boruvka.solve_graph``'s contract."""
+def solve_graph_protocol(
+    graph: Graph, *, transport: Optional[SimTransport] = None
+) -> Tuple[np.ndarray, np.ndarray, int]:
+    """Backend entry matching ``models.boruvka.solve_graph``'s contract.
+
+    ``transport`` lets callers run the protocol over a misbehaving channel
+    (``protocol.faults``) — the chaos drill's entry point.
+    """
     if graph.num_nodes == 0 or graph.num_edges == 0:
         return (
             np.zeros(0, dtype=np.int64),
             np.arange(graph.num_nodes, dtype=np.int32),
             0,
         )
-    nodes, _ = run_protocol(graph)
+    nodes, _ = run_protocol(graph, transport=transport)
 
     # Harvest BRANCH edges (each appears as BRANCH on both endpoints).
     branch_pairs = set()

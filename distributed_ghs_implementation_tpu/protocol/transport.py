@@ -15,7 +15,7 @@ from __future__ import annotations
 
 import heapq
 import itertools
-from typing import Callable, Dict, Optional
+from typing import Dict
 
 from distributed_ghs_implementation_tpu.protocol.messages import Message
 

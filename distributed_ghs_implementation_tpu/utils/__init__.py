@@ -1,9 +1,23 @@
-"""Utilities: verification oracles, visualization, reporting, metrics."""
+"""Utilities: verification oracles, resilience, visualization, reporting."""
 
+from distributed_ghs_implementation_tpu.utils.resilience import (
+    FAULTS,
+    Supervisor,
+    SupervisorConfig,
+    supervised_solve,
+)
 from distributed_ghs_implementation_tpu.utils.verify import (
     networkx_mst_weight,
     scipy_mst_weight,
     verify_result,
 )
 
-__all__ = ["networkx_mst_weight", "scipy_mst_weight", "verify_result"]
+__all__ = [
+    "FAULTS",
+    "Supervisor",
+    "SupervisorConfig",
+    "networkx_mst_weight",
+    "scipy_mst_weight",
+    "supervised_solve",
+    "verify_result",
+]

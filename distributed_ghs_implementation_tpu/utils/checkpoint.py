@@ -1,4 +1,4 @@
-"""Per-level checkpoint/resume for long solves.
+"""Per-level checkpoint/resume for long solves, with crash-consistent saves.
 
 The reference has no checkpointing (SURVEY.md §5 — durable state is input
 files and result JSONs only). Here the whole solver state is three arrays —
@@ -6,17 +6,31 @@ files and result JSONs only). Here the whole solver state is three arrays —
 resume is ``boruvka_solve`` from an arbitrary starting partition (explicitly
 supported; see its docstring). Worth having for the RMAT-24/USA-road configs
 where a preempted multi-minute run would otherwise restart from scratch.
+
+Durability discipline: every save is tmp-file + rename, and the previous
+checkpoint survives as ``<path>.bak`` (one retained generation). Resume goes
+through :func:`load_checkpoint_resilient` — primary, then ``.bak``, then a
+fresh solve — so a file torn by a crash mid-write (simulated via the
+``checkpoint.save`` fault site, ``utils.resilience.FAULTS``) costs at most
+one checkpoint interval, never the run. A checkpoint from a *different*
+graph still refuses loudly (:class:`CheckpointMismatch`): silently solving
+from a stranger's partition is the one failure recovery must not paper over.
 """
 
 from __future__ import annotations
 
 import os
 import tempfile
-from typing import Optional, Tuple
+from typing import List, Optional, Tuple
 
 import numpy as np
 
 from distributed_ghs_implementation_tpu.graphs.edgelist import Graph
+from distributed_ghs_implementation_tpu.utils.resilience import FAULTS, InjectedFault
+
+
+class CheckpointMismatch(ValueError):
+    """The checkpoint was written for a different graph (fingerprint guard)."""
 
 
 def graph_fingerprint(graph: Graph) -> np.ndarray:
@@ -40,8 +54,21 @@ def graph_fingerprint(graph: Graph) -> np.ndarray:
     )
 
 
-def save_checkpoint(path: str, fragment, mst_ranks, level: int, *, fingerprint=None) -> str:
-    """Atomic npz write of the solver state (tmp file + rename)."""
+def save_checkpoint(
+    path: str,
+    fragment,
+    mst_ranks,
+    level: int,
+    *,
+    fingerprint=None,
+    retain_previous: bool = True,
+) -> str:
+    """Atomic npz write of the solver state (tmp file + rename).
+
+    ``retain_previous`` rotates an existing ``path`` to ``path + ".bak"``
+    first, so the last known-good generation survives a write that a crash
+    (or the ``checkpoint.save`` fault site) leaves torn.
+    """
     d = os.path.dirname(os.path.abspath(path)) or "."
     os.makedirs(d, exist_ok=True)
     fd, tmp = tempfile.mkstemp(dir=d, suffix=".npz.tmp")
@@ -56,6 +83,26 @@ def save_checkpoint(path: str, fragment, mst_ranks, level: int, *, fingerprint=N
             if fingerprint is not None:
                 arrays["fingerprint"] = np.asarray(fingerprint)
             np.savez_compressed(f, **arrays)
+        if retain_previous and os.path.exists(path):
+            import zipfile
+
+            if zipfile.is_zipfile(path):
+                os.replace(path, path + ".bak")
+            else:
+                # The primary is torn (e.g. the save this one follows
+                # crashed mid-write): rotating it would clobber the last
+                # good generation. Drop it and keep the loadable .bak.
+                os.unlink(path)
+        armed = FAULTS.pop("checkpoint.save")
+        if armed is not None:
+            if armed.kind == "torn":
+                # Simulate a crash on a non-atomic filesystem: the
+                # destination ends up holding a truncated npz.
+                with open(tmp, "rb") as f:
+                    blob = f.read()
+                with open(path, "wb") as f:
+                    f.write(blob[: max(1, len(blob) // 2)])
+            raise InjectedFault(f"injected fault at checkpoint.save ({armed.kind})")
         os.replace(tmp, path)
     finally:
         if os.path.exists(tmp):
@@ -67,16 +114,62 @@ def load_checkpoint(
     path: str, *, expect_fingerprint=None
 ) -> Tuple[np.ndarray, np.ndarray, int]:
     """Load solver state; refuses a checkpoint whose fingerprint mismatches."""
-    data = np.load(path)
-    if expect_fingerprint is not None:
-        stored = data.get("fingerprint")
-        if stored is None or not np.array_equal(stored, expect_fingerprint):
-            raise ValueError(
-                f"checkpoint {path} was written for a different graph "
-                f"(fingerprint {None if stored is None else stored.tolist()} "
-                f"!= expected {np.asarray(expect_fingerprint).tolist()})"
-            )
-    return data["fragment"], data["mst_ranks"], int(data["level"])
+    with np.load(path) as data:
+        if expect_fingerprint is not None:
+            stored = data.get("fingerprint")
+            if stored is None or not np.array_equal(stored, expect_fingerprint):
+                raise CheckpointMismatch(
+                    f"checkpoint {path} was written for a different graph "
+                    f"(fingerprint {None if stored is None else stored.tolist()} "
+                    f"!= expected {np.asarray(expect_fingerprint).tolist()})"
+                )
+        # Materialize before the NpzFile closes (arrays decompress on access).
+        return (
+            np.asarray(data["fragment"]),
+            np.asarray(data["mst_ranks"]),
+            int(data["level"]),
+        )
+
+
+def load_checkpoint_resilient(
+    path: str, *, expect_fingerprint=None
+) -> Tuple[Optional[Tuple[np.ndarray, np.ndarray, int]], Optional[str], List[Tuple[str, str]]]:
+    """Load ``path``, falling back to ``path + ".bak"``, then to ``None``.
+
+    Returns ``(state_or_None, source_path_or_None, notes)`` where ``notes``
+    records why each skipped candidate was rejected — the incident trail for
+    logs and the chaos report. Corruption (truncated zip, missing keys, IO
+    errors) falls through; :class:`CheckpointMismatch` re-raises, because a
+    wrong-graph resume is a caller bug, not a recoverable fault.
+    """
+    notes: List[Tuple[str, str]] = []
+    for candidate in (path, path + ".bak"):
+        if not os.path.exists(candidate):
+            notes.append((candidate, "missing"))
+            continue
+        try:
+            state = load_checkpoint(candidate, expect_fingerprint=expect_fingerprint)
+        except CheckpointMismatch:
+            raise
+        except Exception as e:  # torn/corrupt/unreadable: try the next generation
+            notes.append((candidate, f"{type(e).__name__}: {e}"))
+            continue
+        return state, candidate, notes
+    return None, None, notes
+
+
+def _warn_skipped_generations(state, notes) -> None:
+    """Surface a degraded resume: corrupt generations must not be silent."""
+    skipped = [(p, why) for p, why in notes if why != "missing"]
+    if not skipped:
+        return
+    import warnings
+
+    trail = "; ".join(f"{p}: {why}" for p, why in skipped)
+    tail = "resuming from the previous generation" if state is not None else (
+        "no loadable generation — solving from scratch"
+    )
+    warnings.warn(f"checkpoint recovery: {trail} — {tail}", RuntimeWarning)
 
 
 def solve_graph_checkpointed(
@@ -103,8 +196,11 @@ def solve_graph_checkpointed(
 
     fp = graph_fingerprint(graph)
     initial_state = None
-    if resume and os.path.exists(checkpoint_path):
-        initial_state = load_checkpoint(checkpoint_path, expect_fingerprint=fp)
+    if resume:
+        initial_state, _source, notes = load_checkpoint_resilient(
+            checkpoint_path, expect_fingerprint=fp
+        )
+        _warn_skipped_generations(initial_state, notes)
 
     if strategy == "auto":
         from distributed_ghs_implementation_tpu.models.boruvka import (
@@ -215,11 +311,14 @@ def solve_graph_checkpointed_sharded(
     fp = graph_fingerprint(graph)
     primary = multihost.is_primary()
     initial_state = None
-    if resume and primary and os.path.exists(checkpoint_path):
+    if resume and primary:
         try:
-            initial_state = load_checkpoint(
+            # Corrupt/torn generations fall back (.bak, then fresh) on the
+            # primary alone; only a wrong-graph checkpoint still raises.
+            initial_state, _source, notes = load_checkpoint_resilient(
                 checkpoint_path, expect_fingerprint=fp
             )
+            _warn_skipped_generations(initial_state, notes)
         except Exception:
             # Tell every process to abort before re-raising: a primary-only
             # failure would leave the others blocked in the broadcast.

@@ -14,7 +14,7 @@ import contextlib
 import dataclasses
 import json
 import time
-from typing import List, Optional
+from typing import List
 
 import numpy as np
 

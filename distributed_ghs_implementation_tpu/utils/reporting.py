@@ -15,13 +15,13 @@ Schema parity with the reference's two artifacts:
 from __future__ import annotations
 
 import json
-from typing import List, Optional
+from typing import List
 
 from distributed_ghs_implementation_tpu.api import MSTResult
 
 
 def result_to_dict(result: MSTResult) -> dict:
-    return {
+    out = {
         "mst_edges": [[int(a), int(b)] for a, b in result.edges],
         "total_weight": result.total_weight,
         "num_nodes": result.graph.num_nodes,
@@ -32,6 +32,11 @@ def result_to_dict(result: MSTResult) -> dict:
         "backend": result.backend,
         "execution_time": result.wall_time_s,
     }
+    if result.incidents is not None:
+        # Persist the supervised attempt/fallback trail with the artifact —
+        # a degraded run must stay diagnosable after the process exits.
+        out["incidents"] = result.incidents.to_dicts()
+    return out
 
 
 def write_result_json(result: MSTResult, path: str) -> str:

@@ -1,0 +1,498 @@
+"""Self-healing solve supervision + the fault-injection registry.
+
+The solver stack is fast but brittle by construction: a transient
+``XlaRuntimeError`` (device OOM spike, preemption, interconnect hiccup)
+aborts a multi-minute RMAT-24 solve, and nothing above ``_solve`` knows how
+to try again. This module adds the production discipline the reference never
+had:
+
+* :class:`FaultRegistry` — named injection sites armed via the
+  ``GHS_FAULT_*`` environment or the :meth:`FaultRegistry.inject` context
+  manager, so tests (and operators doing game-days) can induce solver
+  exceptions, slow chunks, and torn checkpoint writes deterministically.
+* :class:`Supervisor` — wraps the solve in a watchdog deadline (checked
+  cooperatively at chunk/level boundaries — no thread can interrupt a
+  running XLA dispatch), bounded retry with capped exponential backoff on
+  *transient* errors, and a degradation ladder
+  ``sharded -> device -> stepped -> host`` that trades speed for simplicity
+  one rung at a time. Every attempt lands in a structured
+  :class:`IncidentLog` so a degraded run is diagnosable after the fact.
+
+Exposed as ``api.minimum_spanning_forest(..., supervised=True)`` and
+``run --supervised`` on the CLI. The chaos drill
+(``tools/chaos_drill.py``) exercises the whole matrix against the oracle.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import dataclasses
+import json
+import os
+import time
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from distributed_ghs_implementation_tpu.graphs.edgelist import Graph
+
+
+# ----------------------------------------------------------------------
+# Error vocabulary
+# ----------------------------------------------------------------------
+class InjectedFault(RuntimeError):
+    """Raised at an armed injection site (always classified transient)."""
+
+
+class TransientDeviceError(RuntimeError):
+    """Explicitly-transient wrapper for callers surfacing retryable errors."""
+
+
+class WatchdogTimeout(RuntimeError):
+    """An attempt exceeded the supervisor deadline at a chunk boundary."""
+
+
+class SupervisorExhausted(RuntimeError):
+    """Every rung of the degradation ladder failed; carries the incident log."""
+
+    def __init__(self, message: str, incidents: "IncidentLog"):
+        super().__init__(message)
+        self.incidents = incidents
+
+
+# jaxlib surfaces device failures under this name (it subclasses RuntimeError,
+# so we match by name rather than importing jaxlib here).
+_TRANSIENT_TYPE_NAMES = {"XlaRuntimeError"}
+
+
+def is_transient(exc: BaseException) -> bool:
+    """Should the supervisor retry/degrade (True) or re-raise (False)?
+
+    Transient: injected faults, watchdog timeouts, explicit
+    :class:`TransientDeviceError`, OS/timeout/connection errors, and
+    ``XlaRuntimeError`` (device runtime failures). Everything else — e.g.
+    ``ValueError`` from malformed input — is a programming error the ladder
+    must not paper over.
+    """
+    if isinstance(
+        exc,
+        (
+            InjectedFault,
+            TransientDeviceError,
+            WatchdogTimeout,
+            TimeoutError,
+            ConnectionError,
+            OSError,
+        ),
+    ):
+        return True
+    return type(exc).__name__ in _TRANSIENT_TYPE_NAMES
+
+
+# ----------------------------------------------------------------------
+# Fault-injection registry
+# ----------------------------------------------------------------------
+@dataclasses.dataclass
+class _ArmedFault:
+    remaining: int
+    kind: str = "raise"  # "raise" | "slow" | "torn"
+    value: float = 0.0  # seconds for kind="slow"
+
+
+class FaultRegistry:
+    """Process-global registry of induced faults at named sites.
+
+    Site names are dotted, underscore-free identifiers
+    (``resilience.attempt.device``, ``checkpoint.save``). Arm a site either
+    programmatically::
+
+        with FAULTS.inject("resilience.attempt.device", times=2):
+            ...
+
+    or from the environment, mapping ``GHS_FAULT_<SITE>`` with dots as
+    underscores and a ``times[:kind[:value]]`` value::
+
+        GHS_FAULT_RESILIENCE_ATTEMPT_DEVICE=2
+        GHS_FAULT_CHECKPOINT_SAVE=1:torn
+        GHS_FAULT_RESILIENCE_SLOW_STEPPED=1:slow:3600
+
+    Kinds: ``raise`` makes the site raise :class:`InjectedFault`; ``slow``
+    advances the supervisor's virtual clock by ``value`` seconds at the next
+    chunk boundary (a deterministic stand-in for a stalled dispatch — no
+    sleeps); ``torn`` makes ``save_checkpoint`` leave a truncated file and
+    raise, simulating a crash mid-write on a non-atomic filesystem.
+    """
+
+    ENV_PREFIX = "GHS_FAULT_"
+
+    def __init__(self):
+        self._sites: Dict[str, _ArmedFault] = {}
+        self._env_loaded = False
+
+    # -- configuration -------------------------------------------------
+    def arm(
+        self, site: str, *, times: int = 1, kind: str = "raise", value: float = 0.0
+    ) -> None:
+        if kind not in ("raise", "slow", "torn"):
+            raise ValueError(f"unknown fault kind {kind!r}")
+        if "_" in site:
+            raise ValueError(
+                f"site {site!r} may not contain '_' (reserved for the env mapping)"
+            )
+        self._sites[site] = _ArmedFault(remaining=times, kind=kind, value=value)
+
+    def disarm(self, site: str) -> None:
+        self._sites.pop(site, None)
+
+    def reset(self) -> None:
+        """Forget every armed site AND any env-derived state (test isolation)."""
+        self._sites.clear()
+        self._env_loaded = True  # do not re-read the env behind the reset
+
+    def reload_env(self) -> None:
+        """(Re-)parse ``GHS_FAULT_*`` from the current environment."""
+        self._env_loaded = True
+        for key, raw in os.environ.items():
+            if not key.startswith(self.ENV_PREFIX) or not raw:
+                continue
+            site = key[len(self.ENV_PREFIX):].lower().replace("_", ".")
+            parts = raw.split(":")
+            try:
+                times = int(parts[0])
+                kind = parts[1] if len(parts) > 1 else "raise"
+                value = float(parts[2]) if len(parts) > 2 else 0.0
+            except ValueError as e:
+                raise ValueError(
+                    f"bad {key}={raw!r}; expected times[:kind[:value]]"
+                ) from e
+            self.arm(site, times=times, kind=kind, value=value)
+
+    @contextlib.contextmanager
+    def inject(
+        self, site: str, *, times: int = 1, kind: str = "raise", value: float = 0.0
+    ):
+        """Arm ``site`` for the duration of the block, disarming on exit."""
+        self.arm(site, times=times, kind=kind, value=value)
+        try:
+            yield self
+        finally:
+            self.disarm(site)
+
+    # -- firing --------------------------------------------------------
+    def armed(self, site: str) -> bool:
+        """Is ``site`` armed? (peek — does not consume a shot)."""
+        if not self._env_loaded:
+            self.reload_env()
+        return site in self._sites
+
+    def pop(self, site: str) -> Optional[_ArmedFault]:
+        """Consume one shot at ``site``; returns the armed spec or ``None``."""
+        if not self._env_loaded:
+            self.reload_env()
+        armed = self._sites.get(site)
+        if armed is None or armed.remaining <= 0:
+            return None
+        armed.remaining -= 1
+        if armed.remaining == 0:
+            del self._sites[site]
+        return armed
+
+    def fire(self, site: str) -> None:
+        """Raise :class:`InjectedFault` if ``site`` is armed (kind ``raise``)."""
+        armed = self.pop(site)
+        if armed is not None and armed.kind == "raise":
+            raise InjectedFault(f"injected fault at {site}")
+
+
+FAULTS = FaultRegistry()
+
+
+# ----------------------------------------------------------------------
+# Incident log
+# ----------------------------------------------------------------------
+@dataclasses.dataclass
+class Incident:
+    rung: str
+    attempt: int  # 1-based within the rung
+    outcome: str  # "ok" | "transient" | "timeout" | "unavailable" | "fatal"
+    error: Optional[str] = None
+    elapsed_s: float = 0.0
+    backoff_s: float = 0.0
+
+
+class IncidentLog:
+    """Structured record of every supervised attempt, in order."""
+
+    def __init__(self):
+        self.records: List[Incident] = []
+
+    def add(self, **kwargs) -> Incident:
+        rec = Incident(**kwargs)
+        self.records.append(rec)
+        return rec
+
+    @property
+    def final_rung(self) -> Optional[str]:
+        for rec in reversed(self.records):
+            if rec.outcome == "ok":
+                return rec.rung
+        return None
+
+    def to_dicts(self) -> List[dict]:
+        return [dataclasses.asdict(r) for r in self.records]
+
+    def to_json(self, **kwargs) -> str:
+        return json.dumps(self.to_dicts(), **kwargs)
+
+    def summary(self) -> str:
+        """One line per attempt, e.g. ``device#1 transient(InjectedFault)``."""
+        parts = []
+        for r in self.records:
+            detail = "" if r.error is None else f"({r.error.split('(')[0]})"
+            parts.append(f"{r.rung}#{r.attempt} {r.outcome}{detail}")
+        return " -> ".join(parts)
+
+    def __len__(self) -> int:
+        return len(self.records)
+
+
+# ----------------------------------------------------------------------
+# Degradation ladder rungs — all share _solve's (edge_ids, fragment, levels)
+# contract. `tick` (when not None) is called at chunk/level boundaries; the
+# supervisor uses it for cooperative watchdog checks.
+# ----------------------------------------------------------------------
+def _mask_to_ids(graph: Graph, mst_ranks, fragment, levels):
+    ranks = np.nonzero(np.asarray(mst_ranks))[0]
+    edge_ids = np.sort(graph.edge_id_of_rank(ranks))
+    return edge_ids, np.asarray(fragment)[: graph.num_nodes], int(levels)
+
+
+def _rung_sharded(graph: Graph, tick):
+    try:
+        from distributed_ghs_implementation_tpu.parallel.sharded import (
+            solve_graph_sharded,
+        )
+    except ImportError as e:
+        raise NotImplementedError("sharded backend unavailable") from e
+    return solve_graph_sharded(graph)
+
+
+def _rung_device(graph: Graph, tick):
+    from distributed_ghs_implementation_tpu.models.rank_solver import (
+        make_production_solver,
+    )
+
+    solve = make_production_solver(graph)
+    on_chunk = None if tick is None else (lambda level, frag, mst, count: tick())
+    mst, fragment, levels = solve(on_chunk=on_chunk)
+    return _mask_to_ids(graph, mst, fragment, levels)
+
+
+def _rung_stepped(graph: Graph, tick):
+    from distributed_ghs_implementation_tpu.models.boruvka import (
+        prepare_device_arrays,
+        solve_arrays_stepped,
+    )
+
+    args = prepare_device_arrays(graph)
+    on_level = (
+        None if tick is None else (lambda level, f, m, has, count, dt: tick())
+    )
+    mst, fragment, levels = solve_arrays_stepped(
+        *args, stepped_levels=None, on_level=on_level
+    )
+    return _mask_to_ids(graph, mst, fragment, levels)
+
+
+def _rung_host(graph: Graph, tick):
+    from distributed_ghs_implementation_tpu.models.rank_solver import (
+        solve_graph_kruskal_host,
+    )
+
+    # Raises NotImplementedError (rung unavailable) on float weights or a
+    # missing native toolchain — the supervisor records it and degrades.
+    return solve_graph_kruskal_host(graph)
+
+
+_RUNGS = {
+    "sharded": _rung_sharded,
+    "device": _rung_device,
+    "stepped": _rung_stepped,
+    "host": _rung_host,
+}
+
+#: Degradation order: multi-chip -> single-device production routing ->
+#: host-stepped kernel (simplest device path, per-level sync) -> host
+#: Kruskal (no accelerator at all). Each rung trades speed for fewer moving
+#: parts; all compute the identical forest (rank order makes the MSF unique).
+LADDER: Tuple[str, ...] = ("sharded", "device", "stepped", "host")
+
+
+# ----------------------------------------------------------------------
+# Supervisor
+# ----------------------------------------------------------------------
+@dataclasses.dataclass(frozen=True)
+class SupervisorConfig:
+    """Retry/degrade policy.
+
+    ``retries_per_rung`` is the number of *re*-tries after the first attempt
+    (so a rung sees at most ``retries_per_rung + 1`` attempts).
+    ``deadline_s`` arms the cooperative watchdog: attempts are aborted with
+    :class:`WatchdogTimeout` at the first chunk/level boundary past the
+    deadline (rungs without boundary hooks — sharded, host — run unguarded).
+    """
+
+    retries_per_rung: int = 1
+    backoff_base_s: float = 0.5
+    backoff_cap_s: float = 30.0
+    deadline_s: Optional[float] = None
+    ladder: Tuple[str, ...] = LADDER
+
+
+class Supervisor:
+    """Retry, degrade, and log around any ladder rung.
+
+    ``clock``/``sleep`` are injectable for deterministic tests (the armed
+    ``resilience.slow.<rung>`` site advances a virtual skew on top of
+    ``clock``, so a "slow chunk" is simulated without wall-clock sleeps).
+    """
+
+    def __init__(
+        self,
+        config: Optional[SupervisorConfig] = None,
+        *,
+        clock=time.monotonic,
+        sleep=time.sleep,
+    ):
+        self.config = config or SupervisorConfig()
+        self._clock = clock
+        self._sleep = sleep
+        bad = [r for r in self.config.ladder if r not in _RUNGS]
+        if bad:
+            raise ValueError(f"unknown ladder rungs {bad}; known: {sorted(_RUNGS)}")
+
+    def solve(self, graph: Graph, *, entry: str = "device"):
+        """Run the ladder from ``entry`` down; returns
+        ``(edge_ids, fragment, levels, incident_log)``.
+
+        ``entry`` outside the ladder (e.g. ``"protocol"``) starts at
+        ``"device"``. Raises :class:`SupervisorExhausted` when every rung
+        fails, non-transient errors immediately (after logging them).
+        """
+        cfg = self.config
+        log = IncidentLog()
+        if graph.num_nodes == 0 or graph.num_edges == 0:
+            return (
+                np.zeros(0, dtype=np.int64),
+                np.arange(graph.num_nodes, dtype=np.int32),
+                0,
+                log,
+            )
+        ladder = cfg.ladder
+        if entry in ladder:
+            start = ladder.index(entry)
+        elif "device" in ladder:
+            start = ladder.index("device")
+        else:
+            start = 0
+        for rung in ladder[start:]:
+            outcome = self._attempt_rung(rung, graph, log)
+            if outcome is not None:
+                return outcome + (log,)
+        raise SupervisorExhausted(
+            f"every rung failed: {log.summary()}", log
+        )
+
+    # ------------------------------------------------------------------
+    def _attempt_rung(self, rung: str, graph: Graph, log: IncidentLog):
+        """All attempts of one rung; result tuple on success, None to degrade."""
+        cfg = self.config
+        for attempt in range(1, cfg.retries_per_rung + 2):
+            skew = [0.0]
+            t0 = self._clock()
+
+            def tick():
+                armed = FAULTS.pop(f"resilience.slow.{rung}")
+                if armed is not None:
+                    if armed.kind == "slow":
+                        skew[0] += armed.value
+                    else:
+                        raise InjectedFault(f"injected fault at resilience.slow.{rung}")
+                elapsed = (self._clock() - t0) + skew[0]
+                if cfg.deadline_s is not None and elapsed > cfg.deadline_s:
+                    raise WatchdogTimeout(
+                        f"{rung} attempt {attempt}: {elapsed:.1f}s elapsed "
+                        f"exceeds the {cfg.deadline_s}s deadline"
+                    )
+
+            # Boundary hooks change solver routing slightly (chunked vs
+            # speculative dispatch), so only guard when the watchdog has a
+            # deadline to enforce — or a slow site is armed, which must be
+            # consumed here rather than leak into an unrelated later solve.
+            guard = (
+                tick
+                if cfg.deadline_s is not None
+                or FAULTS.armed(f"resilience.slow.{rung}")
+                else None
+            )
+            try:
+                FAULTS.fire(f"resilience.attempt.{rung}")
+                result = _RUNGS[rung](graph, guard)
+            except NotImplementedError as e:
+                log.add(
+                    rung=rung,
+                    attempt=attempt,
+                    outcome="unavailable",
+                    error=str(e),
+                    elapsed_s=(self._clock() - t0) + skew[0],
+                )
+                return None  # this rung can never work here: degrade
+            except Exception as e:  # noqa: BLE001 — classification below
+                elapsed = (self._clock() - t0) + skew[0]
+                if not is_transient(e):
+                    log.add(
+                        rung=rung,
+                        attempt=attempt,
+                        outcome="fatal",
+                        error=repr(e),
+                        elapsed_s=elapsed,
+                    )
+                    raise
+                retrying = attempt <= cfg.retries_per_rung
+                backoff = 0.0
+                if retrying:
+                    backoff = min(
+                        cfg.backoff_base_s * (2 ** (attempt - 1)),
+                        cfg.backoff_cap_s,
+                    )
+                log.add(
+                    rung=rung,
+                    attempt=attempt,
+                    outcome="timeout" if isinstance(e, WatchdogTimeout) else "transient",
+                    error=repr(e),
+                    elapsed_s=elapsed,
+                    backoff_s=backoff,
+                )
+                if retrying and backoff > 0:
+                    self._sleep(backoff)
+                continue
+            log.add(
+                rung=rung,
+                attempt=attempt,
+                outcome="ok",
+                elapsed_s=(self._clock() - t0) + skew[0],
+            )
+            return result
+        return None  # retries exhausted: degrade to the next rung
+
+
+def supervised_solve(
+    graph: Graph,
+    *,
+    entry: str = "device",
+    config: Optional[SupervisorConfig] = None,
+    clock=time.monotonic,
+    sleep=time.sleep,
+):
+    """Convenience wrapper: ``Supervisor(config).solve(graph, entry=entry)``."""
+    return Supervisor(config, clock=clock, sleep=sleep).solve(graph, entry=entry)

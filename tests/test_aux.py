@@ -782,3 +782,54 @@ def test_production_solver_chunked_spec_regime(monkeypatch):
     mst, frag, _ = solve(on_chunk=hook)
     assert calls, "chunked form fired no on_chunk"
     assert np.array_equal(np.asarray(mst), np.asarray(mst_ref))
+
+
+def test_broadcast_resume_state_single_process_passthrough():
+    """Single-process runs skip the collective: state comes back unchanged."""
+    from distributed_ghs_implementation_tpu.parallel import multihost
+
+    state = (
+        np.arange(6, dtype=np.int32),
+        np.zeros(12, dtype=bool),
+        3,
+    )
+    assert multihost.broadcast_resume_state(state) is state
+    assert multihost.broadcast_resume_state(None) is None
+
+
+def test_broadcast_resume_state_single_process_error():
+    """error=True (the primary's pre-raise abort signal) returns None in a
+    single-process run so the caller's re-raise proceeds — regression guard
+    for the checkpoint abort discipline."""
+    from distributed_ghs_implementation_tpu.parallel import multihost
+
+    state = (np.arange(3, dtype=np.int32), np.ones(5, dtype=bool), 1)
+    assert multihost.broadcast_resume_state(state, error=True) is None
+    assert multihost.broadcast_resume_state(None, error=True) is None
+
+
+def test_failure_report_protocol_nodes_on_failed_run():
+    """The protocol table coexists with a failing verification: edge-state
+    tallies, per-node rows, and the alive-edge diagnosis all populate."""
+    import dataclasses
+
+    from distributed_ghs_implementation_tpu.api import minimum_spanning_forest
+    from distributed_ghs_implementation_tpu.protocol.runner import run_protocol
+    from distributed_ghs_implementation_tpu.utils.diagnostics import failure_report
+    from distributed_ghs_implementation_tpu.utils.verify import verify_result
+
+    g = erdos_renyi_graph(20, 0.3, seed=23)
+    nodes, _ = run_protocol(g)
+    result = minimum_spanning_forest(g, backend="protocol")
+    broken = dataclasses.replace(result, edge_ids=result.edge_ids[:-1])
+    v = verify_result(broken)
+    assert not v.ok
+    report = failure_report(broken, v, nodes=nodes)
+    proto = report["protocol"]
+    assert proto["edge_state_totals"]["BRANCH"] == 2 * (g.num_nodes - 1)
+    assert not proto["nodes_truncated"] and len(proto["nodes"]) == g.num_nodes
+    halted_roots = [r for r in proto["nodes"] if r["halted"]]
+    assert halted_roots, "a completed protocol run must have halted roots"
+    assert all(r["messages_processed"] > 0 for r in proto["nodes"])
+    assert report["verification"]["ok"] is False
+    assert report["edges"]["alive_inter_fragment"] > 0

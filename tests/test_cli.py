@@ -3,7 +3,6 @@
 import json
 import os
 
-import pytest
 
 from distributed_ghs_implementation_tpu.cli import main
 

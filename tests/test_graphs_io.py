@@ -4,7 +4,6 @@ import json
 import os
 
 import numpy as np
-import pytest
 
 from distributed_ghs_implementation_tpu.graphs.edgelist import Graph
 from distributed_ghs_implementation_tpu.graphs.generators import (

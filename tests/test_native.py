@@ -1,6 +1,5 @@
 """Native ingestion library: build, bindings, and NumPy-fallback parity."""
 
-import os
 
 import numpy as np
 import pytest

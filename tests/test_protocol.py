@@ -143,3 +143,23 @@ def test_message_complexity_bound():
         assert transport.messages_sent <= bound, (
             n, g.num_edges, transport.messages_sent, bound,
         )
+
+
+def test_transport_livelock_guard_raises():
+    """A node that defers forever must trip the max_events guard, not spin:
+    the deterministic analog of the reference's requeue-cap hang."""
+
+    class AlwaysDefer:
+        def handle(self, msg):
+            return False
+
+    from distributed_ghs_implementation_tpu.protocol.messages import (
+        Message,
+        MessageType,
+    )
+
+    transport = SimTransport(max_events=1000)
+    transport.send(0, 0, Message(MessageType.TEST, sender=0))
+    with pytest.raises(RuntimeError, match="did not quiesce within 1000 events"):
+        transport.run({0: AlwaysDefer()})
+    assert transport.messages_deferred > 0

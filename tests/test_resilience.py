@@ -1,0 +1,474 @@
+"""Self-healing supervisor, fault registry, checkpoint recovery, chaos drill.
+
+Covers the acceptance contract end to end: induced solver faults walk the
+retry/degrade ladder and still return the oracle MST with every attempt in
+the incident log; torn checkpoint writes recover from the retained
+generation, then from scratch. Deterministic throughout — injected faults
+and a virtual clock, no sleeps.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+from distributed_ghs_implementation_tpu.api import minimum_spanning_forest
+from distributed_ghs_implementation_tpu.graphs.generators import erdos_renyi_graph
+from distributed_ghs_implementation_tpu.models.boruvka import solve_graph
+from distributed_ghs_implementation_tpu.utils.resilience import (
+    FAULTS,
+    FaultRegistry,
+    InjectedFault,
+    Supervisor,
+    SupervisorConfig,
+    SupervisorExhausted,
+    TransientDeviceError,
+    WatchdogTimeout,
+    is_transient,
+)
+
+G = erdos_renyi_graph(80, 0.08, seed=5)
+REF_IDS = solve_graph(G)[0]
+
+# No-sleep, zero-backoff policy used throughout (tier-1 must not wait).
+FAST = SupervisorConfig(retries_per_rung=1, backoff_base_s=0.0)
+
+
+def _sup(config=FAST, **kwargs):
+    kwargs.setdefault("sleep", lambda s: None)
+    return Supervisor(config, **kwargs)
+
+
+@pytest.fixture(autouse=True)
+def _clean_registry():
+    FAULTS.reset()
+    yield
+    FAULTS.reset()
+
+
+# ----------------------------------------------------------------------
+# Fault registry
+# ----------------------------------------------------------------------
+def test_registry_arm_pop_counts():
+    reg = FaultRegistry()
+    reg.arm("a.site", times=2)
+    assert reg.pop("a.site") is not None
+    assert reg.pop("a.site") is not None
+    assert reg.pop("a.site") is None  # exhausted and forgotten
+
+
+def test_registry_fire_raises_only_when_armed():
+    reg = FaultRegistry()
+    reg.fire("quiet.site")  # unarmed: no-op
+    reg.arm("loud.site")
+    with pytest.raises(InjectedFault, match="loud.site"):
+        reg.fire("loud.site")
+    reg.fire("loud.site")  # single-shot: now disarmed
+
+
+def test_registry_context_manager_disarms():
+    reg = FaultRegistry()
+    with reg.inject("tmp.site", times=99):
+        assert reg.pop("tmp.site") is not None
+    assert reg.pop("tmp.site") is None
+
+
+def test_registry_rejects_bad_input():
+    reg = FaultRegistry()
+    with pytest.raises(ValueError, match="kind"):
+        reg.arm("x", kind="explode")
+    with pytest.raises(ValueError, match="'_'"):
+        reg.arm("under_scored")
+
+
+def test_registry_env_parsing(monkeypatch):
+    monkeypatch.setenv("GHS_FAULT_RESILIENCE_ATTEMPT_DEVICE", "2")
+    monkeypatch.setenv("GHS_FAULT_RESILIENCE_SLOW_STEPPED", "1:slow:3600")
+    reg = FaultRegistry()
+    reg.reload_env()
+    armed = reg.pop("resilience.attempt.device")
+    assert armed is not None and armed.kind == "raise"
+    assert reg.pop("resilience.attempt.device") is not None
+    slow = reg.pop("resilience.slow.stepped")
+    assert slow is not None and slow.kind == "slow" and slow.value == 3600.0
+
+
+def test_registry_env_bad_value(monkeypatch):
+    monkeypatch.setenv("GHS_FAULT_BROKEN", "lots")
+    reg = FaultRegistry()
+    with pytest.raises(ValueError, match="GHS_FAULT_BROKEN"):
+        reg.reload_env()
+
+
+def test_transient_classification():
+    assert is_transient(InjectedFault("x"))
+    assert is_transient(TransientDeviceError("x"))
+    assert is_transient(WatchdogTimeout("x"))
+    assert is_transient(OSError("io"))
+    assert not is_transient(ValueError("bad input"))
+    assert not is_transient(RuntimeError("livelock guard"))
+
+    class XlaRuntimeError(RuntimeError):  # jaxlib's name, matched by name
+        pass
+
+    assert is_transient(XlaRuntimeError("device halted"))
+
+
+# ----------------------------------------------------------------------
+# Supervisor
+# ----------------------------------------------------------------------
+def test_supervised_happy_path_parity():
+    ids, frag, _lv, log = _sup().solve(G, entry="device")
+    assert np.array_equal(ids, REF_IDS)
+    assert [(r.rung, r.outcome) for r in log.records] == [("device", "ok")]
+    assert log.final_rung == "device"
+
+
+def test_supervised_retries_then_succeeds():
+    slept = []
+    cfg = SupervisorConfig(retries_per_rung=1, backoff_base_s=2.0)
+    sup = Supervisor(cfg, sleep=slept.append)
+    with FAULTS.inject("resilience.attempt.device", times=1):
+        ids, _, _, log = sup.solve(G, entry="device")
+    assert np.array_equal(ids, REF_IDS)
+    assert [(r.rung, r.outcome) for r in log.records] == [
+        ("device", "transient"),
+        ("device", "ok"),
+    ]
+    assert slept == [2.0]  # backoff honored, via the injected sleeper
+    assert log.records[0].backoff_s == 2.0
+
+
+def test_supervised_backoff_doubles_and_caps():
+    slept = []
+    cfg = SupervisorConfig(
+        retries_per_rung=3, backoff_base_s=2.0, backoff_cap_s=5.0, ladder=("device",)
+    )
+    with FAULTS.inject("resilience.attempt.device", times=3):
+        ids, _, _, log = Supervisor(cfg, sleep=slept.append).solve(G)
+    assert np.array_equal(ids, REF_IDS)
+    assert slept == [2.0, 4.0, 5.0]  # 2, 4, then capped at 5
+
+
+def test_supervised_degrades_down_the_ladder():
+    """The acceptance scenario: persistent device faults ride the ladder to
+    the stepped rung; the incident log names every attempt and fallback."""
+    with FAULTS.inject("resilience.attempt.device", times=2):
+        ids, frag, _lv, log = _sup().solve(G, entry="device")
+    assert np.array_equal(ids, REF_IDS)
+    assert [(r.rung, r.outcome) for r in log.records] == [
+        ("device", "transient"),
+        ("device", "transient"),
+        ("stepped", "ok"),
+    ]
+    assert log.final_rung == "stepped"
+    assert "InjectedFault" in log.records[0].error
+    assert "stepped#1 ok" in log.summary()
+
+
+def test_supervised_watchdog_timeout_virtual_clock():
+    """An armed slow-chunk site advances virtual time past the deadline: the
+    attempt dies with WatchdogTimeout at a chunk boundary (no sleeps) and
+    the clean retry succeeds."""
+    cfg = SupervisorConfig(retries_per_rung=1, backoff_base_s=0.0, deadline_s=100.0)
+    sup = _sup(cfg, clock=lambda: 0.0)  # frozen real clock: only skew advances
+    with FAULTS.inject("resilience.slow.device", times=1, kind="slow", value=1e6):
+        ids, _, _, log = sup.solve(G, entry="device")
+    assert np.array_equal(ids, REF_IDS)
+    assert [(r.rung, r.outcome) for r in log.records] == [
+        ("device", "timeout"),
+        ("device", "ok"),
+    ]
+    assert log.records[0].elapsed_s >= 1e6
+
+
+def test_supervised_fatal_error_propagates():
+    """Non-transient errors are logged and re-raised, never retried."""
+    import distributed_ghs_implementation_tpu.models.rank_solver as rs
+
+    real = rs.make_production_solver
+    calls = []
+
+    def broken(graph):
+        calls.append(1)
+        raise ValueError("malformed input")
+
+    rs.make_production_solver = broken
+    try:
+        with pytest.raises(ValueError, match="malformed input"):
+            _sup().solve(G, entry="device")
+    finally:
+        rs.make_production_solver = real
+    assert calls == [1]  # exactly one attempt: no retry on fatal
+
+
+def test_supervised_exhausted_carries_log():
+    cfg = SupervisorConfig(retries_per_rung=0, backoff_base_s=0.0, ladder=("device",))
+    with FAULTS.inject("resilience.attempt.device", times=5):
+        with pytest.raises(SupervisorExhausted) as ei:
+            _sup(cfg).solve(G, entry="device")
+    log = ei.value.incidents
+    assert [(r.rung, r.outcome) for r in log.records] == [("device", "transient")]
+
+
+def test_supervised_empty_graph():
+    from distributed_ghs_implementation_tpu.graphs.edgelist import Graph
+
+    g = Graph.from_edges(3, [])
+    ids, frag, lv, log = _sup().solve(g)
+    assert ids.size == 0 and frag.tolist() == [0, 1, 2] and len(log) == 0
+
+
+def test_api_supervised_surface():
+    """`minimum_spanning_forest(supervised=True)` labels the backend with the
+    rung that actually ran and attaches the incident log."""
+    with FAULTS.inject("resilience.attempt.device", times=2):
+        r = minimum_spanning_forest(
+            G,
+            supervised=True,
+            supervisor=_sup(),
+        )
+    assert np.array_equal(r.edge_ids, REF_IDS)
+    assert r.backend == "supervised/stepped"
+    assert len(r.incidents) == 3
+    assert r.incidents.to_json()  # serializes
+
+
+def test_api_supervised_env_knob(monkeypatch):
+    monkeypatch.setenv("GHS_FAULT_RESILIENCE_ATTEMPT_DEVICE", "1")
+    FAULTS.reload_env()
+    r = minimum_spanning_forest(G, supervised=True, supervisor=_sup())
+    assert np.array_equal(r.edge_ids, REF_IDS)
+    assert [(i.rung, i.outcome) for i in r.incidents.records] == [
+        ("device", "transient"),
+        ("device", "ok"),
+    ]
+
+
+# ----------------------------------------------------------------------
+# Checkpoint generations + recovery
+# ----------------------------------------------------------------------
+def test_checkpoint_retains_previous_generation(tmp_path):
+    from distributed_ghs_implementation_tpu.utils.checkpoint import (
+        load_checkpoint,
+        save_checkpoint,
+    )
+
+    p = str(tmp_path / "gen.npz")
+    save_checkpoint(p, np.arange(4, dtype=np.int32), np.zeros(8, bool), 1)
+    save_checkpoint(p, np.arange(4, dtype=np.int32), np.ones(8, bool), 2)
+    assert os.path.exists(p + ".bak")
+    _, _, lv_cur = load_checkpoint(p)
+    _, _, lv_bak = load_checkpoint(p + ".bak")
+    assert (lv_cur, lv_bak) == (2, 1)
+
+
+def test_torn_write_recovers_from_bak(tmp_path):
+    """The acceptance scenario: a save torn mid-write costs one generation,
+    not the run — resume falls back to .bak and matches the oracle."""
+    from distributed_ghs_implementation_tpu.utils.checkpoint import (
+        graph_fingerprint,
+        load_checkpoint,
+        load_checkpoint_resilient,
+        save_checkpoint,
+        solve_graph_checkpointed,
+    )
+
+    g = erdos_renyi_graph(120, 0.06, seed=31)
+    ref_ids = solve_graph(g)[0]
+    fp = graph_fingerprint(g)
+    p = str(tmp_path / "torn.npz")
+    solve_graph_checkpointed(g, p, every=1)
+    frag, mst, lv = load_checkpoint(p, expect_fingerprint=fp)
+
+    with FAULTS.inject("checkpoint.save", times=1, kind="torn"):
+        with pytest.raises(InjectedFault, match="torn"):
+            save_checkpoint(p, frag, mst, lv, fingerprint=fp)
+
+    # The primary generation is now a truncated npz; .bak still loads.
+    with pytest.raises(Exception):
+        load_checkpoint(p)
+    state, source, notes = load_checkpoint_resilient(p, expect_fingerprint=fp)
+    assert state is not None and source == p + ".bak"
+    assert notes and notes[0][0] == p  # the torn file is named in the trail
+
+    ids, _, _ = solve_graph_checkpointed(g, p, resume=True)
+    assert np.array_equal(ids, ref_ids)
+
+
+def test_double_corruption_falls_back_to_fresh_solve(tmp_path):
+    from distributed_ghs_implementation_tpu.utils.checkpoint import (
+        load_checkpoint_resilient,
+        solve_graph_checkpointed,
+    )
+
+    g = erdos_renyi_graph(120, 0.06, seed=32)
+    ref_ids = solve_graph(g)[0]
+    p = str(tmp_path / "dead.npz")
+    solve_graph_checkpointed(g, p, every=1)
+    for victim in (p, p + ".bak"):
+        with open(victim, "wb") as f:
+            f.write(b"\x00not-a-zip")
+    state, source, notes = load_checkpoint_resilient(p)
+    assert state is None and source is None and len(notes) == 2
+    ids, _, _ = solve_graph_checkpointed(g, p, resume=True)
+    assert np.array_equal(ids, ref_ids)
+
+
+def test_wrong_graph_checkpoint_still_refused(tmp_path):
+    """Recovery must not weaken the fingerprint guard: wrong-graph resume
+    raises CheckpointMismatch (a ValueError) instead of falling back."""
+    from distributed_ghs_implementation_tpu.utils.checkpoint import (
+        CheckpointMismatch,
+        solve_graph_checkpointed,
+    )
+
+    g1 = erdos_renyi_graph(100, 0.1, seed=16)
+    g2 = erdos_renyi_graph(100, 0.1, seed=17)
+    p = str(tmp_path / "fp.npz")
+    solve_graph_checkpointed(g1, p)
+    with pytest.raises(CheckpointMismatch, match="different graph"):
+        solve_graph_checkpointed(g2, p, resume=True)
+
+
+def test_plain_injected_save_failure_keeps_generations_loadable(tmp_path):
+    """kind="raise" at checkpoint.save models a crash before the rename: the
+    primary path is gone but .bak still resumes."""
+    from distributed_ghs_implementation_tpu.utils.checkpoint import (
+        load_checkpoint_resilient,
+        save_checkpoint,
+    )
+
+    p = str(tmp_path / "crash.npz")
+    save_checkpoint(p, np.arange(4, dtype=np.int32), np.zeros(8, bool), 1)
+    with FAULTS.inject("checkpoint.save", times=1):
+        with pytest.raises(InjectedFault):
+            save_checkpoint(p, np.arange(4, dtype=np.int32), np.ones(8, bool), 2)
+    state, source, _ = load_checkpoint_resilient(p)
+    assert state is not None and source == p + ".bak" and state[2] == 1
+
+
+# ----------------------------------------------------------------------
+# Chaos drill (the tier-1 fast subset of tools/chaos_drill.py)
+# ----------------------------------------------------------------------
+def test_chaos_drill_fast_subset(tmp_path):
+    from distributed_ghs_implementation_tpu.utils.chaos import run_chaos_drill
+
+    report = run_chaos_drill(fast=True, workdir=str(tmp_path))
+    failed = [c for c in report["cases"] if not c["ok"]]
+    assert report["ok"], f"chaos cases failed: {failed}"
+    kinds = {c["kind"] for c in report["cases"]}
+    assert kinds == {"protocol", "solver", "checkpoint"}
+    # Every protocol case must have genuinely exercised its fault schedule.
+    for c in report["cases"]:
+        if c["kind"] == "protocol" and c["spec"]["drop"] > 0:
+            assert c["stats"]["dropped"] > 0
+
+
+def test_supervisor_kwarg_implies_supervised():
+    """Passing a configured supervisor must not be silently ignored."""
+    r = minimum_spanning_forest(G, supervisor=_sup())
+    assert r.backend == "supervised/device"
+    assert r.incidents is not None and r.incidents.final_rung == "device"
+
+
+def test_result_json_carries_incident_log(tmp_path):
+    """Persisted artifacts of a supervised run keep the attempt trail."""
+    from distributed_ghs_implementation_tpu.utils.reporting import result_to_dict
+
+    with FAULTS.inject("resilience.attempt.device", times=1):
+        r = minimum_spanning_forest(G, supervisor=_sup())
+    d = result_to_dict(r)
+    assert [i["outcome"] for i in d["incidents"]] == ["transient", "ok"]
+    plain = minimum_spanning_forest(G)
+    assert "incidents" not in result_to_dict(plain)
+
+
+def test_degraded_resume_warns(tmp_path):
+    """Falling back past a corrupt generation is loud (RuntimeWarning naming
+    the rejected file), not silent."""
+    import warnings
+
+    from distributed_ghs_implementation_tpu.utils.checkpoint import (
+        solve_graph_checkpointed,
+    )
+
+    g = erdos_renyi_graph(100, 0.08, seed=41)
+    p = str(tmp_path / "warn.npz")
+    solve_graph_checkpointed(g, p, every=1)
+    with open(p, "wb") as f:
+        f.write(b"\x00torn")
+    with pytest.warns(RuntimeWarning, match="previous generation"):
+        ids, _, _ = solve_graph_checkpointed(g, p, resume=True)
+    assert np.array_equal(ids, solve_graph(g)[0])
+    # A clean resume stays silent.
+    with warnings.catch_warnings():
+        warnings.simplefilter("error")
+        solve_graph_checkpointed(g, p, resume=True)
+
+
+def test_chaos_drill_crashed_case_reported(monkeypatch):
+    """A solver case whose supervisor crashes becomes ok:false in the
+    report, not a drill traceback."""
+    from distributed_ghs_implementation_tpu.utils import chaos
+    from distributed_ghs_implementation_tpu.utils import resilience
+
+    class Boom(resilience.Supervisor):
+        def solve(self, graph, *, entry="device"):
+            raise SupervisorExhausted("boom", resilience.IncidentLog())
+
+    monkeypatch.setattr(
+        "distributed_ghs_implementation_tpu.utils.resilience.Supervisor", Boom
+    )
+    cases = chaos._solver_cases(fast=True)
+    assert cases and all(c["ok"] is False for c in cases)
+    assert all("SupervisorExhausted" in c["error"] for c in cases)
+
+
+def test_slow_site_consumed_without_deadline():
+    """An armed slow site must be consumed by the guarded attempt even when
+    no deadline is set — it must not leak into a later solve."""
+    FAULTS.arm("resilience.slow.device", kind="slow", value=1e6)
+    ids, _, _, log = _sup().solve(G, entry="device")
+    assert np.array_equal(ids, REF_IDS)
+    assert [(r.rung, r.outcome) for r in log.records] == [("device", "ok")]
+    assert not FAULTS.armed("resilience.slow.device")
+
+
+def test_cli_supervised_deadline_watchdog(tmp_path, monkeypatch, capsys):
+    """`run --supervised --deadline-s` arms the watchdog end to end: an
+    env-injected slow chunk times the first attempt out, the retry lands."""
+    from distributed_ghs_implementation_tpu.cli import main as cli_main
+    from distributed_ghs_implementation_tpu.graphs import io as gio
+
+    gdir = str(tmp_path / "g")
+    gio.write_partition_dir(erdos_renyi_graph(30, 0.2, seed=6), gdir)
+    monkeypatch.setenv("GHS_FAULT_RESILIENCE_SLOW_DEVICE", "1:slow:1000000")
+    FAULTS.reload_env()
+    rc = cli_main(
+        ["run", "--graph-dir", gdir, "--backend", "device",
+         "--supervised", "--deadline-s", "600", "--verify"]
+    )
+    assert rc == 0
+    err = capsys.readouterr().err
+    assert "timeout" in err and "device#2 ok" in err
+
+
+def test_save_after_torn_recovery_keeps_good_generation(tmp_path):
+    """Rotating a torn primary over the good .bak would reopen the
+    zero-generation window; the torn file is dropped instead."""
+    from distributed_ghs_implementation_tpu.utils.checkpoint import (
+        load_checkpoint,
+        save_checkpoint,
+    )
+
+    p = str(tmp_path / "rot.npz")
+    save_checkpoint(p, np.arange(4, dtype=np.int32), np.zeros(8, bool), 1)
+    with FAULTS.inject("checkpoint.save", times=1, kind="torn"):
+        with pytest.raises(InjectedFault):
+            save_checkpoint(p, np.arange(4, dtype=np.int32), np.ones(8, bool), 2)
+    # p is torn, .bak holds level 1. The next save must not rotate the torn
+    # primary over it: afterwards BOTH generations load.
+    save_checkpoint(p, np.arange(4, dtype=np.int32), np.ones(8, bool), 3)
+    assert load_checkpoint(p)[2] == 3
+    assert load_checkpoint(p + ".bak")[2] == 1

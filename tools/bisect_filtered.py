@@ -74,9 +74,6 @@ def main():
             return out
         return w
 
-    fetches = []
-    orig_get = rs.jax.device_get
-
     names = [
         "_filtered_head", "_compact_and_mark", "_shrink_and_run",
         "_run_levels", "_finish_chunk", "_filter_suffix_ends",

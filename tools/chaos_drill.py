@@ -1,0 +1,20 @@
+#!/usr/bin/env python
+"""Chaos drill CLI: fault matrix (lossy transport x induced solver faults x
+torn checkpoint writes) vs the MST oracle.
+
+    python tools/chaos_drill.py [--full] [--no-solver] [--output report.json]
+
+Exit code 0 iff every case reaches oracle parity. The same drill is
+reachable as ``python -m distributed_ghs_implementation_tpu chaos``; the
+fast subset also runs inside tier-1 (``tests/test_resilience.py``).
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from distributed_ghs_implementation_tpu.utils.chaos import main  # noqa: E402
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))

@@ -22,7 +22,6 @@ import time
 
 import jax
 import jax.numpy as jnp
-import numpy as np
 
 from distributed_ghs_implementation_tpu.graphs.generators import rmat_graph
 from distributed_ghs_implementation_tpu.models.boruvka import (

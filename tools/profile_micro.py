@@ -91,7 +91,6 @@ def main():
 
     @functools.partial(jax.jit, static_argnames=("nbuckets",))
     def scan_gathers_only(fragment, *f, nbuckets):
-        n = fragment.shape[0]
         acc = jnp.zeros((), jnp.int32)
         for i in range(nbuckets):
             dstb = f[3 * i + 1]
