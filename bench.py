@@ -55,6 +55,11 @@ def main(argv=None) -> int:
     p.add_argument("--repeats", type=int, default=3)
     p.add_argument("--backend", default="device", choices=["device", "sharded"])
     p.add_argument("--no-verify", action="store_true")
+    p.add_argument(
+        "--metrics-out",
+        help="also write the run's metrics in the bench-gate schema "
+        "(tools/bench_gate.py compares such files across runs)",
+    )
     args = p.parse_args(argv)
 
     from distributed_ghs_implementation_tpu.api import minimum_spanning_forest
@@ -159,6 +164,31 @@ def main(argv=None) -> int:
         out["prep_s"] = round(prep_s, 3)
         out["e2e_edges_per_sec"] = round(g.num_edges / (prep_s + best), 1)
     print(json.dumps(out))
+    if args.metrics_out:
+        gate_metrics = {
+            "solve_s": best,
+            "edges_per_sec": edges_per_sec,
+            "levels": int(result.num_levels),
+            "mst_weight": int(result.total_weight),
+            "mst_edges": int(result.num_edges),
+        }
+        if prep_s is not None:
+            gate_metrics["prep_s"] = prep_s
+            gate_metrics["e2e_edges_per_sec"] = g.num_edges / (prep_s + best)
+        with open(args.metrics_out, "w") as f:
+            json.dump(
+                {
+                    "schema": "ghs-bench-metrics-v1",
+                    "config": {
+                        "workload": f"rmat-{args.scale}x{args.edge_factor}"
+                        f"-seed{SEED}-{args.backend}",
+                    },
+                    "metrics": gate_metrics,
+                },
+                f,
+                indent=2,
+            )
+            f.write("\n")
     return 0
 
 

@@ -219,6 +219,103 @@ def _cmd_chaos(args) -> int:
     return chaos.emit_report(report, args.output)
 
 
+def _trace_graph(args):
+    """The graph a trace/stats session runs on: ``--graph-dir`` when given,
+    else a seeded G(n,m) (default 1k nodes — small enough to trace every
+    backend, big enough for multi-level solver activity)."""
+    if args.graph_dir:
+        return _load_graph(args)
+    from distributed_ghs_implementation_tpu.graphs.generators import (
+        gnm_random_graph,
+    )
+
+    edges = args.edges or 4 * args.nodes
+    return gnm_random_graph(args.nodes, edges, seed=args.seed)
+
+
+def _traced_session(args):
+    """Run one fully-instrumented solve session; returns the event bus.
+
+    The solve goes through the self-healing supervisor (entry = the chosen
+    backend rung), so armed ``GHS_FAULT_*`` sites surface as structured
+    ``resilience.attempt`` retry events in the trace. Default entry is the
+    ``stepped`` rung — the host-stepped kernel emits one ``solver.level``
+    span per level, which is the timeline a trace is for. Unless disabled,
+    a protocol pass over the same graph rides along and contributes message
+    counters (``protocol.*``) to the same trace.
+    """
+    from distributed_ghs_implementation_tpu.api import minimum_spanning_forest
+    from distributed_ghs_implementation_tpu.obs.events import BUS
+    from distributed_ghs_implementation_tpu.utils.resilience import FAULTS
+
+    BUS.enable()
+    BUS.clear()
+    FAULTS.reload_env()
+    g = _trace_graph(args)
+    with BUS.span(
+        "trace.session", cat="trace", backend=args.backend,
+        nodes=g.num_nodes, edges=g.num_edges,
+    ):
+        if args.backend == "protocol":
+            from distributed_ghs_implementation_tpu.protocol.runner import (
+                solve_graph_protocol,
+            )
+
+            solve_graph_protocol(g)
+        else:
+            result = minimum_spanning_forest(
+                g, backend=args.backend, supervised=True
+            )
+            if result.incidents is not None and len(result.incidents):
+                print(
+                    f"supervisor: {result.incidents.summary()}", file=sys.stderr
+                )
+            if (
+                not args.no_protocol_sample
+                and g.num_nodes <= args.protocol_sample_max
+            ):
+                from distributed_ghs_implementation_tpu.protocol.runner import (
+                    solve_graph_protocol,
+                )
+
+                with BUS.span(
+                    "trace.protocol_sample", cat="trace", nodes=g.num_nodes
+                ):
+                    solve_graph_protocol(g)
+    return BUS
+
+
+def _cmd_trace(args) -> int:
+    from distributed_ghs_implementation_tpu.obs.export import (
+        render_stats,
+        write_chrome_trace,
+        write_events_jsonl,
+    )
+
+    bus = _traced_session(args)
+    write_chrome_trace(bus, args.out)
+    if args.jsonl:
+        write_events_jsonl(bus, args.jsonl)
+    print(render_stats(bus.snapshot()), file=sys.stderr)
+    print("open in https://ui.perfetto.dev or chrome://tracing", file=sys.stderr)
+    print(args.out)
+    return 0
+
+
+def _cmd_stats(args) -> int:
+    from distributed_ghs_implementation_tpu.obs.export import (
+        render_stats,
+        snapshot_from_jsonl,
+    )
+
+    if args.input:
+        snapshot = snapshot_from_jsonl(args.input)
+    else:
+        snapshot = _traced_session(args).snapshot()
+    print(render_stats(snapshot))
+    return 0
+
+
 def _cmd_bench(args) -> int:
     import bench as bench_mod  # repo-root bench.py
 
@@ -227,6 +324,8 @@ def _cmd_bench(args) -> int:
             "--repeats", str(args.repeats), "--backend", args.backend]
     if args.no_verify:
         argv.append("--no-verify")
+    if args.metrics_out:
+        argv += ["--metrics-out", args.metrics_out]
     return bench_mod.main(argv)
 
 
@@ -312,12 +411,56 @@ def build_parser() -> argparse.ArgumentParser:
     c.add_argument("--output", help="write the JSON report here")
     c.set_defaults(fn=_cmd_chaos)
 
+    def _obs_graph_args(sp):
+        sp.add_argument("--graph-dir", default=None,
+                        help="trace this graph dir / npz instead of generating")
+        sp.add_argument("--nodes", type=int, default=1000)
+        sp.add_argument("--edges", type=int, default=0,
+                        help="G(n,m) edges (default 4x nodes)")
+        sp.add_argument("--seed", type=int, default=42)
+        sp.add_argument(
+            "--backend",
+            default="stepped",
+            choices=["stepped", "device", "sharded", "protocol"],
+            help="supervisor entry rung (stepped emits per-level spans) or "
+            "the message-level protocol backend",
+        )
+        sp.add_argument(
+            "--no-protocol-sample",
+            action="store_true",
+            help="skip the protocol pass that adds message counters",
+        )
+        sp.add_argument("--protocol-sample-max", type=int, default=2000,
+                        help="largest node count the protocol sample runs at")
+
+    t = sub.add_parser(
+        "trace",
+        help="run an instrumented solve and export a Chrome-trace/Perfetto "
+        "timeline (solver levels, protocol counters, resilience retries)",
+    )
+    _obs_graph_args(t)
+    t.add_argument("--out", default="trace.json",
+                   help="Chrome-trace JSON output path")
+    t.add_argument("--jsonl", help="also write the raw event log here")
+    t.set_defaults(fn=_cmd_trace)
+
+    s = sub.add_parser(
+        "stats",
+        help="plain-text telemetry summary (span/counter/histogram tables) "
+        "from a fresh instrumented solve or an existing event JSONL",
+    )
+    _obs_graph_args(s)
+    s.add_argument("--input", help="summarize this event JSONL instead of running")
+    s.set_defaults(fn=_cmd_stats)
+
     b = sub.add_parser("bench", help="run the benchmark (see bench.py)")
     b.add_argument("--scale", type=int, default=22)
     b.add_argument("--edge-factor", type=int, default=16)
     b.add_argument("--repeats", type=int, default=3)
     b.add_argument("--backend", default="device", choices=["device", "sharded"])
     b.add_argument("--no-verify", action="store_true")
+    b.add_argument("--metrics-out",
+                   help="write bench-gate metrics JSON here (tools/bench_gate.py)")
     b.set_defaults(fn=_cmd_bench)
     return p
 

@@ -35,6 +35,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from distributed_ghs_implementation_tpu.graphs.edgelist import Graph
+from distributed_ghs_implementation_tpu.obs.events import BUS
 from distributed_ghs_implementation_tpu.ops.segment_ops import INT32_MAX, fragment_moe
 from distributed_ghs_implementation_tpu.ops.union_find import hook_and_compress
 
@@ -383,6 +384,13 @@ def solve_arrays_stepped(
         levels += 1
         has_np, count_np = jax.device_get((has, count))  # one round trip
         count_np = int(count_np)
+        BUS.complete(
+            "solver.level",
+            time.perf_counter() - t0,
+            cat="solver",
+            level=levels,
+            edges_alive=count_np // 2,  # directed slots -> undirected edges
+        )
         if on_level is not None:
             on_level(
                 levels, fragment, mst_ranks, bool(has_np), count_np,
@@ -397,10 +405,13 @@ def solve_arrays_stepped(
                 src_f, dst_f, rank = _compact_kernel(src_f, dst_f, rank, tgt)
     if levels >= max_levels:
         return mst_ranks, fragment, levels
-    mst_ranks, fragment, level = _continue_solve(
-        fragment, mst_ranks, jnp.asarray(levels, jnp.int32), src_f, dst_f, rank, ra, rb
-    )
-    return mst_ranks, fragment, int(level)
+    with BUS.span("solver.fused_finish", cat="solver", from_level=levels):
+        mst_ranks, fragment, level = _continue_solve(
+            fragment, mst_ranks, jnp.asarray(levels, jnp.int32),
+            src_f, dst_f, rank, ra, rb,
+        )
+        level = int(level)
+    return mst_ranks, fragment, level
 
 
 def _next_pow2(x: int) -> int:
@@ -471,25 +482,29 @@ def solve_graph(
         # far cheaper host prep); small graphs stay on the shape-bucketed flat
         # kernel (shared compiles, single dispatch).
         strategy = "rank" if graph.num_edges >= ELL_AUTO_EDGE_THRESHOLD else "fused"
-    if strategy == "rank":
-        from distributed_ghs_implementation_tpu.models.rank_solver import (
-            solve_graph_rank,
-        )
+    with BUS.span(
+        "solver.solve", cat="solver",
+        strategy=strategy, nodes=n, edges=graph.num_edges,
+    ):
+        if strategy == "rank":
+            from distributed_ghs_implementation_tpu.models.rank_solver import (
+                solve_graph_rank,
+            )
 
-        return solve_graph_rank(graph)
-    if strategy == "ell":
-        buckets, ra, rb, n_pad = prepare_ell_arrays(graph)
-        mst_ranks, fragment, levels = _solve_ell(buckets, ra, rb, num_nodes=n_pad)
-    elif strategy == "stepped":
-        args = prepare_device_arrays(graph, bucket_shapes=bucket_shapes)
-        mst_ranks, fragment, levels = solve_arrays_stepped(*args)
-    elif strategy == "fused":
-        args = prepare_device_arrays(graph, bucket_shapes=bucket_shapes)
-        mst_ranks, fragment, levels = _solve_from_iota(
-            *args[1:], num_nodes=args[0].shape[0]
-        )
-    else:
-        raise ValueError(f"unknown strategy {strategy!r}")
-    ranks = np.nonzero(np.asarray(mst_ranks))[0]
-    edge_ids = np.sort(graph.edge_id_of_rank(ranks))
-    return edge_ids, np.asarray(fragment)[:n], int(levels)
+            return solve_graph_rank(graph)
+        if strategy == "ell":
+            buckets, ra, rb, n_pad = prepare_ell_arrays(graph)
+            mst_ranks, fragment, levels = _solve_ell(buckets, ra, rb, num_nodes=n_pad)
+        elif strategy == "stepped":
+            args = prepare_device_arrays(graph, bucket_shapes=bucket_shapes)
+            mst_ranks, fragment, levels = solve_arrays_stepped(*args)
+        elif strategy == "fused":
+            args = prepare_device_arrays(graph, bucket_shapes=bucket_shapes)
+            mst_ranks, fragment, levels = _solve_from_iota(
+                *args[1:], num_nodes=args[0].shape[0]
+            )
+        else:
+            raise ValueError(f"unknown strategy {strategy!r}")
+        ranks = np.nonzero(np.asarray(mst_ranks))[0]
+        edge_ids = np.sort(graph.edge_id_of_rank(ranks))
+        return edge_ids, np.asarray(fragment)[:n], int(levels)

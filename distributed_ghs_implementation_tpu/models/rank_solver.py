@@ -1794,6 +1794,47 @@ def make_production_solver(graph: Graph):
                 vmin0, ra, rb, **_family_params(family),
                 on_chunk=on_chunk, parent1=parent1,
             )
+    return _observed_solver(solve, family)
+
+
+def _observed_solver(inner, family):
+    """Wrap a production solve with event-bus telemetry.
+
+    The overall dispatch is a ``solver.rank.solve`` span; when the caller
+    requests chunk boundaries, each one also lands as a ``solver.chunk``
+    event. Crucially the wrapper passes ``on_chunk`` through UNCHANGED when
+    the caller didn't ask for one — requesting boundaries selects the
+    chunked kernel forms, and observability must never reroute production.
+    """
+    import time as _time
+
+    from distributed_ghs_implementation_tpu.obs.events import BUS
+
+    def solve(on_chunk=None):
+        if not BUS.enabled:
+            return inner(on_chunk=on_chunk)
+        hook = on_chunk
+        if hook is not None:
+            last = [_time.perf_counter()]
+
+            def on_chunk(level, fragment, mst, count):  # noqa: F811
+                now = _time.perf_counter()
+                BUS.complete(
+                    "solver.chunk",
+                    now - last[0],
+                    cat="solver",
+                    level=int(level),
+                    edges_alive=int(count),
+                )
+                last[0] = now
+                hook(level, fragment, mst, count)
+
+        with BUS.span(
+            "solver.rank.solve", cat="solver",
+            family=str(family), chunked=hook is not None,
+        ):
+            return inner(on_chunk=on_chunk)
+
     return solve
 
 

@@ -1,0 +1,26 @@
+"""Unified observability: structured event bus + exporters.
+
+>>> from distributed_ghs_implementation_tpu.obs import BUS
+>>> with BUS.span("solver.solve", cat="solver", nodes=1000):
+...     ...
+>>> BUS.count("protocol.messages_sent", 42)
+>>> from distributed_ghs_implementation_tpu.obs.export import write_chrome_trace
+>>> write_chrome_trace(BUS, "/tmp/trace.json")  # open in ui.perfetto.dev
+
+See ``docs/OBSERVABILITY.md`` for the event taxonomy and workflows.
+"""
+
+from distributed_ghs_implementation_tpu.obs.events import (  # noqa: F401
+    BUS,
+    NULL_SPAN,
+    EventBus,
+    get_bus,
+)
+from distributed_ghs_implementation_tpu.obs.export import (  # noqa: F401
+    read_events_jsonl,
+    render_stats,
+    snapshot_from_jsonl,
+    to_chrome_trace,
+    write_chrome_trace,
+    write_events_jsonl,
+)

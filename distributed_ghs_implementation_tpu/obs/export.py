@@ -1,0 +1,239 @@
+"""Exporters for the event bus: Chrome-trace/Perfetto JSON, JSONL, stats.
+
+Three views of the same ring buffer:
+
+* :func:`write_chrome_trace` — the Chrome ``traceEvents`` JSON format, which
+  Perfetto (https://ui.perfetto.dev) and ``chrome://tracing`` both load
+  directly: spans become ``"X"`` slices that nest by time per thread track,
+  counters become ``"C"`` timeline tracks.
+* :func:`write_events_jsonl` — one JSON object per line (stream-appendable,
+  grep-able), with a trailing ``"M"`` metadata line carrying the counter
+  totals and histogram summaries so a log file is self-contained.
+* :func:`render_stats` — the plain-text summary behind the ``stats``
+  subcommand, computed from a live bus or a parsed JSONL file.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Dict, List, Optional, Tuple
+
+from distributed_ghs_implementation_tpu.obs.events import (
+    PH_COMPLETE,
+    PH_COUNTER,
+    PH_INSTANT,
+    EventBus,
+    aggregate_span_stats,
+)
+
+
+def _jsonable(value: Any) -> Any:
+    """Lazy serialization boundary: coerce arbitrary arg values to JSON."""
+    if value is None or isinstance(value, (bool, int, float, str)):
+        return value
+    if isinstance(value, dict):
+        return {str(k): _jsonable(v) for k, v in value.items()}
+    if isinstance(value, (list, tuple)):
+        return [_jsonable(v) for v in value]
+    try:  # numpy scalars expose item()
+        return value.item()
+    except AttributeError:
+        return repr(value)
+
+
+def _tid_map(events) -> Dict[int, int]:
+    """Stable small-int thread ids (raw idents are unreadable in a trace)."""
+    mapping: Dict[int, int] = {}
+    for rec in events:
+        mapping.setdefault(rec[5], len(mapping))
+    return mapping
+
+
+def chrome_trace_events(bus: EventBus) -> List[dict]:
+    """Bus records as Chrome ``traceEvents`` dicts (timestamps in µs)."""
+    events = bus.events()
+    tids = _tid_map(events)
+    pid = os.getpid()
+    out: List[dict] = []
+    for ph, name, cat, ts_ns, dur_ns, tid, args in events:
+        ev: Dict[str, Any] = {
+            "name": name,
+            "cat": cat,
+            "ph": ph,
+            "ts": ts_ns / 1000.0,
+            "pid": pid,
+            "tid": tids[tid],
+        }
+        if ph == PH_COMPLETE:
+            ev["dur"] = dur_ns / 1000.0
+        if ph == PH_COUNTER:
+            ev["args"] = {"value": _jsonable((args or {}).get("value", 0))}
+        elif args:
+            ev["args"] = _jsonable(args)
+        if ph == PH_INSTANT:
+            ev["s"] = "t"  # thread-scoped instant marker
+        out.append(ev)
+    # Counter totals as a final sample each, so every counter has a track
+    # even if no timeline samples were taken during the run.
+    end_ts = max((e["ts"] + e.get("dur", 0.0) for e in out), default=0.0)
+    for name, value in sorted(bus.counters().items()):
+        out.append(
+            {
+                "name": name,
+                "cat": "counter",
+                "ph": PH_COUNTER,
+                "ts": end_ts,
+                "pid": pid,
+                "tid": 0,
+                "args": {"value": _jsonable(value)},
+            }
+        )
+    return out
+
+
+def to_chrome_trace(bus: EventBus) -> dict:
+    return {
+        "traceEvents": chrome_trace_events(bus),
+        "displayTimeUnit": "ms",
+        "otherData": {
+            "generator": "distributed_ghs_implementation_tpu.obs",
+            "events_dropped": bus.dropped,
+        },
+    }
+
+
+def write_chrome_trace(bus: EventBus, path: str) -> str:
+    with open(path, "w") as f:
+        json.dump(to_chrome_trace(bus), f)
+        f.write("\n")
+    return path
+
+
+def write_events_jsonl(bus: EventBus, path: str) -> str:
+    """Events one-per-line + a trailing metadata line (counters/histograms)."""
+    with open(path, "w") as f:
+        for ph, name, cat, ts_ns, dur_ns, tid, args in bus.events():
+            rec = {
+                "ph": ph,
+                "name": name,
+                "cat": cat,
+                "ts_us": ts_ns / 1000.0,
+            }
+            if ph == PH_COMPLETE:
+                rec["dur_us"] = dur_ns / 1000.0
+            if args:
+                rec["args"] = _jsonable(args)
+            f.write(json.dumps(rec) + "\n")
+        f.write(
+            json.dumps(
+                {
+                    "ph": "M",
+                    "counters": _jsonable(bus.counters()),
+                    "histograms": _jsonable(bus.histograms()),
+                    "events_dropped": bus.dropped,
+                }
+            )
+            + "\n"
+        )
+    return path
+
+
+def read_events_jsonl(path: str) -> Tuple[List[dict], dict]:
+    """Parse a JSONL event log; returns ``(event_dicts, metadata)``."""
+    events: List[dict] = []
+    meta: dict = {}
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            rec = json.loads(line)
+            if rec.get("ph") == "M":
+                meta = rec
+            else:
+                events.append(rec)
+    return events, meta
+
+
+def snapshot_from_jsonl(path: str) -> dict:
+    """Rebuild a :meth:`EventBus.snapshot`-shaped dict from a JSONL log."""
+    events, meta = read_events_jsonl(path)
+    spans, instants = aggregate_span_stats(
+        (rec["ph"], rec["name"], rec.get("dur_us", 0.0) / 1e6) for rec in events
+    )
+    return {
+        "schema": "ghs-obs-snapshot-v1",
+        "spans": spans,
+        "instants": instants,
+        "counters": meta.get("counters", {}),
+        "histograms": meta.get("histograms", {}),
+        "events_retained": len(events),
+        "events_dropped": meta.get("events_dropped", 0),
+    }
+
+
+def _fmt_s(seconds: float) -> str:
+    if seconds >= 1.0:
+        return f"{seconds:8.3f}s "
+    if seconds >= 1e-3:
+        return f"{seconds * 1e3:8.3f}ms"
+    return f"{seconds * 1e6:8.1f}µs"
+
+
+def render_stats(snapshot: dict) -> str:
+    """Human-readable summary of a snapshot (live bus or JSONL-derived)."""
+    lines: List[str] = []
+    spans = snapshot.get("spans", {})
+    if spans:
+        lines.append("spans (by total time):")
+        lines.append(
+            f"  {'name':<32} {'count':>7} {'total':>10} {'mean':>10} {'max':>10}"
+        )
+        for name, agg in sorted(
+            spans.items(), key=lambda kv: -kv[1]["total_s"]
+        ):
+            lines.append(
+                f"  {name:<32} {agg['count']:>7} {_fmt_s(agg['total_s']):>10}"
+                f" {_fmt_s(agg['mean_s']):>10} {_fmt_s(agg['max_s']):>10}"
+            )
+    instants = snapshot.get("instants", {})
+    if instants:
+        lines.append("instants:")
+        for name, count in sorted(instants.items()):
+            lines.append(f"  {name:<32} {count:>7}")
+    counters = snapshot.get("counters", {})
+    if counters:
+        lines.append("counters:")
+        for name, value in sorted(counters.items()):
+            value = int(value) if float(value).is_integer() else value
+            lines.append(f"  {name:<40} {value:>12}")
+    hists = snapshot.get("histograms", {})
+    if hists:
+        lines.append("histograms:")
+        for name, h in sorted(hists.items()):
+            if not h.get("count"):
+                continue
+            lines.append(
+                f"  {name:<32} count={h['count']} mean={h['mean']:.2f} "
+                f"p50={h['p50']:.2f} p90={h['p90']:.2f} p99={h['p99']:.2f} "
+                f"max={h['max']:.2f}"
+            )
+    dropped = snapshot.get("events_dropped", 0)
+    lines.append(
+        f"events: {snapshot.get('events_retained', 0)} retained, "
+        f"{dropped} dropped (ring overflow)"
+    )
+    return "\n".join(lines)
+
+
+def save_snapshot(bus: EventBus, path: str) -> str:
+    with open(path, "w") as f:
+        json.dump(bus.snapshot(), f, indent=2)
+        f.write("\n")
+    return path
+
+
+def load_snapshot(path: str) -> Optional[dict]:
+    with open(path) as f:
+        return json.load(f)

@@ -47,7 +47,14 @@ def initialize(
         process_id = int(os.environ["JAX_PROCESS_ID"])
     if process_id is not None:
         kwargs["process_id"] = process_id
-    jax.distributed.initialize(**kwargs)
+    from distributed_ghs_implementation_tpu.obs.events import BUS
+
+    with BUS.span("parallel.multihost.initialize", cat="parallel") as span:
+        jax.distributed.initialize(**kwargs)
+        span.set(
+            process_index=jax.process_index(),
+            process_count=jax.process_count(),
+        )
     initialize._done = True
 
 
@@ -81,6 +88,15 @@ def broadcast_resume_state(state, error: bool = False):
 
     import numpy as np
     from jax.experimental import multihost_utils as mu
+
+    from distributed_ghs_implementation_tpu.obs.events import BUS
+
+    BUS.instant(
+        "parallel.multihost.broadcast_resume",
+        cat="parallel",
+        error=error,
+        has_state=state is not None,
+    )
 
     if jax.process_index() == 0 and (error or state is not None):
         if error:

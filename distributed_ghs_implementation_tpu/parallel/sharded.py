@@ -28,6 +28,7 @@ from distributed_ghs_implementation_tpu.models.boruvka import (
     _max_levels,
     boruvka_level,
 )
+from distributed_ghs_implementation_tpu.obs.events import BUS
 from distributed_ghs_implementation_tpu.parallel.mesh import (
     EDGE_AXIS,
     edge_mesh,
@@ -150,30 +151,36 @@ def solve_graph_sharded_ell(
     ra_np, rb_np = graph.rank_endpoints(pad_to=m_pad)
 
     int32_max = np.iinfo(np.int32).max
-    buckets = []
-    for verts, dstb, rankb in graph.ell_buckets:
-        vb, w = dstb.shape
-        vb_pad = int(math.ceil(vb / n_dev) * n_dev)
-        if vb_pad > vb:
-            pad = vb_pad - vb
-            verts = np.concatenate([verts, np.zeros(pad, dtype=np.int32)])
-            dstb = np.vstack([dstb, np.zeros((pad, w), dtype=np.int32)])
-            rankb = np.vstack([rankb, np.full((pad, w), int32_max, dtype=np.int32)])
-        row_sharding = NamedSharding(mesh, P(EDGE_AXIS, None))
-        vert_sharding = NamedSharding(mesh, P(EDGE_AXIS))
-        buckets.append(
-            (
-                _stage(verts, vert_sharding),
-                _stage(dstb, row_sharding),
-                _stage(rankb, row_sharding),
+    with BUS.span("parallel.stage", cat="parallel", strategy="ell", devices=n_dev):
+        buckets = []
+        for verts, dstb, rankb in graph.ell_buckets:
+            vb, w = dstb.shape
+            vb_pad = int(math.ceil(vb / n_dev) * n_dev)
+            if vb_pad > vb:
+                pad = vb_pad - vb
+                verts = np.concatenate([verts, np.zeros(pad, dtype=np.int32)])
+                dstb = np.vstack([dstb, np.zeros((pad, w), dtype=np.int32)])
+                rankb = np.vstack(
+                    [rankb, np.full((pad, w), int32_max, dtype=np.int32)]
+                )
+            row_sharding = NamedSharding(mesh, P(EDGE_AXIS, None))
+            vert_sharding = NamedSharding(mesh, P(EDGE_AXIS))
+            buckets.append(
+                (
+                    _stage(verts, vert_sharding),
+                    _stage(dstb, row_sharding),
+                    _stage(rankb, row_sharding),
+                )
             )
-        )
-    rep = NamedSharding(mesh, P())
-    ra = _stage(ra_np, rep)
-    rb = _stage(rb_np, rep)
+        rep = NamedSharding(mesh, P())
+        ra = _stage(ra_np, rep)
+        rb = _stage(rb_np, rep)
 
     solver = make_sharded_ell_solver(mesh, n_pad)
-    mst_ranks, fragment, levels = solver(tuple(buckets), ra, rb)
+    with BUS.span(
+        "parallel.sharded.solve", cat="parallel", strategy="ell", devices=n_dev
+    ):
+        mst_ranks, fragment, levels = solver(tuple(buckets), ra, rb)
     ranks = np.nonzero(np.asarray(mst_ranks))[0]
     edge_ids = np.sort(graph.edge_id_of_rank(ranks))
     return edge_ids, np.asarray(fragment)[:n], int(levels)
@@ -242,13 +249,21 @@ def solve_graph_sharded(
     )
 
     solver = make_sharded_solver(mesh, n_pad)
-    edge_sharding = NamedSharding(mesh, P(EDGE_AXIS))
-    src = _stage(src_np, edge_sharding)
-    dst = _stage(dst_np, edge_sharding)
-    rank = _stage(rank_np, edge_sharding)
-    ra = _stage(ra_np, edge_sharding)
-    rb = _stage(rb_np, edge_sharding)
-    mst_ranks, fragment, levels = solver(src, dst, rank, ra, rb)
+    n_dev_i = int(n_dev)
+    with BUS.span(
+        "parallel.stage", cat="parallel", strategy="flat", devices=n_dev_i
+    ):
+        edge_sharding = NamedSharding(mesh, P(EDGE_AXIS))
+        src = _stage(src_np, edge_sharding)
+        dst = _stage(dst_np, edge_sharding)
+        rank = _stage(rank_np, edge_sharding)
+        ra = _stage(ra_np, edge_sharding)
+        rb = _stage(rb_np, edge_sharding)
+    with BUS.span(
+        "parallel.sharded.solve", cat="parallel", strategy="flat",
+        devices=n_dev_i,
+    ):
+        mst_ranks, fragment, levels = solver(src, dst, rank, ra, rb)
     ranks = np.nonzero(np.asarray(mst_ranks))[0]
     edge_ids = np.sort(graph.edge_id_of_rank(ranks))
     return edge_ids, np.asarray(fragment)[:n], int(levels)

@@ -36,6 +36,7 @@ import heapq
 import random
 from typing import Dict, Tuple
 
+from distributed_ghs_implementation_tpu.obs.events import BUS
 from distributed_ghs_implementation_tpu.protocol.messages import Message
 from distributed_ghs_implementation_tpu.protocol.transport import SimTransport
 
@@ -106,6 +107,17 @@ class FaultyTransport(SimTransport):
         base = self.now + max(1, self._latency(src, dst))
         for when in self._delivery_times(base):
             heapq.heappush(self._queue, (when, next(self._seq), dst, msg))
+
+    def _bus_counters(self) -> Dict[str, int]:
+        counters = super()._bus_counters()
+        counters.update(
+            {
+                "protocol.drops_injected": self.dropped,
+                "protocol.duplicates_injected": self.duplicated,
+                "protocol.reorders_injected": self.jittered,
+            }
+        )
+        return counters
 
 
 # Wire/loop items for ReliableTransport. DATA and ACK cross the lossy
@@ -184,6 +196,11 @@ class ReliableTransport(FaultyTransport):
         self.retransmits = 0
         self.acks_sent = 0
         self.dup_suppressed = 0
+        # Ack latency (sim ticks, first send -> first ack per sequence).
+        self._sent_at: Dict[Tuple[Tuple[int, int], int], int] = {}
+        self.ack_latency_count = 0
+        self.ack_latency_sum = 0
+        self.ack_latency_max = 0
 
     # ------------------------------------------------------------------
     def _push(self, when: int, target: int, item) -> None:
@@ -201,32 +218,38 @@ class ReliableTransport(FaultyTransport):
         seq_no = self._next_seq.get(link, 0)
         self._next_seq[link] = seq_no + 1
         self._unacked.setdefault(link, {})[seq_no] = msg
+        self._sent_at[(link, seq_no)] = self.now
         self._transmit(src, dst, _Data(src, seq_no, msg))
         self._push(self.now + self._rto, src, _Timer(dst, seq_no, 1))
 
     # ------------------------------------------------------------------
-    def run(self, nodes) -> int:
-        processed = 0
-        iterations = 0
-        while self._queue:
-            iterations += 1
-            if iterations >= self._max_events:
-                raise RuntimeError(
-                    f"protocol did not quiesce within {self._max_events} events"
-                )
-            when, _, target, item = heapq.heappop(self._queue)
-            self.now = max(self.now, when)
-            if isinstance(item, _Data):
-                processed += self._on_data(nodes, target, item)
-            elif isinstance(item, _Ack):
-                self._unacked.get((target, item.src), {}).pop(item.seq_no, None)
-            elif isinstance(item, _Timer):
-                self._on_timer(target, item)
-            elif isinstance(item, _Local):
-                processed += self._deliver(nodes, target, item.payload)
-            else:  # a raw Message cannot appear: send() always wraps
-                raise AssertionError(f"unexpected event item {item!r}")
-        return processed
+    def _dispatch(self, nodes, target: int, item) -> int:
+        """The reliable layer's event vocabulary, under the shared run loop."""
+        if isinstance(item, _Data):
+            return self._on_data(nodes, target, item)
+        if isinstance(item, _Ack):
+            self._on_ack(target, item)
+            return 0
+        if isinstance(item, _Timer):
+            self._on_timer(target, item)
+            return 0
+        if isinstance(item, _Local):
+            return self._deliver(nodes, target, item.payload)
+        # A raw Message cannot appear: send() always wraps.
+        raise AssertionError(f"unexpected event item {item!r}")
+
+    def _on_ack(self, owner: int, ack: "_Ack") -> None:
+        link = (owner, ack.src)
+        if self._unacked.get(link, {}).pop(ack.seq_no, None) is None:
+            return  # duplicate ack: already settled
+        sent = self._sent_at.pop((link, ack.seq_no), None)
+        if sent is not None:
+            latency = self.now - sent
+            self.ack_latency_count += 1
+            self.ack_latency_sum += latency
+            if latency > self.ack_latency_max:
+                self.ack_latency_max = latency
+            BUS.record("protocol.ack_latency_ticks", latency)
 
     def _on_data(self, nodes, dst: int, data: _Data) -> int:
         link = (data.src, dst)
@@ -271,6 +294,17 @@ class ReliableTransport(FaultyTransport):
             self.now + backoff, owner, _Timer(timer.dst, timer.seq_no, timer.attempt + 1)
         )
 
+    def _bus_counters(self) -> Dict[str, int]:
+        counters = super()._bus_counters()
+        counters.update(
+            {
+                "protocol.retransmits": self.retransmits,
+                "protocol.acks_sent": self.acks_sent,
+                "protocol.dup_suppressed": self.dup_suppressed,
+            }
+        )
+        return counters
+
     @property
     def stats(self) -> dict:
         """Channel + reliability counters, for reports and assertions."""
@@ -283,4 +317,13 @@ class ReliableTransport(FaultyTransport):
             "retransmits": self.retransmits,
             "acks_sent": self.acks_sent,
             "dup_suppressed": self.dup_suppressed,
+            "ack_latency_ticks": {
+                "count": self.ack_latency_count,
+                "mean": (
+                    self.ack_latency_sum / self.ack_latency_count
+                    if self.ack_latency_count
+                    else 0.0
+                ),
+                "max": self.ack_latency_max,
+            },
         }

@@ -277,6 +277,37 @@ def _checkpoint_cases(fast: bool, workdir: Optional[str] = None) -> List[dict]:
     return cases
 
 
+def _channel_totals(cases: List[dict]) -> dict:
+    """Aggregate the reliable sublayer's counters across protocol cases:
+    what the lossy channel did (drops/duplicates/reorders injected) and
+    what reliability cost (retransmits, acks, duplicates suppressed, worst
+    ack latency) — the one-glance health line of the drill."""
+    totals = {
+        "messages_sent": 0,
+        "dropped": 0,
+        "duplicated": 0,
+        "jittered": 0,
+        "retransmits": 0,
+        "acks_sent": 0,
+        "dup_suppressed": 0,
+        "ack_latency_max_ticks": 0,
+    }
+    for case in cases:
+        stats = case.get("stats")
+        if not stats:
+            continue
+        for key in (
+            "messages_sent", "dropped", "duplicated", "jittered",
+            "retransmits", "acks_sent", "dup_suppressed",
+        ):
+            totals[key] += stats.get(key, 0)
+        latency = stats.get("ack_latency_ticks") or {}
+        totals["ack_latency_max_ticks"] = max(
+            totals["ack_latency_max_ticks"], latency.get("max", 0)
+        )
+    return totals
+
+
 def run_chaos_drill(
     fast: bool = True, include_solver: bool = True, workdir: Optional[str] = None
 ) -> dict:
@@ -290,6 +321,7 @@ def run_chaos_drill(
         "fast": fast,
         "num_cases": len(cases),
         "num_failed": sum(not c["ok"] for c in cases),
+        "channel_totals": _channel_totals(cases),
         "cases": cases,
         "ok": all(c["ok"] for c in cases),
     }

@@ -1,11 +1,17 @@
-"""Per-level structured metrics and profiler hooks.
+"""Per-level metrics as a compatibility view over the event bus.
 
-The reference's observability is print-narration (per-message logs at
-``/root/reference/ghs_implementation_mpi.py:100-113``, heartbeats ``:728-734``)
-— unusable at scale and absent on the thread backend. The TPU equivalent
-(SURVEY.md §5): structured per-level records (fragments remaining, edges
-alive, level latency) from the host-stepped solver, plus a context manager
-around ``jax.profiler`` for device traces viewable in TensorBoard/Perfetto.
+Historically this module kept its own private timing; it now routes every
+observation through ``obs.events`` (the unified bus behind ``trace``/
+``stats`` and the bench gate) and keeps :class:`SolveMetrics` /
+:class:`LevelMetrics` only as a thin read-back view so existing callers and
+tests are unaffected. Each instrumented level lands on the bus as a
+``metrics.level`` span-event carrying the fragment census
+(``fragments_before/after``, ``edges_alive``); the dataclasses below are
+reconstructed from exactly those events after the solve.
+
+When the global bus is disabled (``GHS_OBS=0``) a private single-use bus
+collects the same events, so the compatibility API keeps working without
+re-enabling process-wide telemetry.
 """
 
 from __future__ import annotations
@@ -17,6 +23,8 @@ import time
 from typing import List
 
 import numpy as np
+
+from distributed_ghs_implementation_tpu.obs.events import BUS, EventBus
 
 
 @dataclasses.dataclass
@@ -37,6 +45,53 @@ class SolveMetrics:
 
     def to_json(self) -> str:
         return json.dumps(dataclasses.asdict(self), indent=2)
+
+
+def _metrics_bus() -> EventBus:
+    """The global bus when it's on; otherwise a private single-solve bus
+    (the compat API must work even with process telemetry disabled)."""
+    return BUS if BUS.enabled else EventBus(capacity=8192)
+
+
+def _levels_from_bus(bus: EventBus, mark: int) -> List[LevelMetrics]:
+    """Reconstruct the compatibility records from ``metrics.level`` events."""
+    records = []
+    for rec in bus.events_since(mark):
+        if rec[0] != "X" or rec[1] != "metrics.level":
+            continue
+        args = rec[6] or {}
+        records.append(
+            LevelMetrics(
+                level=args["level"],
+                fragments_before=args["fragments_before"],
+                fragments_after=args["fragments_after"],
+                edges_alive_after=args["edges_alive"],
+                wall_time_s=rec[4] / 1e9,
+            )
+        )
+    return records
+
+
+def _level_emitter(bus: EventBus, num_nodes: int):
+    """Build the shared per-level hook body: census the fragment array and
+    emit one ``metrics.level`` event. Returns ``emit(level, fragment,
+    edges_alive, dt)``."""
+    frags_before = [num_nodes]
+
+    def emit(level: int, fragment, edges_alive: int, dt: float) -> None:
+        frags_after = int(np.unique(np.asarray(fragment)[:num_nodes]).size)
+        bus.complete(
+            "metrics.level",
+            dt,
+            cat="metrics",
+            level=int(level),
+            fragments_before=frags_before[0],
+            fragments_after=frags_after,
+            edges_alive=int(edges_alive),
+        )
+        frags_before[0] = frags_after
+
+    return emit
 
 
 def solve_graph_instrumented(
@@ -65,35 +120,29 @@ def solve_graph_instrumented(
         solve_arrays_stepped,
     )
 
+    bus = _metrics_bus()
+    mark = bus.mark()
     args = prepare_device_arrays(graph)
-    records: List[LevelMetrics] = []
-    frags_before = [n]
+    emit = _level_emitter(bus, n)
 
     def on_level(level, fragment, mst_ranks, has, count, dt):
-        frags_after = int(np.unique(np.asarray(fragment)[:n]).size)
-        records.append(
-            LevelMetrics(
-                level=level,
-                fragments_before=frags_before[0],
-                fragments_after=frags_after,
-                # The stepped kernel counts surviving *directed slots*; each
-                # undirected edge occupies two, so halve for the edge count.
-                edges_alive_after=count // 2,
-                wall_time_s=dt,
-            )
-        )
-        frags_before[0] = frags_after
+        # The stepped kernel counts surviving *directed slots*; each
+        # undirected edge occupies two, so halve for the edge count.
+        emit(level, fragment, count // 2, dt)
 
     t_start = time.perf_counter()
-    mst_ranks, fragment, levels = solve_arrays_stepped(
-        *args, compact=compact, stepped_levels=None, on_level=on_level
-    )
+    with bus.span("metrics.solve", cat="metrics", strategy="stepped", nodes=n):
+        mst_ranks, fragment, levels = solve_arrays_stepped(
+            *args, compact=compact, stepped_levels=None, on_level=on_level
+        )
     total = time.perf_counter() - t_start
 
     ranks_chosen = np.nonzero(np.asarray(mst_ranks))[0]
     edge_ids = np.sort(graph.edge_id_of_rank(ranks_chosen))
     result = (edge_ids, np.asarray(fragment)[:n], levels)
-    return result, SolveMetrics(n, graph.num_edges, records, total)
+    return result, SolveMetrics(
+        n, graph.num_edges, _levels_from_bus(bus, mark), total
+    )
 
 
 def _solve_rank_instrumented(graph) -> tuple:
@@ -104,23 +153,14 @@ def _solve_rank_instrumented(graph) -> tuple:
     )
 
     n = graph.num_nodes
-    records = []
-    frags_before = [n]
+    bus = _metrics_bus()
+    mark = bus.mark()
+    emit = _level_emitter(bus, n)
     last = [time.perf_counter()]
 
     def on_chunk(level, fragment, mst_ranks, count):
         now = time.perf_counter()
-        frags_after = int(np.unique(np.asarray(fragment)[:n]).size)
-        records.append(
-            LevelMetrics(
-                level=level,
-                fragments_before=frags_before[0],
-                fragments_after=frags_after,
-                edges_alive_after=count,
-                wall_time_s=now - last[0],
-            )
-        )
-        frags_before[0] = frags_after
+        emit(level, fragment, count, now - last[0])
         last[0] = now
 
     # make_production_solver is the single routing source shared with
@@ -128,15 +168,18 @@ def _solve_rank_instrumented(graph) -> tuple:
     # production runs (passing on_chunk selects the chunked forms — the
     # speculative single-dispatch variant has no boundaries to instrument).
     solve = make_production_solver(graph)
-    last[0] = time.perf_counter()
-    t_start = last[0]
-    mst_ranks, fragment, levels = solve(on_chunk=on_chunk)
-    total = time.perf_counter() - t_start
+    with bus.span("metrics.solve", cat="metrics", strategy="rank", nodes=n):
+        last[0] = time.perf_counter()
+        t_start = last[0]
+        mst_ranks, fragment, levels = solve(on_chunk=on_chunk)
+        total = time.perf_counter() - t_start
 
     ranks_chosen = np.nonzero(np.asarray(mst_ranks))[0]
     edge_ids = np.sort(graph.edge_id_of_rank(ranks_chosen))
     result = (edge_ids, np.asarray(fragment)[:n], levels)
-    return result, SolveMetrics(n, graph.num_edges, records, total)
+    return result, SolveMetrics(
+        n, graph.num_edges, _levels_from_bus(bus, mark), total
+    )
 
 
 @contextlib.contextmanager
@@ -145,6 +188,9 @@ def profiler_trace(log_dir: str):
 
     >>> with profiler_trace("/tmp/ghs-trace"):
     ...     minimum_spanning_forest(graph)
+
+    This is the *device-side* (XLA op) view; the host-side structured trace
+    is ``python -m distributed_ghs_implementation_tpu trace``.
     """
     import jax
 

@@ -35,6 +35,7 @@ from typing import Dict, List, Optional, Tuple
 import numpy as np
 
 from distributed_ghs_implementation_tpu.graphs.edgelist import Graph
+from distributed_ghs_implementation_tpu.obs.events import BUS
 
 
 # ----------------------------------------------------------------------
@@ -218,10 +219,20 @@ class Incident:
     error: Optional[str] = None
     elapsed_s: float = 0.0
     backoff_s: float = 0.0
+    # The fault-registry site implicated in a failed attempt (the attempt
+    # site for transient/fatal errors, the slow site for timeouts); None on
+    # success/unavailable. Structured so dashboards and tests can key on it.
+    site: Optional[str] = None
 
 
 class IncidentLog:
-    """Structured record of every supervised attempt, in order."""
+    """Structured record of every supervised attempt, in order.
+
+    Every record is mirrored onto the event bus as a ``resilience.attempt``
+    span-event (duration = the attempt's elapsed time), so traces show the
+    retry/degrade ladder inline with solver and protocol activity — the
+    structured replacement for grepping formatted attempt strings.
+    """
 
     def __init__(self):
         self.records: List[Incident] = []
@@ -229,6 +240,17 @@ class IncidentLog:
     def add(self, **kwargs) -> Incident:
         rec = Incident(**kwargs)
         self.records.append(rec)
+        BUS.complete(
+            "resilience.attempt",
+            rec.elapsed_s,
+            cat="resilience",
+            rung=rec.rung,
+            attempt=rec.attempt,
+            outcome=rec.outcome,
+            error=rec.error,
+            backoff_s=rec.backoff_s,
+            site=rec.site,
+        )
         return rec
 
     @property
@@ -395,10 +417,24 @@ class Supervisor:
             start = ladder.index("device")
         else:
             start = 0
-        for rung in ladder[start:]:
-            outcome = self._attempt_rung(rung, graph, log)
-            if outcome is not None:
-                return outcome + (log,)
+        with BUS.span(
+            "resilience.solve", cat="resilience", entry=ladder[start],
+            nodes=graph.num_nodes, edges=graph.num_edges,
+        ) as span:
+            remaining = ladder[start:]
+            for i, rung in enumerate(remaining):
+                outcome = self._attempt_rung(rung, graph, log)
+                if outcome is not None:
+                    span.set(final_rung=rung, attempts=len(log))
+                    return outcome + (log,)
+                if i + 1 < len(remaining):
+                    BUS.instant(
+                        "resilience.degrade",
+                        cat="resilience",
+                        from_rung=rung,
+                        to_rung=remaining[i + 1],
+                    )
+            span.set(final_rung=None, attempts=len(log))
         raise SupervisorExhausted(
             f"every rung failed: {log.summary()}", log
         )
@@ -456,6 +492,7 @@ class Supervisor:
                         outcome="fatal",
                         error=repr(e),
                         elapsed_s=elapsed,
+                        site=f"resilience.attempt.{rung}",
                     )
                     raise
                 retrying = attempt <= cfg.retries_per_rung
@@ -465,13 +502,19 @@ class Supervisor:
                         cfg.backoff_base_s * (2 ** (attempt - 1)),
                         cfg.backoff_cap_s,
                     )
+                timed_out = isinstance(e, WatchdogTimeout)
                 log.add(
                     rung=rung,
                     attempt=attempt,
-                    outcome="timeout" if isinstance(e, WatchdogTimeout) else "transient",
+                    outcome="timeout" if timed_out else "transient",
                     error=repr(e),
                     elapsed_s=elapsed,
                     backoff_s=backoff,
+                    site=(
+                        f"resilience.slow.{rung}"
+                        if timed_out
+                        else f"resilience.attempt.{rung}"
+                    ),
                 )
                 if retrying and backoff > 0:
                     self._sleep(backoff)

@@ -137,6 +137,23 @@ def test_protocol_parity_simple_fixture_all_fault_kinds():
         assert float(edge_ids.shape[0]) == expected
 
 
+def test_reliable_stats_report_ack_latency():
+    """The reliable sublayer measures first-send -> first-ack latency in sim
+    ticks: bounded below by one round trip, inflated by drops (a retransmit
+    must age the sample past the RTO)."""
+    clean = ReliableTransport(FaultSpec())
+    _blast(clean)
+    lat = clean.stats["ack_latency_ticks"]
+    assert lat["count"] == 200
+    assert lat["mean"] == lat["max"] == 2  # symmetric 1-tick links: RTT 2
+
+    lossy = ReliableTransport(FaultSpec(drop=0.3, seed=21))
+    _blast(lossy)
+    lossy_lat = lossy.stats["ack_latency_ticks"]
+    assert lossy_lat["count"] == 200  # reliability: every send eventually acks
+    assert lossy_lat["max"] >= 8  # a dropped DATA waits out at least one RTO
+
+
 def test_reliable_runs_are_replayable():
     """(graph, spec) fully determines the run — stats and result identical."""
     g = erdos_renyi_graph(30, 0.15, seed=2)

@@ -1,0 +1,496 @@
+"""Unified observability layer: event bus, exporters, bench gate, CLI.
+
+The coverage contract from the issue: span nesting, counter/histogram
+aggregation, ring-buffer overflow, the disabled-mode zero-allocation path,
+and a Chrome-trace export round-trip (valid JSON loadable as a trace) —
+plus the bench gate's pass/fail behavior against a committed baseline and
+the ``trace``/``stats`` CLI surface.
+"""
+
+import json
+import os
+import sys
+
+import pytest
+
+from distributed_ghs_implementation_tpu.obs.events import (
+    BUS,
+    NULL_SPAN,
+    EventBus,
+)
+from distributed_ghs_implementation_tpu.obs.export import (
+    read_events_jsonl,
+    render_stats,
+    snapshot_from_jsonl,
+    write_chrome_trace,
+    write_events_jsonl,
+)
+
+
+@pytest.fixture(autouse=True)
+def _clean_global_bus():
+    """Every test sees an enabled, empty global bus and leaves it that way
+    (the default state: production telemetry is on)."""
+    BUS.enable()
+    BUS.clear()
+    yield
+    BUS.enable()
+    BUS.clear()
+
+
+# ----------------------------------------------------------------------
+# Event bus core
+# ----------------------------------------------------------------------
+def test_span_records_complete_event_with_args():
+    bus = EventBus(capacity=64)
+    with bus.span("outer", cat="test", a=1) as span:
+        span.set(b=2)
+    (rec,) = bus.events()
+    ph, name, cat, ts_ns, dur_ns, _tid, args = rec
+    assert (ph, name, cat) == ("X", "outer", "test")
+    assert dur_ns >= 0 and ts_ns >= 0
+    assert args == {"a": 1, "b": 2}
+
+
+def test_span_nesting_timestamps_contain_inner():
+    bus = EventBus(capacity=64)
+    with bus.span("outer"):
+        with bus.span("inner"):
+            pass
+    events = {rec[1]: rec for rec in bus.events()}
+    assert set(events) == {"outer", "inner"}
+    # Exit order: inner lands first.
+    assert [rec[1] for rec in bus.events()] == ["inner", "outer"]
+    o, i = events["outer"], events["inner"]
+    assert o[3] <= i[3]  # inner starts within outer
+    assert i[3] + i[4] <= o[3] + o[4]  # and ends within it
+
+
+def test_counter_and_histogram_aggregation():
+    bus = EventBus(capacity=64)
+    bus.count("msgs", 3)
+    bus.count("msgs", 4)
+    bus.count("other")
+    for v in [1.0, 2.0, 3.0, 10.0]:
+        bus.record("latency", v)
+    assert bus.counters() == {"msgs": 7, "other": 1}
+    h = bus.histograms()["latency"]
+    assert h["count"] == 4
+    assert h["sum"] == 16.0
+    assert h["min"] == 1.0 and h["max"] == 10.0
+    assert h["p50"] in (2.0, 3.0)
+
+
+def test_ring_buffer_overflow_drops_oldest_keeps_totals():
+    bus = EventBus(capacity=8)
+    for i in range(20):
+        bus.instant(f"e{i}")
+        bus.count("total")
+    events = bus.events()
+    assert len(events) == 8
+    assert [rec[1] for rec in events] == [f"e{i}" for i in range(12, 20)]
+    assert bus.dropped == 12
+    assert bus.counters()["total"] == 20  # aggregates survive overflow
+    snap = bus.snapshot()
+    assert snap["events_dropped"] == 12 and snap["events_retained"] == 8
+
+
+def test_events_since_mark():
+    bus = EventBus(capacity=64)
+    bus.instant("before")
+    mark = bus.mark()
+    bus.instant("after")
+    assert [rec[1] for rec in bus.events_since(mark)] == ["after"]
+
+
+def test_disabled_mode_is_allocation_free_noop():
+    bus = EventBus(capacity=64, enabled=False)
+    # The span handle is the shared module-level singleton: nothing is
+    # allocated per call on the disabled path.
+    assert bus.span("a") is NULL_SPAN
+    assert bus.span("b", x=1) is NULL_SPAN
+    with bus.span("c") as s:
+        s.set(y=2)  # no-op, chainable
+    bus.instant("i")
+    bus.count("c", 5)
+    bus.record("h", 1.0)
+    bus.complete("x", 0.5)
+    bus.sample("s", 3)
+    assert bus.events() == []
+    assert bus.counters() == {}
+    assert bus.histograms() == {}
+    # Re-enabling starts recording without any reconstruction.
+    bus.enable()
+    bus.instant("live")
+    assert [rec[1] for rec in bus.events()] == ["live"]
+
+
+def test_complete_event_explicit_duration():
+    bus = EventBus(capacity=64)
+    bus.complete("k", 0.25, cat="solver", level=3)
+    (rec,) = bus.events()
+    assert rec[1] == "k" and abs(rec[4] - 0.25e9) < 1e6
+    assert rec[6] == {"level": 3}
+
+
+def test_bad_capacity_rejected():
+    with pytest.raises(ValueError, match="capacity"):
+        EventBus(capacity=0)
+
+
+# ----------------------------------------------------------------------
+# Exporters
+# ----------------------------------------------------------------------
+def _populate(bus):
+    with bus.span("solve", cat="solver", nodes=10):
+        with bus.span("level", cat="solver"):
+            pass
+    bus.instant("degrade", cat="resilience", from_rung="device")
+    bus.count("protocol.messages_sent", 42)
+    bus.sample("protocol.messages_sent", 17)
+    bus.record("ack_latency", 3.0)
+    bus.record("ack_latency", 5.0)
+
+
+def test_chrome_trace_round_trip(tmp_path):
+    bus = EventBus(capacity=64)
+    _populate(bus)
+    path = str(tmp_path / "trace.json")
+    write_chrome_trace(bus, path)
+    with open(path) as f:
+        trace = json.load(f)  # valid JSON — loadable as a trace
+    events = trace["traceEvents"]
+    assert isinstance(events, list) and events
+    for ev in events:
+        assert {"name", "ph", "ts", "pid", "tid"} <= set(ev)
+        assert ev["ph"] in ("X", "I", "C")
+    by_name = {}
+    for ev in events:
+        by_name.setdefault(ev["name"], []).append(ev)
+    assert by_name["solve"][0]["dur"] >= by_name["level"][0]["dur"]
+    assert by_name["solve"][0]["args"] == {"nodes": 10}
+    # Counters appear as "C" track events with the final total.
+    counter_values = [
+        ev["args"]["value"]
+        for ev in by_name["protocol.messages_sent"]
+        if ev["ph"] == "C"
+    ]
+    assert 42 in counter_values  # final total sample
+    assert trace["otherData"]["events_dropped"] == 0
+
+
+def test_jsonl_round_trip_and_stats(tmp_path):
+    bus = EventBus(capacity=64)
+    _populate(bus)
+    path = str(tmp_path / "events.jsonl")
+    write_events_jsonl(bus, path)
+    events, meta = read_events_jsonl(path)
+    assert {e["name"] for e in events} >= {"solve", "level", "degrade"}
+    assert meta["counters"]["protocol.messages_sent"] == 42
+    assert meta["histograms"]["ack_latency"]["count"] == 2
+
+    snap = snapshot_from_jsonl(path)
+    assert snap["spans"]["solve"]["count"] == 1
+    assert snap["instants"]["degrade"] == 1
+    text = render_stats(snap)
+    assert "solve" in text and "protocol.messages_sent" in text
+    assert "ack_latency" in text
+
+    # The live-bus snapshot renders the same names.
+    live = render_stats(bus.snapshot())
+    assert "solve" in live and "degrade" in live
+
+
+# ----------------------------------------------------------------------
+# Layer instrumentation lands on the global bus
+# ----------------------------------------------------------------------
+def test_solver_emits_solve_span_and_level_events():
+    from distributed_ghs_implementation_tpu.graphs.generators import (
+        erdos_renyi_graph,
+    )
+    from distributed_ghs_implementation_tpu.models.boruvka import solve_graph
+
+    g = erdos_renyi_graph(60, 0.1, seed=3)
+    solve_graph(g, strategy="stepped")
+    names = [rec[1] for rec in BUS.events()]
+    assert "solver.solve" in names
+    assert names.count("solver.level") >= 1
+    level_args = [
+        rec[6] for rec in BUS.events() if rec[1] == "solver.level"
+    ]
+    assert all("edges_alive" in a and "level" in a for a in level_args)
+
+
+def test_protocol_transport_publishes_counters():
+    from distributed_ghs_implementation_tpu.graphs.generators import line_graph
+    from distributed_ghs_implementation_tpu.protocol.runner import (
+        solve_graph_protocol,
+    )
+
+    solve_graph_protocol(line_graph(12))
+    counters = BUS.counters()
+    assert counters["protocol.messages_sent"] > 0
+    names = [rec[1] for rec in BUS.events()]
+    assert "protocol.run" in names
+
+
+def test_repeated_runs_publish_counter_deltas_once():
+    """Driving run() twice on one transport publishes each message to the
+    bus exactly once (delta-based publishing, not lifetime totals)."""
+    from distributed_ghs_implementation_tpu.protocol.messages import (
+        Message,
+        MessageType,
+    )
+    from distributed_ghs_implementation_tpu.protocol.transport import SimTransport
+
+    class _Sink:
+        def handle(self, msg):
+            return True
+
+    t = SimTransport()
+    nodes = {0: _Sink(), 1: _Sink()}
+    for i in range(5):
+        t.send(0, 1, Message(MessageType.TEST, sender=0, fragment=i))
+    t.run(nodes)
+    for i in range(3):
+        t.send(1, 0, Message(MessageType.TEST, sender=1, fragment=i))
+    t.run(nodes)
+    assert t.messages_sent == 8
+    assert BUS.counters()["protocol.messages_sent"] == 8
+
+
+def test_reliable_transport_counters_and_ack_latency():
+    from distributed_ghs_implementation_tpu.graphs.generators import (
+        erdos_renyi_graph,
+    )
+    from distributed_ghs_implementation_tpu.protocol.faults import (
+        FaultSpec,
+        ReliableTransport,
+    )
+    from distributed_ghs_implementation_tpu.protocol.runner import (
+        solve_graph_protocol,
+    )
+
+    t = ReliableTransport(FaultSpec(drop=0.2, duplicate=0.1, reorder=0.3, seed=7))
+    solve_graph_protocol(erdos_renyi_graph(30, 0.15, seed=2), transport=t)
+    counters = BUS.counters()
+    assert counters["protocol.drops_injected"] == t.dropped > 0
+    assert counters["protocol.retransmits"] == t.retransmits > 0
+    assert counters["protocol.dup_suppressed"] == t.dup_suppressed
+    lat = BUS.histograms()["protocol.ack_latency_ticks"]
+    assert lat["count"] == t.ack_latency_count > 0
+    assert lat["max"] == t.stats["ack_latency_ticks"]["max"]
+
+
+def test_metrics_compat_view_reads_back_from_bus():
+    from distributed_ghs_implementation_tpu.graphs.generators import (
+        erdos_renyi_graph,
+    )
+    from distributed_ghs_implementation_tpu.models.boruvka import solve_graph
+    from distributed_ghs_implementation_tpu.utils.metrics import (
+        solve_graph_instrumented,
+    )
+
+    g = erdos_renyi_graph(60, 0.1, seed=4)
+    (ids, frag, lv), metrics = solve_graph_instrumented(g)
+    assert list(ids) == list(solve_graph(g)[0])
+    assert metrics.num_nodes == 60
+    assert len(metrics.levels) == lv
+    assert metrics.levels[0].fragments_before == 60
+    for a, b in zip(metrics.levels, metrics.levels[1:]):
+        assert b.fragments_before == a.fragments_after
+    # The same observations exist as metrics.level events on the bus.
+    bus_levels = [rec for rec in BUS.events() if rec[1] == "metrics.level"]
+    assert len(bus_levels) == len(metrics.levels)
+    assert bus_levels[0][6]["fragments_after"] == metrics.levels[0].fragments_after
+
+
+def test_metrics_compat_works_with_global_bus_disabled():
+    from distributed_ghs_implementation_tpu.graphs.generators import (
+        erdos_renyi_graph,
+    )
+    from distributed_ghs_implementation_tpu.utils.metrics import (
+        solve_graph_instrumented,
+    )
+
+    BUS.disable()
+    g = erdos_renyi_graph(40, 0.15, seed=5)
+    (_ids, _frag, lv), metrics = solve_graph_instrumented(g)
+    assert len(metrics.levels) == lv >= 1
+    assert BUS.events() == []  # nothing leaked onto the disabled global bus
+
+
+# ----------------------------------------------------------------------
+# Bench gate
+# ----------------------------------------------------------------------
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "tools"))
+
+
+def _gate():
+    import bench_gate
+
+    return bench_gate
+
+
+def _metrics_doc(**overrides):
+    metrics = {
+        "device_solve_s": 1.0,
+        "device_levels": 6,
+        "mst_weight": 8291,
+        "protocol_messages_sent": 1000,
+        "edges_per_sec": 500.0,
+    }
+    metrics.update(overrides)
+    return {"schema": "ghs-bench-metrics-v1", "config": {"workload": "t"},
+            "metrics": metrics}
+
+
+def test_gate_passes_identical_and_improved():
+    gate = _gate()
+    base = _metrics_doc()
+    ok, _ = gate.compare(base, _metrics_doc())
+    assert ok
+    better = _metrics_doc(
+        device_solve_s=0.5, protocol_messages_sent=900, edges_per_sec=800.0
+    )
+    ok, lines = gate.compare(base, better)
+    assert ok, lines
+
+
+def test_gate_fails_each_regression_class():
+    gate = _gate()
+    base = _metrics_doc()
+    # Wall-time past tolerance.
+    ok, lines = gate.compare(base, _metrics_doc(device_solve_s=1.6))
+    assert not ok and any("device_solve_s" in ln and "FAIL" in ln for ln in lines)
+    # Message-count regression past the tight count tolerance.
+    ok, lines = gate.compare(base, _metrics_doc(protocol_messages_sent=1100))
+    assert not ok and any("protocol_messages_sent" in ln for ln in lines if "FAIL" in ln)
+    # Throughput collapse.
+    ok, _ = gate.compare(base, _metrics_doc(edges_per_sec=100.0))
+    assert not ok
+    # Weight change: exact metric, any delta fails.
+    ok, lines = gate.compare(base, _metrics_doc(mst_weight=8292))
+    assert not ok and any("exact" in ln for ln in lines if "FAIL" in ln)
+    # Missing metric fails rather than silently ungating.
+    broken = _metrics_doc()
+    del broken["metrics"]["device_levels"]
+    ok, lines = gate.compare(base, broken)
+    assert not ok and any("missing" in ln for ln in lines)
+
+
+def test_gate_config_mismatch_fails():
+    gate = _gate()
+    base = _metrics_doc()
+    fresh = _metrics_doc()
+    fresh["config"] = {"workload": "other"}
+    ok, lines = gate.compare(base, fresh)
+    assert not ok and "config mismatch" in lines[0]
+
+
+def test_gate_cli_against_committed_baseline(tmp_path):
+    """The acceptance scenario: the committed baseline passes a synthetic
+    identical run and fails a synthetically-regressed metrics file."""
+    gate = _gate()
+    baseline_path = os.path.join(
+        os.path.dirname(__file__), "..", "docs", "BENCH_BASELINE.json"
+    )
+    with open(baseline_path) as f:
+        baseline = json.load(f)
+    same = str(tmp_path / "same.json")
+    with open(same, "w") as f:
+        json.dump(baseline, f)
+    assert gate.main(["--baseline", baseline_path, "--metrics", same]) == 0
+
+    regressed = dict(baseline)
+    regressed["metrics"] = dict(baseline["metrics"])
+    regressed["metrics"]["protocol_messages_sent"] = int(
+        baseline["metrics"]["protocol_messages_sent"] * 1.5
+    )
+    bad = str(tmp_path / "bad.json")
+    with open(bad, "w") as f:
+        json.dump(regressed, f)
+    assert gate.main(["--baseline", baseline_path, "--metrics", bad]) == 1
+
+
+def test_gate_rejects_bad_schema(tmp_path):
+    gate = _gate()
+    path = str(tmp_path / "junk.json")
+    with open(path, "w") as f:
+        json.dump({"schema": "nope", "metrics": {}}, f)
+    assert gate.main(["--metrics", path]) == 2
+
+
+def test_gate_live_run_matches_committed_counts():
+    """The gate's own seeded workload reproduces the committed deterministic
+    counters exactly (this is what makes the CI gate meaningful)."""
+    gate = _gate()
+    fresh = gate.run_gate_bench()
+    baseline_path = os.path.join(
+        os.path.dirname(__file__), "..", "docs", "BENCH_BASELINE.json"
+    )
+    with open(baseline_path) as f:
+        baseline = json.load(f)
+    for name, value in baseline["metrics"].items():
+        if gate.metric_kind(name) in ("count", "exact"):
+            assert fresh["metrics"][name] == value, name
+
+
+# ----------------------------------------------------------------------
+# CLI: trace + stats
+# ----------------------------------------------------------------------
+def test_cli_trace_writes_valid_chrome_trace(tmp_path):
+    from distributed_ghs_implementation_tpu.cli import main
+
+    out = str(tmp_path / "trace.json")
+    jsonl = str(tmp_path / "events.jsonl")
+    assert main([
+        "trace", "--nodes", "64", "--edges", "160", "--seed", "9",
+        "--out", out, "--jsonl", jsonl,
+    ]) == 0
+    with open(out) as f:
+        trace = json.load(f)
+    names = {ev["name"] for ev in trace["traceEvents"]}
+    assert "trace.session" in names
+    assert "solver.level" in names  # per-level solver spans
+    assert "protocol.messages_sent" in names  # protocol counter track
+    assert os.path.exists(jsonl)
+
+
+def test_cli_trace_captures_resilience_retries(tmp_path, monkeypatch):
+    from distributed_ghs_implementation_tpu.cli import main
+    from distributed_ghs_implementation_tpu.utils.resilience import FAULTS
+
+    monkeypatch.setenv("GHS_FAULT_RESILIENCE_ATTEMPT_STEPPED", "1")
+    out = str(tmp_path / "trace.json")
+    try:
+        assert main([
+            "trace", "--nodes", "48", "--edges", "120",
+            "--no-protocol-sample", "--out", out,
+        ]) == 0
+    finally:
+        FAULTS.reset()
+    with open(out) as f:
+        trace = json.load(f)
+    attempts = [
+        ev["args"] for ev in trace["traceEvents"]
+        if ev["name"] == "resilience.attempt"
+    ]
+    assert [a["outcome"] for a in attempts] == ["transient", "ok"]
+    assert attempts[0]["site"] == "resilience.attempt.stepped"
+
+
+def test_cli_stats_from_jsonl(tmp_path, capsys):
+    from distributed_ghs_implementation_tpu.cli import main
+
+    out = str(tmp_path / "trace.json")
+    jsonl = str(tmp_path / "events.jsonl")
+    assert main([
+        "trace", "--nodes", "48", "--edges", "120", "--out", out,
+        "--jsonl", jsonl,
+    ]) == 0
+    capsys.readouterr()
+    assert main(["stats", "--input", jsonl]) == 0
+    text = capsys.readouterr().out
+    assert "solver.level" in text
+    assert "protocol.messages_sent" in text

@@ -234,6 +234,57 @@ def test_api_supervised_surface():
     assert r.incidents.to_json()  # serializes
 
 
+def test_supervised_attempts_emit_structured_events():
+    """Satellite contract: the attempt log is mirrored onto the event bus as
+    structured ``resilience.attempt`` events (attempt index, fault site,
+    rung = degradation tier, outcome) — no string parsing required."""
+    from distributed_ghs_implementation_tpu.obs.events import BUS
+
+    BUS.enable()
+    mark = BUS.mark()
+    with FAULTS.inject("resilience.attempt.device", times=2):
+        _sup().solve(G, entry="device")
+    attempts = [
+        rec[6] for rec in BUS.events_since(mark) if rec[1] == "resilience.attempt"
+    ]
+    assert [(a["rung"], a["attempt"], a["outcome"]) for a in attempts] == [
+        ("device", 1, "transient"),
+        ("device", 2, "transient"),
+        ("stepped", 1, "ok"),
+    ]
+    assert attempts[0]["site"] == "resilience.attempt.device"
+    assert "InjectedFault" in attempts[0]["error"]
+    assert attempts[2]["site"] is None  # success implicates no fault site
+    degrades = [
+        rec[6] for rec in BUS.events_since(mark) if rec[1] == "resilience.degrade"
+    ]
+    assert degrades == [{"from_rung": "device", "to_rung": "stepped"}]
+    solves = [
+        rec[6] for rec in BUS.events_since(mark) if rec[1] == "resilience.solve"
+    ]
+    assert solves[0]["entry"] == "device"
+    assert solves[0]["final_rung"] == "stepped" and solves[0]["attempts"] == 3
+
+
+def test_watchdog_timeout_incident_names_slow_site():
+    """Timeouts are attributed to the slow site, not the attempt site, in
+    both the Incident record and its bus event."""
+    from distributed_ghs_implementation_tpu.obs.events import BUS
+
+    BUS.enable()
+    mark = BUS.mark()
+    cfg = SupervisorConfig(retries_per_rung=1, backoff_base_s=0.0, deadline_s=100.0)
+    sup = _sup(cfg, clock=lambda: 0.0)
+    with FAULTS.inject("resilience.slow.device", times=1, kind="slow", value=1e6):
+        _ids, _, _, log = sup.solve(G, entry="device")
+    assert log.records[0].site == "resilience.slow.device"
+    attempts = [
+        rec[6] for rec in BUS.events_since(mark) if rec[1] == "resilience.attempt"
+    ]
+    assert attempts[0]["outcome"] == "timeout"
+    assert attempts[0]["site"] == "resilience.slow.device"
+
+
 def test_api_supervised_env_knob(monkeypatch):
     monkeypatch.setenv("GHS_FAULT_RESILIENCE_ATTEMPT_DEVICE", "1")
     FAULTS.reload_env()

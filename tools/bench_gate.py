@@ -1,0 +1,259 @@
+#!/usr/bin/env python
+"""Bench regression gate: compare fresh metrics against a committed baseline.
+
+    python tools/bench_gate.py                       # run + compare
+    python tools/bench_gate.py --update              # (re)write the baseline
+    python tools/bench_gate.py --metrics fresh.json  # compare a saved run
+
+Exit code 0 iff no metric regresses beyond its tolerance. Two metric
+classes, told apart by key suffix (plus the KINDS overrides):
+
+* **counts** (``levels``, ``*_messages_sent``, ...): deterministic — the
+  solver, the rank order, and the event-queue protocol are all seeded — so
+  the tolerance is tight (default 2%) and catches *algorithmic* regressions
+  (an extra Borůvka level, a protocol chattiness bug) that wall-clock noise
+  would hide. ``mst_weight`` is exact: any change is a correctness failure,
+  never a tolerance question (the silent-wrong-MST failure mode from
+  PAPER.md is precisely what this line guards).
+* **times** (``*_s``) / **throughputs** (``*_per_sec``): machine-dependent —
+  gate loosely by default (50%) and loosen further on shared CI
+  (``--time-tolerance 5.0`` catches order-of-magnitude cliffs only).
+
+The default run is small and CPU-safe (the gate must run in CI on every
+push); ``bench.py --metrics-out`` emits the same schema at TPU bench scale
+for gating real hardware runs against ``docs/BASELINE_RUNS.jsonl``-era
+numbers.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+from typing import Dict, List, Tuple
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+SCHEMA = "ghs-bench-metrics-v1"
+DEFAULT_BASELINE = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    "docs",
+    "BENCH_BASELINE.json",
+)
+
+#: Metric-kind overrides; everything else is classified by suffix
+#: (``*_s`` time, ``*_per_sec`` throughput, default count).
+KINDS = {"mst_weight": "exact", "protocol_mst_weight": "exact"}
+
+
+def metric_kind(name: str) -> str:
+    if name in KINDS:
+        return KINDS[name]
+    if name.endswith("_s"):
+        return "time"
+    if name.endswith("_per_sec"):
+        return "throughput"
+    return "count"
+
+
+def run_gate_bench() -> dict:
+    """The gate's own measurement: one small fixed workload per layer.
+
+    Everything here is seeded; only the ``*_s`` entries vary run to run.
+    """
+    from distributed_ghs_implementation_tpu.graphs.generators import (
+        erdos_renyi_graph,
+        gnm_random_graph,
+    )
+    from distributed_ghs_implementation_tpu.models.boruvka import solve_graph
+    from distributed_ghs_implementation_tpu.protocol.faults import (
+        FaultSpec,
+        ReliableTransport,
+    )
+    from distributed_ghs_implementation_tpu.protocol.runner import (
+        solve_graph_protocol,
+    )
+    from distributed_ghs_implementation_tpu.protocol.transport import SimTransport
+
+    metrics: Dict[str, float] = {}
+
+    # Device path: seeded G(n,m) at a size that exercises multiple levels.
+    g = gnm_random_graph(4096, 16384, seed=11)
+    solve_graph(g)  # warm: compile outside the clock
+    times = []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        edge_ids, fragment, levels = solve_graph(g)
+        times.append(time.perf_counter() - t0)
+    metrics["device_solve_s"] = min(times)
+    metrics["device_levels"] = int(levels)
+    metrics["device_mst_edges"] = int(edge_ids.shape[0])
+    metrics["mst_weight"] = int(g.w[edge_ids].sum())
+
+    # Protocol path: the event-queue transport is deterministic, so message
+    # counts are exact fingerprints of protocol behavior.
+    gp = erdos_renyi_graph(96, 0.08, seed=12)
+    transport = SimTransport()
+    t0 = time.perf_counter()
+    ids_p, _, _ = solve_graph_protocol(gp, transport=transport)
+    metrics["protocol_solve_s"] = time.perf_counter() - t0
+    metrics["protocol_messages_sent"] = transport.messages_sent
+    metrics["protocol_messages_deferred"] = transport.messages_deferred
+    metrics["protocol_mst_weight"] = int(gp.w[ids_p].sum())
+
+    # Reliable sublayer under a fixed lossy spec: retransmit/suppression
+    # counts are seeded-deterministic too.
+    gr = erdos_renyi_graph(40, 0.12, seed=13)
+    reliable = ReliableTransport(
+        FaultSpec(drop=0.15, duplicate=0.1, reorder=0.2, seed=14)
+    )
+    solve_graph_protocol(gr, transport=reliable)
+    metrics["reliable_messages_sent"] = reliable.messages_sent
+    metrics["reliable_retransmits"] = reliable.retransmits
+    metrics["reliable_dup_suppressed"] = reliable.dup_suppressed
+
+    return {
+        "schema": SCHEMA,
+        "config": {
+            "workload": "gate-small-v1",
+            "device_graph": "gnm(4096,16384,seed=11)",
+            "protocol_graph": "er(96,0.08,seed=12)",
+            "reliable_graph": "er(40,0.12,seed=13)+drop0.15dup0.1re0.2seed14",
+        },
+        "metrics": metrics,
+    }
+
+
+def compare(
+    baseline: dict,
+    fresh: dict,
+    *,
+    time_tolerance: float = 0.5,
+    count_tolerance: float = 0.02,
+) -> Tuple[bool, List[str]]:
+    """Per-metric verdicts; returns ``(ok, report_lines)``.
+
+    A *regression* is: slower than ``(1 + time_tolerance) x`` baseline,
+    lower throughput than ``(1 - time_tolerance) x``, a count above
+    ``(1 + count_tolerance) x``, or any change at all to an exact metric.
+    Improvements never fail the gate (they're reported, so a suspicious
+    10x "improvement" is still visible).
+    """
+    lines: List[str] = []
+    ok = True
+    base_cfg = baseline.get("config", {})
+    fresh_cfg = fresh.get("config", {})
+    if base_cfg and fresh_cfg and base_cfg != fresh_cfg:
+        lines.append(
+            f"FAIL config mismatch: baseline {base_cfg} vs fresh {fresh_cfg}"
+        )
+        return False, lines
+    base_metrics = baseline.get("metrics", {})
+    fresh_metrics = fresh.get("metrics", {})
+    for name in sorted(base_metrics):
+        base = base_metrics[name]
+        if name not in fresh_metrics:
+            lines.append(f"FAIL {name}: missing from fresh metrics")
+            ok = False
+            continue
+        value = fresh_metrics[name]
+        kind = metric_kind(name)
+        ratio = value / base if base else float("inf" if value else 1)
+        if kind == "exact":
+            good = value == base
+            verdict = "ok" if good else "FAIL"
+            lines.append(f"{verdict} {name}: {value} vs {base} (exact)")
+        elif kind == "time":
+            good = ratio <= 1 + time_tolerance
+            verdict = "ok" if good else "FAIL"
+            lines.append(
+                f"{verdict} {name}: {value:.4f}s vs {base:.4f}s "
+                f"({ratio:.2f}x, limit {1 + time_tolerance:.2f}x)"
+            )
+        elif kind == "throughput":
+            good = ratio >= 1 - time_tolerance
+            verdict = "ok" if good else "FAIL"
+            lines.append(
+                f"{verdict} {name}: {value:.1f} vs {base:.1f} "
+                f"({ratio:.2f}x, floor {1 - time_tolerance:.2f}x)"
+            )
+        else:  # count
+            good = ratio <= 1 + count_tolerance
+            verdict = "ok" if good else "FAIL"
+            lines.append(
+                f"{verdict} {name}: {value} vs {base} "
+                f"({ratio:.3f}x, limit {1 + count_tolerance:.3f}x)"
+            )
+        ok = ok and good
+    for name in sorted(set(fresh_metrics) - set(base_metrics)):
+        lines.append(f"note {name}: new metric (not in baseline), ungated")
+    return ok, lines
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(prog="bench_gate", description=__doc__)
+    p.add_argument("--baseline", default=DEFAULT_BASELINE)
+    p.add_argument(
+        "--metrics",
+        help="compare this saved metrics JSON instead of running the bench",
+    )
+    p.add_argument("--out", help="write the fresh metrics JSON here")
+    p.add_argument(
+        "--update",
+        action="store_true",
+        help="run the bench and (re)write the baseline instead of comparing",
+    )
+    p.add_argument("--time-tolerance", type=float, default=0.5,
+                   help="allowed fractional wall-time regression (0.5 = +50%%)")
+    p.add_argument("--count-tolerance", type=float, default=0.02,
+                   help="allowed fractional count regression (0.02 = +2%%)")
+    args = p.parse_args(argv)
+
+    if args.metrics:
+        with open(args.metrics) as f:
+            fresh = json.load(f)
+    else:
+        fresh = run_gate_bench()
+    if fresh.get("schema") != SCHEMA:
+        print(f"bench_gate: bad metrics schema {fresh.get('schema')!r}",
+              file=sys.stderr)
+        return 2
+
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump(fresh, f, indent=2)
+            f.write("\n")
+
+    if args.update:
+        with open(args.baseline, "w") as f:
+            json.dump(fresh, f, indent=2)
+            f.write("\n")
+        print(f"baseline written: {args.baseline}")
+        return 0
+
+    if not os.path.exists(args.baseline):
+        print(
+            f"bench_gate: no baseline at {args.baseline} "
+            "(run with --update to create one)",
+            file=sys.stderr,
+        )
+        return 2
+    with open(args.baseline) as f:
+        baseline = json.load(f)
+
+    ok, lines = compare(
+        baseline,
+        fresh,
+        time_tolerance=args.time_tolerance,
+        count_tolerance=args.count_tolerance,
+    )
+    for line in lines:
+        print(line)
+    print(f"bench gate: {'PASS' if ok else 'FAIL'}")
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
