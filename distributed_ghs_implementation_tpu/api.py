@@ -21,11 +21,14 @@ from __future__ import annotations
 
 import dataclasses
 import time
-from typing import Iterable, List, Optional, Tuple
+from typing import TYPE_CHECKING, Iterable, List, Optional, Tuple
 
 import numpy as np
 
 from distributed_ghs_implementation_tpu.graphs.edgelist import Graph
+
+if TYPE_CHECKING:  # deferred: resilience imports stay off the cold path
+    from distributed_ghs_implementation_tpu.utils.resilience import IncidentLog
 
 
 @dataclasses.dataclass
@@ -43,8 +46,8 @@ class MSTResult:
     backend: str
     num_components: int
     # Populated by supervised solves only: the structured attempt/fallback
-    # record (``utils.resilience.IncidentLog``).
-    incidents: Optional[object] = None
+    # record.
+    incidents: Optional["IncidentLog"] = None
 
     @property
     def edges(self) -> List[Tuple[int, int]]:

@@ -139,6 +139,28 @@ def _cmd_run(args) -> int:
     print(json.dumps(result_to_dict(result), indent=2))
     if args.output:
         write_result_json(result, args.output)
+    if args.metrics_out:
+        # The bench-gate schema (tools/bench_gate.py), so ad-hoc runs gate
+        # against saved baselines exactly like `ghs bench` runs do.
+        with open(args.metrics_out, "w") as f:
+            json.dump(
+                {
+                    "schema": "ghs-bench-metrics-v1",
+                    "config": {
+                        "workload": f"run-{os.path.basename(args.graph_dir)}"
+                        f"-{result.backend}",
+                    },
+                    "metrics": {
+                        "solve_s": result.wall_time_s,
+                        "levels": int(result.num_levels),
+                        "mst_weight": result.total_weight,
+                        "mst_edges": int(result.num_edges),
+                    },
+                },
+                f,
+                indent=2,
+            )
+            f.write("\n")
     if args.visualize:
         from distributed_ghs_implementation_tpu.utils.viz import visualize_mst
 
@@ -316,6 +338,27 @@ def _cmd_stats(args) -> int:
     return 0
 
 
+def _cmd_serve(args) -> int:
+    """The MST query service: JSONL requests on stdin (or --input), JSON
+    responses on stdout (serve/service.py has the protocol)."""
+    from distributed_ghs_implementation_tpu.serve.service import (
+        MSTService,
+        serve_loop,
+    )
+
+    service = MSTService(
+        backend=args.backend,
+        store_capacity=args.cache_entries,
+        disk_dir=args.disk_cache,
+        max_concurrent=args.max_concurrent,
+        resolve_threshold=args.resolve_threshold,
+    )
+    if args.input:
+        with open(args.input) as f:
+            return serve_loop(f, sys.stdout, service)
+    return serve_loop(sys.stdin, sys.stdout, service)
+
+
 def _cmd_bench(args) -> int:
     import bench as bench_mod  # repo-root bench.py
 
@@ -385,6 +428,11 @@ def build_parser() -> argparse.ArgumentParser:
         help="with --supervised: watchdog deadline per attempt, checked at "
         "chunk/level boundaries",
     )
+    r.add_argument(
+        "--metrics-out",
+        help="write bench-gate metrics JSON here (tools/bench_gate.py; "
+        "same schema as `bench --metrics-out`)",
+    )
     r.set_defaults(fn=_cmd_run)
 
     v = sub.add_parser("verify", help="print the oracle MST for a graph dir")
@@ -452,6 +500,30 @@ def build_parser() -> argparse.ArgumentParser:
     _obs_graph_args(s)
     s.add_argument("--input", help="summarize this event JSONL instead of running")
     s.set_defaults(fn=_cmd_stats)
+
+    srv = sub.add_parser(
+        "serve",
+        help="MST query service: JSONL solve/update/stats requests on stdin, "
+        "content-addressed result cache + incremental edge updates "
+        "(docs/SERVING.md)",
+    )
+    srv.add_argument(
+        "--backend", default="device", choices=["device", "sharded"]
+    )
+    srv.add_argument("--cache-entries", type=int, default=128,
+                     help="in-memory LRU capacity (results)")
+    srv.add_argument("--disk-cache",
+                     help="directory for the persistent cache layer")
+    srv.add_argument("--max-concurrent", type=int, default=2,
+                     help="solve admission bound (cache misses in flight)")
+    srv.add_argument(
+        "--resolve-threshold", type=int,
+        help="update batches larger than this re-solve instead of applying "
+        "incrementally (default: max(64, edges/10))",
+    )
+    srv.add_argument("--input",
+                     help="read JSONL requests from this file instead of stdin")
+    srv.set_defaults(fn=_cmd_serve)
 
     b = sub.add_parser("bench", help="run the benchmark (see bench.py)")
     b.add_argument("--scale", type=int, default=22)

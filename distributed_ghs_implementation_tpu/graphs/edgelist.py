@@ -128,6 +128,38 @@ class Graph:
         return Graph.from_edges(g.number_of_nodes(), edges)
 
     # ------------------------------------------------------------------
+    # Identity
+    # ------------------------------------------------------------------
+    @functools.cached_property
+    def _digest(self) -> str:
+        import hashlib
+
+        h = hashlib.sha256()
+        h.update(np.int64(self.num_nodes).tobytes())
+        h.update(self.w.dtype.char.encode())  # int 5 vs float 5.0 differ
+        h.update(np.ascontiguousarray(self.u).tobytes())
+        h.update(np.ascontiguousarray(self.v).tobytes())
+        h.update(np.ascontiguousarray(self.w).tobytes())
+        return h.hexdigest()
+
+    def digest(self) -> str:
+        """Stable content hash over ``(num_nodes, u, v, w)`` — hex sha256.
+
+        Construction canonicalizes edges (``u < v``, sorted, deduped), so any
+        two :class:`Graph` instances describing the same weighted edge set
+        share a digest regardless of input order. This is the ONE identity
+        both the serve result cache (``serve/store.py``) and checkpoint
+        fingerprints (``utils/checkpoint.py``) key on; computed once per
+        instance (cached).
+        """
+        return self._digest
+
+    def digest_words(self) -> np.ndarray:
+        """:meth:`digest` as four int64 words — the array form checkpoint
+        fingerprints and disk-cache entries embed (one decode, one place)."""
+        return np.frombuffer(bytes.fromhex(self._digest), dtype=np.int64).copy()
+
+    # ------------------------------------------------------------------
     # Views
     # ------------------------------------------------------------------
     def edge_triples(self) -> list:

@@ -23,7 +23,9 @@ Event taxonomy (names are dotted, ``docs/OBSERVABILITY.md`` has the full
 registry): ``solver.*`` (level/chunk kernels), ``protocol.*`` (message
 transport + reliable sublayer), ``resilience.*`` (supervisor attempts,
 degradations), ``parallel.*`` (sharded staging/collectives), ``trace.*``
-(CLI session phases), ``metrics.*`` (per-level fragment census).
+(CLI session phases), ``metrics.*`` (per-level fragment census),
+``serve.*`` (query service: cache hits/misses, single-flight coalescing,
+queue-depth samples, incremental-vs-resolve update routing).
 """
 
 from __future__ import annotations

@@ -1,0 +1,30 @@
+"""MST query service: cache, scheduler, incremental maintenance, JSONL loop.
+
+The serving layer over the solver stack (``docs/SERVING.md``):
+
+* ``store``     — content-addressed result cache (graph digest + solver
+  config -> ``MSTResult``), in-memory LRU front + optional crash-consistent
+  on-disk layer.
+* ``scheduler`` — single-flight request coalescing and capacity-bounded
+  admission; every cache miss solves under the ``utils.resilience``
+  supervisor.
+* ``dynamic``   — incremental MST maintenance for edge insert/delete/
+  reweight against a cached result (cycle rule / replacement-edge search on
+  the ``ops`` primitives), with a supervised full re-solve fallback.
+* ``service``   — the JSONL request/response loop behind ``ghs serve``.
+"""
+
+from distributed_ghs_implementation_tpu.serve.dynamic import DynamicMST, Update
+from distributed_ghs_implementation_tpu.serve.scheduler import SolveScheduler
+from distributed_ghs_implementation_tpu.serve.service import MSTService, serve_loop
+from distributed_ghs_implementation_tpu.serve.store import ResultStore, solve_cache_key
+
+__all__ = [
+    "DynamicMST",
+    "MSTService",
+    "ResultStore",
+    "SolveScheduler",
+    "Update",
+    "serve_loop",
+    "solve_cache_key",
+]

@@ -1,0 +1,151 @@
+"""Micro-batching solve scheduler: single-flight coalescing + admission bound.
+
+Request handling for the serve path, in order:
+
+1. **Cache probe** — ``ResultStore.get`` by content key; a hit never touches
+   the solver (zero ``solver.*`` spans — the warm-path guarantee tests
+   assert on bus events).
+2. **Single-flight** — concurrent requests for the same key join the one
+   in-flight solve instead of duplicating it (``serve.scheduler.coalesced``
+   counts the joins). This is what keeps a thundering herd of identical
+   queries at exactly one kernel dispatch.
+3. **Admission bound** — distinct misses solve under a semaphore
+   (``max_concurrent``); excess requests queue. ``serve.queue.depth`` is
+   sampled on every transition so traces show pressure over time.
+4. **Supervised solve** — every miss runs through the round-6 resilience
+   supervisor (watchdog, bounded retry, the sharded->device->stepped->host
+   degradation ladder), so one flaky device never fails a request that a
+   degraded rung can still answer exactly.
+
+``solve_batch`` is the micro-batching entry: it dedups a whole request list
+by key first, solves each unique key once, and fans the results back out —
+duplicates inside a batch cost a dict lookup, not a solve.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import List, Optional, Sequence, Tuple
+
+from distributed_ghs_implementation_tpu.api import MSTResult, minimum_spanning_forest
+from distributed_ghs_implementation_tpu.graphs.edgelist import Graph
+from distributed_ghs_implementation_tpu.obs.events import BUS
+from distributed_ghs_implementation_tpu.serve.store import ResultStore, solve_cache_key
+
+
+class _Flight:
+    """One in-flight solve; joiners block on ``event`` and read the outcome."""
+
+    __slots__ = ("event", "result", "error")
+
+    def __init__(self):
+        self.event = threading.Event()
+        self.result: Optional[MSTResult] = None
+        self.error: Optional[BaseException] = None
+
+
+class SolveScheduler:
+    """Cache-fronted, single-flight, capacity-bounded solve dispatch."""
+
+    def __init__(
+        self,
+        store: Optional[ResultStore] = None,
+        *,
+        backend: str = "device",
+        max_concurrent: int = 2,
+        supervisor_config=None,
+    ):
+        if max_concurrent < 1:
+            raise ValueError(f"max_concurrent must be >= 1, got {max_concurrent}")
+        self.store = store if store is not None else ResultStore()
+        self.backend = backend
+        self._supervisor_config = supervisor_config
+        self._sem = threading.BoundedSemaphore(max_concurrent)
+        self._flights: dict = {}
+        self._lock = threading.Lock()
+
+    def solve(
+        self, graph: Graph, *, backend: Optional[str] = None
+    ) -> Tuple[MSTResult, str]:
+        """Answer one solve request; returns ``(result, source)`` where
+        ``source`` is ``"cache"`` / ``"coalesced"`` / ``"solved"``."""
+        backend = backend or self.backend
+        key = solve_cache_key(graph, backend=backend)
+        cached = self.store.get(key, graph=graph)
+        if cached is not None:
+            return cached, "cache"
+
+        with self._lock:
+            flight = self._flights.get(key)
+            leader = flight is None
+            if leader:
+                flight = self._flights[key] = _Flight()
+                BUS.sample("serve.queue.depth", len(self._flights))
+        if not leader:
+            BUS.count("serve.scheduler.coalesced")
+            flight.event.wait()
+            if flight.error is not None:
+                raise flight.error
+            return flight.result, "coalesced"
+
+        try:
+            # Double-check after winning leadership: a previous leader may
+            # have published between our cache probe and the flight insert —
+            # without this, that race re-solves an already-cached graph.
+            cached = self.store.get(key, graph=graph, record_miss=False)
+            if cached is not None:
+                flight.result = cached
+                return cached, "cache"
+            with self._sem:
+                with BUS.span(
+                    "serve.solve", cat="serve", backend=backend,
+                    nodes=graph.num_nodes, edges=graph.num_edges,
+                ):
+                    flight.result = minimum_spanning_forest(
+                        graph, backend=backend, supervised=True,
+                        supervisor=self._make_supervisor(),
+                    )
+            self.store.put(key, flight.result)
+        except BaseException as e:
+            flight.error = e
+            raise
+        finally:
+            with self._lock:
+                del self._flights[key]
+                BUS.sample("serve.queue.depth", len(self._flights))
+            flight.event.set()
+        return flight.result, "solved"
+
+    def solve_batch(
+        self, graphs: Sequence[Graph], *, backend: Optional[str] = None
+    ) -> List[Tuple[MSTResult, str]]:
+        """Solve a batch, deduplicating by content key first (micro-batching:
+        duplicates inside the batch resolve against the leader's result)."""
+        backend = backend or self.backend
+        unique: dict = {}
+        keys = []
+        for g in graphs:
+            key = solve_cache_key(g, backend=backend)
+            keys.append(key)
+            if key in unique:
+                BUS.count("serve.scheduler.coalesced")
+            else:
+                unique[key] = g
+        solved = {
+            key: self.solve(g, backend=backend) for key, g in unique.items()
+        }
+        out: List[Tuple[MSTResult, str]] = []
+        first = set()
+        for key in keys:
+            if key in first:
+                out.append((solved[key][0], "coalesced"))
+            else:
+                first.add(key)
+                out.append(solved[key])
+        return out
+
+    # ------------------------------------------------------------------
+    def _make_supervisor(self):
+        from distributed_ghs_implementation_tpu.utils.resilience import Supervisor
+
+        return Supervisor(self._supervisor_config)

@@ -1,0 +1,244 @@
+"""The MST query service: a JSONL request/response loop over the serve stack.
+
+One JSON object per input line, one JSON response line per request — a
+protocol a test, the chaos drill, or a thin network front-end can all drive
+(``ghs serve`` wires it to stdin/stdout). Requests:
+
+* ``{"op": "solve", "num_nodes": N, "edges": [[u, v, w], ...]}`` — or
+  ``{"op": "solve", "graph_path": "graph.npz"}`` — optional ``"backend"``,
+  ``"edges_out": true`` to include the MST edge list in the response.
+  Response carries the graph ``digest`` (the handle updates key on) and
+  ``source``: ``"cache"`` / ``"coalesced"`` / ``"solved"``.
+* ``{"op": "update", "digest": "...", "updates": [{"kind": "insert",
+  "u": 1, "v": 2, "w": 5}, {"kind": "delete", "u": 3, "v": 4}, ...]}`` —
+  incremental maintenance against the session for ``digest``; the response
+  carries the *new* digest (sessions re-key content-addressed) and ``mode``
+  (``"incremental"`` or ``"resolve"``).
+* ``{"op": "stats"}`` — serve counters from the ``obs`` bus + store stats.
+* ``{"op": "shutdown"}`` — acknowledge and end the loop (EOF also ends it).
+
+Errors never kill the loop: a malformed line or a failed request produces
+``{"ok": false, "error": ...}`` and the loop reads on.
+"""
+
+from __future__ import annotations
+
+import collections
+import json
+from typing import IO, Optional
+
+from distributed_ghs_implementation_tpu.api import MSTResult
+from distributed_ghs_implementation_tpu.graphs.edgelist import Graph
+from distributed_ghs_implementation_tpu.obs.events import BUS
+from distributed_ghs_implementation_tpu.serve.dynamic import DynamicMST
+from distributed_ghs_implementation_tpu.serve.scheduler import SolveScheduler
+from distributed_ghs_implementation_tpu.serve.store import (
+    ResultStore,
+    solve_cache_key,
+)
+
+_MAX_SESSIONS = 32  # update handles retained (LRU); results outlive them
+
+
+class MSTService:
+    """Request handler: solve through the scheduler, update through
+    per-digest :class:`DynamicMST` sessions, everything cached in the store."""
+
+    def __init__(
+        self,
+        *,
+        backend: str = "device",
+        store: Optional[ResultStore] = None,
+        store_capacity: int = 128,
+        disk_dir: Optional[str] = None,
+        max_concurrent: int = 2,
+        resolve_threshold: Optional[int] = None,
+        max_sessions: int = _MAX_SESSIONS,
+    ):
+        self.store = store if store is not None else ResultStore(
+            capacity=store_capacity, disk_dir=disk_dir
+        )
+        self.scheduler = SolveScheduler(
+            self.store, backend=backend, max_concurrent=max_concurrent
+        )
+        self.backend = backend
+        self.resolve_threshold = resolve_threshold
+        self.max_sessions = max_sessions
+        # digest -> DynamicMST (materialized by an update) or a lightweight
+        # (result, backend) seed (parked by a solve).
+        self._sessions: "collections.OrderedDict[str, object]" = (
+            collections.OrderedDict()
+        )
+
+    # ------------------------------------------------------------------
+    def handle(self, request: dict) -> dict:
+        op = request.get("op")
+        with BUS.span("serve.request", cat="serve", op=str(op)):
+            BUS.count("serve.requests")
+            try:
+                if op == "solve":
+                    return self._handle_solve(request)
+                if op == "update":
+                    return self._handle_update(request)
+                if op == "stats":
+                    return self._handle_stats()
+                if op == "shutdown":
+                    return {"ok": True, "op": "shutdown"}
+                raise ValueError(
+                    f"unknown op {op!r}; expected solve|update|stats|shutdown"
+                )
+            except Exception as e:  # noqa: BLE001 — the loop must survive
+                BUS.count("serve.errors")
+                return {"ok": False, "op": op, "error": f"{type(e).__name__}: {e}"}
+
+    # ------------------------------------------------------------------
+    def _handle_solve(self, request: dict) -> dict:
+        graph = self._load_graph(request)
+        backend = request.get("backend", self.backend)
+        result, source = self.scheduler.solve(graph, backend=backend)
+        digest = graph.digest()
+        self._remember(digest, result, backend)
+        out = {
+            "ok": True,
+            "op": "solve",
+            "digest": digest,
+            "source": source,
+            "cached": source != "solved",
+        }
+        out.update(self._result_fields(result, request))
+        return out
+
+    def _handle_update(self, request: dict) -> dict:
+        digest = request.get("digest")
+        entry = self._sessions.get(digest) if digest else None
+        if entry is None:
+            raise KeyError(
+                f"no session for digest {digest!r} (solve the graph first; "
+                f"{len(self._sessions)} sessions live)"
+            )
+        if not isinstance(entry, DynamicMST):
+            # Lazy materialization: solves park a (result, backend) seed —
+            # the O(m) session arrays are only built for graphs that
+            # actually receive updates, never on the query-only warm path.
+            seed_result, seed_backend = entry
+            entry = DynamicMST(
+                seed_result,
+                resolve_threshold=self.resolve_threshold,
+                backend=seed_backend,
+            )
+            self._sessions[digest] = entry
+        session = entry
+        self._sessions.move_to_end(digest)
+        try:
+            result = session.apply(request.get("updates", []))
+        except Exception:
+            if session.dirty:
+                # The apply failed mid-batch — a state no client has seen.
+                # Drop the session; the next update for this digest needs a
+                # fresh solve first (usually a cache hit). Pre-mutation
+                # failures (validation) leave the session usable.
+                del self._sessions[digest]
+                BUS.count("serve.sessions.poisoned")
+            raise
+        new_digest = result.graph.digest()
+        # Re-key content-addressed: the session now answers for the updated
+        # graph, and the updated result is cached for future solve requests.
+        del self._sessions[digest]
+        self._sessions[new_digest] = session
+        # Cache under the backend the session's solves used (a client pinned
+        # to a non-default backend must hit this entry on its next solve).
+        self.store.put(
+            solve_cache_key(result.graph, backend=session.backend), result
+        )
+        out = {
+            "ok": True,
+            "op": "update",
+            "digest": new_digest,
+            "prev_digest": digest,
+            "mode": session.last_mode,
+            "applied": len(request.get("updates", [])),
+        }
+        out.update(self._result_fields(result, request))
+        return out
+
+    def _handle_stats(self) -> dict:
+        counters = {
+            name: value
+            for name, value in BUS.counters().items()
+            if name.startswith("serve.")
+        }
+        return {
+            "ok": True,
+            "op": "stats",
+            "counters": counters,
+            "store": self.store.stats(),
+            "sessions": len(self._sessions),
+        }
+
+    # ------------------------------------------------------------------
+    def _load_graph(self, request: dict) -> Graph:
+        if "graph_path" in request:
+            from distributed_ghs_implementation_tpu.graphs import io
+
+            path = request["graph_path"]
+            if path.endswith(".npz"):
+                return io.read_npz(path)
+            return io.read_partition_dir(path)
+        if "edges" in request:
+            return Graph.from_edges(
+                int(request["num_nodes"]), request["edges"]
+            )
+        raise ValueError("solve needs either graph_path or num_nodes+edges")
+
+    def _remember(self, digest: str, result: MSTResult, backend: str) -> None:
+        if digest not in self._sessions:
+            # A lightweight seed, not a DynamicMST: the result is shared
+            # with the store entry (no array copies) until an update op
+            # materializes the session.
+            self._sessions[digest] = (result, backend)
+        self._sessions.move_to_end(digest)
+        while len(self._sessions) > self.max_sessions:
+            self._sessions.popitem(last=False)
+            BUS.count("serve.sessions.evicted")
+
+    @staticmethod
+    def _result_fields(result: MSTResult, request: dict) -> dict:
+        out = {
+            "total_weight": result.total_weight,
+            "num_nodes": result.graph.num_nodes,
+            "num_edges": result.graph.num_edges,
+            "num_edges_in_mst": result.num_edges,
+            "num_components": result.num_components,
+            "backend": result.backend,
+            "wall_time_s": result.wall_time_s,
+        }
+        if result.incidents is not None and len(result.incidents):
+            out["incident_summary"] = result.incidents.summary()
+        if request.get("edges_out"):
+            out["mst_edges"] = [[int(a), int(b)] for a, b in result.edges]
+        return out
+
+
+def serve_loop(
+    in_stream: IO[str], out_stream: IO[str], service: Optional[MSTService] = None
+) -> int:
+    """Drain JSONL requests from ``in_stream`` until EOF or ``shutdown``;
+    one flushed JSON response line each. Returns a process exit code."""
+    service = service or MSTService()
+    with BUS.span("serve.session", cat="serve"):
+        for line in in_stream:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                request = json.loads(line)
+            except json.JSONDecodeError as e:
+                BUS.count("serve.errors")
+                response = {"ok": False, "error": f"bad JSON: {e}"}
+            else:
+                response = service.handle(request)
+            out_stream.write(json.dumps(response) + "\n")
+            out_stream.flush()
+            if response.get("op") == "shutdown" and response.get("ok"):
+                break
+    return 0

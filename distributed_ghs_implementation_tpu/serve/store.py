@@ -1,0 +1,191 @@
+"""Content-addressed solve cache: graph digest + solver config -> MSTResult.
+
+Keying is by *content*, never identity: :func:`solve_cache_key` combines
+:meth:`Graph.digest` (stable sha256 over the canonicalized ``u/v/w`` arrays
+plus ``num_nodes`` — the same hash checkpoint fingerprints derive from) with
+the solver configuration, so two requests describing the same weighted edge
+set hit the same entry regardless of edge input order or which client sent
+them.
+
+Two layers:
+
+* an in-memory LRU front (``capacity`` entries; an entry is an
+  :class:`api.MSTResult`, which pins its graph's arrays — size the capacity
+  to the working set, not the request rate), and
+* an optional on-disk layer (``disk_dir``) holding one npz per key through
+  ``utils.checkpoint.atomic_write_npz`` — the same tmp-file + rename +
+  ``.bak``-generation write path checkpoints use, so a crash mid-write never
+  leaves a poisoned cache entry (the ``serve.store.save`` fault site tears
+  writes in chaos drills). Disk hits are re-validated against the graph's
+  digest before they are served and promoted into memory.
+
+Telemetry (``obs`` bus): ``serve.store.hit`` / ``.miss`` / ``.disk_hit`` /
+``.put`` / ``.evict`` counters; all methods are thread-safe (the scheduler
+calls in from concurrent request threads).
+"""
+
+from __future__ import annotations
+
+import collections
+import os
+import threading
+from typing import Optional
+
+import numpy as np
+
+from distributed_ghs_implementation_tpu.api import MSTResult
+from distributed_ghs_implementation_tpu.graphs.edgelist import Graph
+from distributed_ghs_implementation_tpu.obs.events import BUS
+
+
+def solve_cache_key(graph: Graph, *, backend: str = "device") -> str:
+    """The cache identity of one solve: content digest + solver config.
+
+    ``backend`` is the *requested* entry (e.g. ``"device"``), not the rung a
+    supervised solve eventually lands on — a degraded result is still the
+    exact MSF (every rung computes the identical forest), so it may serve
+    later requests for the same entry.
+    """
+    return f"{graph.digest()}:{backend}"
+
+
+def _disk_path(disk_dir: str, key: str) -> str:
+    return os.path.join(disk_dir, key.replace(":", "_") + ".npz")
+
+
+class ResultStore:
+    """In-memory LRU + optional on-disk content-addressed result cache."""
+
+    def __init__(
+        self,
+        capacity: int = 128,
+        disk_dir: Optional[str] = None,
+        disk_max_entries: int = 512,
+    ):
+        if capacity < 1:
+            raise ValueError(f"capacity must be >= 1, got {capacity}")
+        self.capacity = capacity
+        self.disk_dir = disk_dir
+        self.disk_max_entries = disk_max_entries
+        self._mem: "collections.OrderedDict[str, MSTResult]" = (
+            collections.OrderedDict()
+        )
+        self._lock = threading.Lock()
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._mem)
+
+    def get(
+        self,
+        key: str,
+        graph: Optional[Graph] = None,
+        *,
+        record_miss: bool = True,
+    ) -> Optional[MSTResult]:
+        """Look up ``key``; memory first, then disk (needs ``graph`` to
+        rebuild the result — content addressing means the caller has it).
+        ``record_miss=False`` keeps a re-probe (the scheduler's single-flight
+        double-check) from inflating the miss counter."""
+        with self._lock:
+            result = self._mem.get(key)
+            if result is not None:
+                self._mem.move_to_end(key)
+                BUS.count("serve.store.hit")
+                return result
+        if self.disk_dir is not None and graph is not None:
+            result = self._disk_get(key, graph)
+            if result is not None:
+                BUS.count("serve.store.hit")
+                BUS.count("serve.store.disk_hit")
+                self._mem_put(key, result)
+                return result
+        if record_miss:
+            BUS.count("serve.store.miss")
+        return None
+
+    def put(self, key: str, result: MSTResult) -> None:
+        BUS.count("serve.store.put")
+        self._mem_put(key, result)
+        if self.disk_dir is not None:
+            try:
+                self._disk_put(key, result)
+                self._disk_sweep()
+            except Exception:  # noqa: BLE001 — write-behind is best-effort
+                # A failed (or torn) cache write must never fail the request
+                # that produced the result; the atomic writer left either
+                # nothing or a .bak generation behind, and reads re-validate
+                # digests, so the worst case is a future miss.
+                BUS.count("serve.store.disk_write_failed")
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                "entries": len(self._mem),
+                "capacity": self.capacity,
+                "disk_dir": self.disk_dir,
+            }
+
+    # ------------------------------------------------------------------
+    def _mem_put(self, key: str, result: MSTResult) -> None:
+        with self._lock:
+            self._mem[key] = result
+            self._mem.move_to_end(key)
+            while len(self._mem) > self.capacity:
+                self._mem.popitem(last=False)
+                BUS.count("serve.store.evict")
+
+    def _disk_put(self, key: str, result: MSTResult) -> None:
+        from distributed_ghs_implementation_tpu.utils.checkpoint import (
+            atomic_write_npz,
+        )
+
+        atomic_write_npz(
+            _disk_path(self.disk_dir, key),
+            {
+                "digest": result.graph.digest_words(),
+                "edge_ids": result.edge_ids,
+                "num_levels": result.num_levels,
+                "num_components": result.num_components,
+                "backend": np.asarray(result.backend),
+            },
+            fault_site="serve.store.save",
+        )
+
+    def _disk_sweep(self) -> None:
+        """Bound the disk layer: drop the oldest entries (and their ``.bak``
+        generations) past ``disk_max_entries`` — an update stream re-keys to
+        a new digest per batch, so without GC the directory grows forever."""
+        entries = [
+            e for e in os.scandir(self.disk_dir) if e.name.endswith(".npz")
+        ]
+        if len(entries) <= self.disk_max_entries:
+            return
+        entries.sort(key=lambda e: e.stat().st_mtime)
+        for entry in entries[: len(entries) - self.disk_max_entries]:
+            for path in (entry.path, entry.path + ".bak"):
+                if os.path.exists(path):
+                    os.unlink(path)
+            BUS.count("serve.store.disk_evict")
+
+    def _disk_get(self, key: str, graph: Graph) -> Optional[MSTResult]:
+        path = _disk_path(self.disk_dir, key)
+        for candidate in (path, path + ".bak"):
+            if not os.path.exists(candidate):
+                continue
+            try:
+                with np.load(candidate) as data:
+                    stored = np.asarray(data["digest"])
+                    if not np.array_equal(stored, graph.digest_words()):
+                        continue  # a different graph collided on the filename
+                    return MSTResult(
+                        graph=graph,
+                        edge_ids=np.asarray(data["edge_ids"]),
+                        num_levels=int(data["num_levels"]),
+                        wall_time_s=0.0,
+                        backend=str(data["backend"]),
+                        num_components=int(data["num_components"]),
+                    )
+            except Exception:  # noqa: BLE001 — torn/corrupt: try the .bak
+                continue
+        return None

@@ -34,24 +34,70 @@ class CheckpointMismatch(ValueError):
 
 
 def graph_fingerprint(graph: Graph) -> np.ndarray:
-    """Cheap identity of a graph: ``[n, m, crc(u), crc(v), crc(w)]``.
+    """Identity of a graph as int64 words: ``[n, m, sha256/4...]``.
 
+    Derived from :meth:`Graph.digest` (the content hash the serve result
+    cache keys on, so checkpoints and cache entries agree on what "the same
+    graph" means); ``n``/``m`` lead so a mismatch error stays readable.
     Guards resume against a stale checkpoint from a *different* graph, which
     would otherwise silently yield a wrong MST whenever the padded shapes
     happen to collide (likely, since shapes are pow2-bucketed).
     """
-    import zlib
-
-    return np.asarray(
+    return np.concatenate(
         [
-            graph.num_nodes,
-            graph.num_edges,
-            zlib.crc32(np.ascontiguousarray(graph.u)),
-            zlib.crc32(np.ascontiguousarray(graph.v)),
-            zlib.crc32(np.ascontiguousarray(graph.w)),
-        ],
-        dtype=np.int64,
+            np.asarray([graph.num_nodes, graph.num_edges], dtype=np.int64),
+            graph.digest_words(),
+        ]
     )
+
+
+def atomic_write_npz(
+    path: str,
+    arrays: dict,
+    *,
+    retain_previous: bool = True,
+    fault_site: str = "checkpoint.save",
+) -> str:
+    """Crash-consistent npz write: tmp file + rename, one ``.bak`` generation.
+
+    ``retain_previous`` rotates an existing ``path`` to ``path + ".bak"``
+    first, so the last known-good generation survives a write that a crash
+    (or the armed ``fault_site``) leaves torn. Shared by solver checkpoints
+    and the serve result store (``serve/store.py``, fault site
+    ``serve.store.save``).
+    """
+    d = os.path.dirname(os.path.abspath(path)) or "."
+    os.makedirs(d, exist_ok=True)
+    fd, tmp = tempfile.mkstemp(dir=d, suffix=".npz.tmp")
+    os.close(fd)
+    try:
+        with open(tmp, "wb") as f:
+            np.savez_compressed(f, **{k: np.asarray(v) for k, v in arrays.items()})
+        if retain_previous and os.path.exists(path):
+            import zipfile
+
+            if zipfile.is_zipfile(path):
+                os.replace(path, path + ".bak")
+            else:
+                # The primary is torn (e.g. the save this one follows
+                # crashed mid-write): rotating it would clobber the last
+                # good generation. Drop it and keep the loadable .bak.
+                os.unlink(path)
+        armed = FAULTS.pop(fault_site)
+        if armed is not None:
+            if armed.kind == "torn":
+                # Simulate a crash on a non-atomic filesystem: the
+                # destination ends up holding a truncated npz.
+                with open(tmp, "rb") as f:
+                    blob = f.read()
+                with open(path, "wb") as f:
+                    f.write(blob[: max(1, len(blob) // 2)])
+            raise InjectedFault(f"injected fault at {fault_site} ({armed.kind})")
+        os.replace(tmp, path)
+    finally:
+        if os.path.exists(tmp):
+            os.unlink(tmp)
+    return path
 
 
 def save_checkpoint(
@@ -63,51 +109,15 @@ def save_checkpoint(
     fingerprint=None,
     retain_previous: bool = True,
 ) -> str:
-    """Atomic npz write of the solver state (tmp file + rename).
-
-    ``retain_previous`` rotates an existing ``path`` to ``path + ".bak"``
-    first, so the last known-good generation survives a write that a crash
-    (or the ``checkpoint.save`` fault site) leaves torn.
-    """
-    d = os.path.dirname(os.path.abspath(path)) or "."
-    os.makedirs(d, exist_ok=True)
-    fd, tmp = tempfile.mkstemp(dir=d, suffix=".npz.tmp")
-    os.close(fd)
-    try:
-        with open(tmp, "wb") as f:
-            arrays = dict(
-                fragment=np.asarray(fragment),
-                mst_ranks=np.asarray(mst_ranks),
-                level=np.asarray(level),
-            )
-            if fingerprint is not None:
-                arrays["fingerprint"] = np.asarray(fingerprint)
-            np.savez_compressed(f, **arrays)
-        if retain_previous and os.path.exists(path):
-            import zipfile
-
-            if zipfile.is_zipfile(path):
-                os.replace(path, path + ".bak")
-            else:
-                # The primary is torn (e.g. the save this one follows
-                # crashed mid-write): rotating it would clobber the last
-                # good generation. Drop it and keep the loadable .bak.
-                os.unlink(path)
-        armed = FAULTS.pop("checkpoint.save")
-        if armed is not None:
-            if armed.kind == "torn":
-                # Simulate a crash on a non-atomic filesystem: the
-                # destination ends up holding a truncated npz.
-                with open(tmp, "rb") as f:
-                    blob = f.read()
-                with open(path, "wb") as f:
-                    f.write(blob[: max(1, len(blob) // 2)])
-            raise InjectedFault(f"injected fault at checkpoint.save ({armed.kind})")
-        os.replace(tmp, path)
-    finally:
-        if os.path.exists(tmp):
-            os.unlink(tmp)
-    return path
+    """Atomic npz write of the solver state (see :func:`atomic_write_npz`)."""
+    arrays = dict(
+        fragment=np.asarray(fragment),
+        mst_ranks=np.asarray(mst_ranks),
+        level=np.asarray(level),
+    )
+    if fingerprint is not None:
+        arrays["fingerprint"] = np.asarray(fingerprint)
+    return atomic_write_npz(path, arrays, retain_previous=retain_previous)
 
 
 def load_checkpoint(

@@ -34,8 +34,11 @@ def result_to_dict(result: MSTResult) -> dict:
     }
     if result.incidents is not None:
         # Persist the supervised attempt/fallback trail with the artifact —
-        # a degraded run must stay diagnosable after the process exits.
+        # a degraded run must stay diagnosable after the process exits. The
+        # one-line summary rides along so serve responses (and anything else
+        # embedding this dict) report degradation without parsing records.
         out["incidents"] = result.incidents.to_dicts()
+        out["incident_summary"] = result.incidents.summary()
     return out
 
 

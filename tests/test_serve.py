@@ -1,0 +1,432 @@
+"""Serving layer: content-addressed store, single-flight scheduler, JSONL
+service, digest identity, and the warm-path acceptance guarantees (a repeat
+solve touches no solver span; a single-edge insert on a cached 10k-node
+graph never re-solves)."""
+
+import json
+import threading
+
+import numpy as np
+import pytest
+
+from distributed_ghs_implementation_tpu.api import minimum_spanning_forest
+from distributed_ghs_implementation_tpu.graphs.edgelist import Graph
+from distributed_ghs_implementation_tpu.graphs.generators import gnm_random_graph
+from distributed_ghs_implementation_tpu.obs.events import BUS
+from distributed_ghs_implementation_tpu.serve.scheduler import SolveScheduler
+from distributed_ghs_implementation_tpu.serve.service import MSTService, serve_loop
+from distributed_ghs_implementation_tpu.serve.store import (
+    ResultStore,
+    solve_cache_key,
+)
+
+
+@pytest.fixture(autouse=True)
+def _clean_global_bus():
+    BUS.enable()
+    BUS.clear()
+    yield
+    BUS.enable()
+    BUS.clear()
+
+
+def _edges(g):
+    return [[int(a), int(b), int(c)] for a, b, c in zip(g.u, g.v, g.w)]
+
+
+# ----------------------------------------------------------------------
+# Digest (satellite: the ONE identity for cache + checkpoints)
+# ----------------------------------------------------------------------
+def test_digest_is_content_addressed_and_order_invariant():
+    e = [(0, 1, 3), (1, 2, 5), (0, 2, 4)]
+    a = Graph.from_edges(3, e)
+    b = Graph.from_edges(3, list(reversed(e)))  # same set, different order
+    c = Graph.from_edges(3, [(1, 0, 3), (2, 1, 5), (2, 0, 4)])  # flipped ends
+    assert a.digest() == b.digest() == c.digest()
+    assert a.digest() != Graph.from_edges(3, [(0, 1, 3), (1, 2, 5)]).digest()
+    assert a.digest() != Graph.from_edges(4, e).digest()  # num_nodes counts
+    # int 5 and float 5.0 weights are different graphs.
+    f = Graph.from_edges(3, [(0, 1, 3.5), (1, 2, 5.0), (0, 2, 4.0)])
+    assert a.digest() != f.digest()
+
+
+def test_checkpoint_fingerprint_derives_from_digest():
+    from distributed_ghs_implementation_tpu.utils.checkpoint import (
+        graph_fingerprint,
+    )
+
+    g = gnm_random_graph(32, 64, seed=3)
+    fp = graph_fingerprint(g)
+    assert fp.dtype == np.int64 and fp.shape == (6,)
+    assert fp[0] == g.num_nodes and fp[1] == g.num_edges
+    expect = np.frombuffer(bytes.fromhex(g.digest()), dtype=np.int64)
+    assert np.array_equal(fp[2:], expect)
+
+
+# ----------------------------------------------------------------------
+# Store
+# ----------------------------------------------------------------------
+def test_store_lru_eviction_and_counters():
+    store = ResultStore(capacity=2)
+    graphs = [gnm_random_graph(24, 48, seed=s) for s in range(3)]
+    results = [minimum_spanning_forest(g) for g in graphs]
+    keys = [solve_cache_key(g) for g in graphs]
+    for key, result in zip(keys, results):
+        store.put(key, result)
+    assert len(store) == 2
+    assert store.get(keys[0]) is None  # oldest evicted
+    assert store.get(keys[2]) is results[2]
+    counters = BUS.counters()
+    assert counters["serve.store.evict"] == 1
+    assert counters["serve.store.miss"] == 1
+    assert counters["serve.store.hit"] == 1
+
+
+def test_store_disk_layer_round_trip_and_digest_guard(tmp_path):
+    g = gnm_random_graph(40, 120, seed=5)
+    result = minimum_spanning_forest(g)
+    key = solve_cache_key(g)
+    ResultStore(capacity=4, disk_dir=str(tmp_path)).put(key, result)
+    # A cold process (fresh store, same dir) serves from disk.
+    cold = ResultStore(capacity=4, disk_dir=str(tmp_path))
+    got = cold.get(key, graph=g)
+    assert got is not None
+    assert got.total_weight == result.total_weight
+    assert np.array_equal(got.edge_ids, result.edge_ids)
+    assert BUS.counters()["serve.store.disk_hit"] == 1
+    # A different graph presented under the same key is refused.
+    other = gnm_random_graph(40, 120, seed=6)
+    assert ResultStore(capacity=4, disk_dir=str(tmp_path)).get(
+        key, graph=other
+    ) is None
+
+
+def test_store_disk_write_is_crash_consistent(tmp_path):
+    """A torn write (serve.store.save fault) must not poison the entry: the
+    .bak generation still serves."""
+    from distributed_ghs_implementation_tpu.utils.resilience import (
+        FAULTS,
+        InjectedFault,
+    )
+
+    g = gnm_random_graph(30, 90, seed=7)
+    result = minimum_spanning_forest(g)
+    key = solve_cache_key(g)
+    store = ResultStore(capacity=4, disk_dir=str(tmp_path))
+    store.put(key, result)
+    with FAULTS.inject("serve.store.save", kind="torn"):
+        with pytest.raises(InjectedFault):
+            store._disk_put(key, result)  # the raw writer does raise...
+    cold = ResultStore(capacity=4, disk_dir=str(tmp_path))
+    got = cold.get(key, graph=g)
+    assert got is not None and got.total_weight == result.total_weight
+    # ...but put() is write-behind: a torn write never fails the caller.
+    with FAULTS.inject("serve.store.save", kind="torn"):
+        store.put(key, result)
+    assert BUS.counters()["serve.store.disk_write_failed"] == 1
+
+
+# ----------------------------------------------------------------------
+# Scheduler
+# ----------------------------------------------------------------------
+def test_scheduler_single_flight_coalesces_duplicates(monkeypatch):
+    """Deterministic single-flight: the leader's solve blocks on an event
+    until every duplicate request has joined the flight, so all of them MUST
+    coalesce (no timing luck involved)."""
+    import time as _time
+
+    from distributed_ghs_implementation_tpu.serve import scheduler as sched_mod
+
+    g = gnm_random_graph(60, 180, seed=9)
+    gate = threading.Event()
+    real = sched_mod.minimum_spanning_forest
+
+    def blocking_solve(graph, **kwargs):
+        assert gate.wait(timeout=30)
+        return real(graph, **kwargs)
+
+    monkeypatch.setattr(sched_mod, "minimum_spanning_forest", blocking_solve)
+    sched = SolveScheduler(max_concurrent=2)
+    outcomes = []
+
+    def worker():
+        outcomes.append(sched.solve(g))
+
+    threads = [threading.Thread(target=worker) for _ in range(5)]
+    for t in threads:
+        t.start()
+    deadline = _time.monotonic() + 30
+    while (
+        BUS.counters().get("serve.scheduler.coalesced", 0) < 4
+        and _time.monotonic() < deadline
+    ):
+        _time.sleep(0.01)
+    gate.set()
+    for t in threads:
+        t.join()
+    sources = [s for _, s in outcomes]
+    assert sources.count("solved") == 1  # exactly one kernel dispatch
+    assert sources.count("coalesced") == 4
+    weights = {r.total_weight for r, _ in outcomes}
+    assert len(weights) == 1
+    assert BUS.counters()["serve.scheduler.coalesced"] == 4
+    # And afterwards it's a plain cache hit.
+    assert sched.solve(g)[1] == "cache"
+
+
+def test_scheduler_batch_dedups_by_content():
+    sched = SolveScheduler()
+    g1 = gnm_random_graph(40, 100, seed=1)
+    g1_again = Graph.from_edges(40, list(reversed(g1.edge_triples())))
+    g2 = gnm_random_graph(40, 100, seed=2)
+    out = sched.solve_batch([g1, g1_again, g2, g1])
+    assert [s for _, s in out] == ["solved", "coalesced", "solved", "coalesced"]
+    assert out[0][0].total_weight == out[1][0].total_weight
+
+
+def test_scheduler_miss_runs_supervised():
+    """Cache misses route through the resilience supervisor: a transient
+    injected fault retries instead of failing the request."""
+    from distributed_ghs_implementation_tpu.utils.resilience import FAULTS
+
+    g = gnm_random_graph(60, 180, seed=11)
+    sched = SolveScheduler()
+    with FAULTS.inject("resilience.attempt.device", times=1):
+        result, source = sched.solve(g)
+    assert source == "solved"
+    assert result.backend.startswith("supervised/")
+    attempts = [
+        rec[6]["outcome"] for rec in BUS.events()
+        if rec[1] == "resilience.attempt"
+    ]
+    assert attempts == ["transient", "ok"]
+
+
+# ----------------------------------------------------------------------
+# Service + JSONL protocol
+# ----------------------------------------------------------------------
+def test_service_solve_update_stats_round_trip():
+    svc = MSTService()
+    g = gnm_random_graph(80, 240, seed=13)
+    first = svc.handle({"op": "solve", "num_nodes": 80, "edges": _edges(g),
+                        "edges_out": True})
+    assert first["ok"] and first["source"] == "solved"
+    assert len(first["mst_edges"]) == first["num_edges_in_mst"]
+    repeat = svc.handle({"op": "solve", "num_nodes": 80, "edges": _edges(g)})
+    assert repeat["cached"] and repeat["source"] == "cache"
+    assert repeat["total_weight"] == first["total_weight"]
+
+    update = svc.handle({
+        "op": "update", "digest": first["digest"],
+        "updates": [{"kind": "insert", "u": 0, "v": 79, "w": 1}],
+    })
+    assert update["ok"] and update["mode"] == "incremental"
+    assert update["digest"] != first["digest"]
+    # The updated graph is itself cached now.
+    again = svc.handle({
+        "op": "update", "digest": update["digest"],
+        "updates": [{"kind": "delete", "u": 0, "v": 79}],
+    })
+    assert again["ok"] and again["total_weight"] == first["total_weight"]
+
+    stats = svc.handle({"op": "stats"})
+    assert stats["ok"]
+    assert stats["counters"]["serve.store.hit"] >= 1
+    assert stats["sessions"] >= 1
+
+
+def test_service_error_responses_keep_loop_alive():
+    svc = MSTService()
+    bad = svc.handle({"op": "nope"})
+    assert not bad["ok"] and "unknown op" in bad["error"]
+    missing = svc.handle({"op": "update", "digest": "beef", "updates": []})
+    assert not missing["ok"] and "no session" in missing["error"]
+    no_graph = svc.handle({"op": "solve"})
+    assert not no_graph["ok"]
+    assert BUS.counters()["serve.errors"] == 3
+
+
+def test_update_midbatch_failure_evicts_session(monkeypatch):
+    """An apply that dies after mutation began leaves state no client saw:
+    the session must be dropped. A pre-mutation validation error must NOT
+    drop it."""
+    from distributed_ghs_implementation_tpu.serve.dynamic import DynamicMST
+
+    svc = MSTService()
+    g = gnm_random_graph(20, 60, seed=33)
+    first = svc.handle({"op": "solve", "num_nodes": 20, "edges": _edges(g)})
+    digest = first["digest"]
+    # Solves park a lightweight seed; the first update materializes it.
+    assert not isinstance(svc._sessions[digest], DynamicMST)
+
+    # Validation error: session survives (and is now materialized).
+    bad = svc.handle({"op": "update", "digest": digest,
+                      "updates": [{"kind": "frobnicate", "u": 0, "v": 1}]})
+    assert not bad["ok"]
+    assert digest in svc._sessions
+    session = svc._sessions[digest]
+    assert isinstance(session, DynamicMST)
+
+    calls = []
+    orig = session._apply_one
+
+    def boom(upd):
+        if calls:
+            raise RuntimeError("boom mid-batch")
+        calls.append(1)
+        orig(upd)
+
+    monkeypatch.setattr(session, "_apply_one", boom)
+    failed = svc.handle({"op": "update", "digest": digest, "updates": [
+        {"kind": "insert", "u": 0, "v": 10, "w": 1},
+        {"kind": "insert", "u": 1, "v": 11, "w": 1},
+    ]})
+    assert not failed["ok"]
+    assert digest not in svc._sessions  # poisoned mid-batch: evicted
+    assert BUS.counters()["serve.sessions.poisoned"] == 1
+
+
+def test_update_result_cached_under_session_backend():
+    """A client pinned to a non-default backend must hit the cache for the
+    graph an update produced (the entry is keyed by the SESSION's backend,
+    not the service default)."""
+    svc = MSTService(backend="device")
+    edges = [[0, 1, 5], [1, 2, 6], [2, 3, 7]]
+    first = svc.handle({"op": "solve", "num_nodes": 4, "edges": edges,
+                        "backend": "sharded"})
+    assert first["ok"]
+    update = svc.handle({"op": "update", "digest": first["digest"],
+                         "updates": [{"kind": "insert", "u": 0, "v": 3, "w": 1}]})
+    assert update["ok"]
+    follow = svc.handle({"op": "solve", "num_nodes": 4,
+                         "edges": edges + [[0, 3, 1]], "backend": "sharded"})
+    assert follow["source"] == "cache"
+    assert follow["total_weight"] == update["total_weight"]
+
+
+def test_serve_loop_jsonl_protocol(tmp_path):
+    import io as _io
+
+    g = gnm_random_graph(30, 90, seed=15)
+    lines = [
+        json.dumps({"op": "solve", "num_nodes": 30, "edges": _edges(g)}),
+        "this is not json",
+        json.dumps({"op": "solve", "num_nodes": 30, "edges": _edges(g)}),
+        json.dumps({"op": "stats"}),
+        json.dumps({"op": "shutdown"}),
+        json.dumps({"op": "solve", "num_nodes": 30, "edges": _edges(g)}),
+    ]
+    out = _io.StringIO()
+    rc = serve_loop(_io.StringIO("\n".join(lines) + "\n"), out)
+    assert rc == 0
+    responses = [json.loads(ln) for ln in out.getvalue().splitlines()]
+    # The post-shutdown line was never processed.
+    assert len(responses) == 5
+    assert responses[0]["ok"] and responses[0]["source"] == "solved"
+    assert not responses[1]["ok"] and "bad JSON" in responses[1]["error"]
+    assert responses[2]["source"] == "cache"
+    assert responses[3]["op"] == "stats"
+    assert responses[4] == {"ok": True, "op": "shutdown"}
+
+
+def test_service_graph_path_solve(tmp_path):
+    from distributed_ghs_implementation_tpu.graphs import io as gio
+
+    g = gnm_random_graph(50, 150, seed=21)
+    path = gio.write_npz(g, str(tmp_path / "g.npz"))
+    svc = MSTService()
+    first = svc.handle({"op": "solve", "graph_path": path})
+    assert first["ok"]
+    inline = svc.handle({"op": "solve", "num_nodes": 50, "edges": _edges(g)})
+    assert inline["source"] == "cache"  # same content, same key
+
+
+# ----------------------------------------------------------------------
+# Acceptance: the warm-path proof
+# ----------------------------------------------------------------------
+def test_warm_path_repeat_solve_records_zero_solver_spans():
+    svc = MSTService()
+    g = gnm_random_graph(500, 2000, seed=23)
+    first = svc.handle({"op": "solve", "num_nodes": 500, "edges": _edges(g)})
+    assert first["ok"]
+    mark = BUS.mark()
+    repeat = svc.handle({"op": "solve", "num_nodes": 500, "edges": _edges(g)})
+    assert repeat["cached"]
+    warm_names = [rec[1] for rec in BUS.events_since(mark)]
+    assert not [n for n in warm_names if n.startswith("solver.")]
+    assert not [n for n in warm_names if n.startswith("resilience.")]
+    assert "serve.request" in warm_names
+
+
+def test_single_edge_insert_on_cached_10k_graph_is_incremental():
+    """The acceptance scenario: one insert on a cached 10k-node graph goes
+    through serve/dynamic.py — no full re-solve (bus counters + zero solver
+    spans) — and the weight matches networkx exactly."""
+    import networkx as nx
+
+    n = 10_000
+    g = gnm_random_graph(n, 30_000, seed=24)
+    svc = MSTService()
+    first = svc.handle({"op": "solve", "num_nodes": n, "edges": _edges(g)})
+    assert first["ok"]
+
+    mark = BUS.mark()
+    update = svc.handle({
+        "op": "update", "digest": first["digest"],
+        "updates": [{"kind": "insert", "u": 17, "v": 4242, "w": 1}],
+    })
+    assert update["ok"] and update["mode"] == "incremental"
+    counters = BUS.counters()
+    assert counters["serve.dynamic.incremental"] == 1
+    assert counters.get("serve.dynamic.resolve", 0) == 0
+    update_names = [rec[1] for rec in BUS.events_since(mark)]
+    assert not [x for x in update_names if x.startswith("solver.")]
+
+    nxg = nx.Graph()
+    nxg.add_nodes_from(range(n))
+    for a, b, c in zip(g.u, g.v, g.w):
+        nxg.add_edge(int(a), int(b), weight=int(c))
+    w17 = nxg[17][4242]["weight"] if nxg.has_edge(17, 4242) else None
+    nxg.add_edge(17, 4242, weight=1 if w17 is None else min(1, w17))
+    expect = nx.minimum_spanning_tree(nxg).size(weight="weight")
+    assert float(update["total_weight"]) == float(expect)
+
+
+# ----------------------------------------------------------------------
+# Satellites: run --metrics-out, serve CLI file input
+# ----------------------------------------------------------------------
+def test_run_metrics_out_emits_bench_gate_schema(tmp_path):
+    import sys as _sys
+
+    _sys.path.insert(0, "tools")
+    import bench_gate
+
+    from distributed_ghs_implementation_tpu.cli import main
+
+    gdir = str(tmp_path / "g")
+    assert main(["generate", "--kind", "gnm", "--nodes", "64", "--edges",
+                 "256", "--seed", "2", "--output-dir", gdir, "--npz"]) == 0
+    metrics = str(tmp_path / "metrics.json")
+    npz = f"{gdir}/graph.npz"
+    assert main(["run", "--graph-dir", npz, "--metrics-out", metrics]) == 0
+    with open(metrics) as f:
+        doc = json.load(f)
+    assert doc["schema"] == "ghs-bench-metrics-v1"
+    assert {"solve_s", "levels", "mst_weight", "mst_edges"} <= set(doc["metrics"])
+    # The file is self-comparable through the gate (identical run passes).
+    assert bench_gate.main(["--baseline", metrics, "--metrics", metrics]) == 0
+
+
+def test_serve_cli_input_file(tmp_path, capsys):
+    from distributed_ghs_implementation_tpu.cli import main
+
+    g = gnm_random_graph(20, 60, seed=31)
+    req = str(tmp_path / "req.jsonl")
+    with open(req, "w") as f:
+        f.write(json.dumps(
+            {"op": "solve", "num_nodes": 20, "edges": _edges(g)}) + "\n")
+        f.write(json.dumps({"op": "shutdown"}) + "\n")
+    assert main(["serve", "--input", req]) == 0
+    out = capsys.readouterr().out
+    responses = [json.loads(ln) for ln in out.splitlines()]
+    assert responses[0]["ok"] and responses[-1]["op"] == "shutdown"

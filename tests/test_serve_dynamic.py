@@ -1,0 +1,240 @@
+"""Incremental MST maintenance (serve/dynamic.py) vs the networkx oracle:
+randomized insert/delete/reweight streams with EVERY intermediate forest
+checked — weight parity against networkx, exact edge-set parity against a
+fresh solve (the (w, u, v) order makes the MSF unique). Long streams are
+``slow``; tier-1 keeps the 100-node ones."""
+
+import numpy as np
+import pytest
+
+from distributed_ghs_implementation_tpu.api import minimum_spanning_forest
+from distributed_ghs_implementation_tpu.graphs.edgelist import Graph
+from distributed_ghs_implementation_tpu.models.boruvka import solve_graph
+from distributed_ghs_implementation_tpu.serve.dynamic import DynamicMST, Update
+
+
+def _random_graph(rng, n, m, wmax=50):
+    return Graph.from_arrays(
+        n,
+        rng.integers(0, n, m),
+        rng.integers(0, n, m),
+        rng.integers(1, wmax + 1, m),
+    )
+
+
+def _nx_msf_weight(graph: Graph) -> float:
+    import networkx as nx
+
+    return nx.minimum_spanning_tree(graph.to_networkx()).size(weight="weight")
+
+
+def _random_update(rng, dyn: DynamicMST, n: int, wmax=50) -> Update:
+    kind = str(rng.choice(["insert", "delete", "reweight"]))
+    if kind in ("delete", "reweight") and dyn._u.size:
+        i = int(rng.integers(0, dyn._u.size))
+        a, b = int(dyn._u[i]), int(dyn._v[i])
+        if kind == "delete":
+            return Update("delete", a, b)
+        return Update("reweight", a, b, int(rng.integers(1, wmax + 1)))
+    a, b = (int(x) for x in rng.integers(0, n, 2))
+    while a == b:
+        a, b = (int(x) for x in rng.integers(0, n, 2))
+    return Update("insert", a, b, int(rng.integers(1, wmax + 1)))
+
+
+def _check_exact(dyn_result, context=""):
+    """The maintained forest must be byte-identical to a fresh solve."""
+    ids_ref, frag_ref, _ = solve_graph(dyn_result.graph)
+    assert np.array_equal(np.sort(dyn_result.edge_ids), np.sort(ids_ref)), context
+    assert dyn_result.num_components == int(np.unique(frag_ref).size), context
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2])
+def test_randomized_stream_100_nodes(seed):
+    rng = np.random.default_rng(100 + seed)
+    n = 100
+    g = _random_graph(rng, n, 300)
+    dyn = DynamicMST(minimum_spanning_forest(g), resolve_threshold=10**9)
+    for step in range(30):
+        upd = _random_update(rng, dyn, n)
+        result = dyn.apply([upd])
+        assert dyn.last_mode == "incremental"
+        assert abs(
+            float(result.total_weight) - _nx_msf_weight(result.graph)
+        ) < 1e-9, (seed, step, upd)
+        if step % 10 == 0:  # exact parity is the expensive check — sample it
+            _check_exact(result, (seed, step, upd))
+    _check_exact(dyn.result(), (seed, "final"))
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("seed", [0, 1])
+def test_randomized_stream_1k_nodes_long(seed):
+    rng = np.random.default_rng(1000 + seed)
+    n = 1000
+    g = _random_graph(rng, n, 4000, wmax=200)
+    dyn = DynamicMST(minimum_spanning_forest(g), resolve_threshold=10**9)
+    for step in range(120):
+        upd = _random_update(rng, dyn, n, wmax=200)
+        result = dyn.apply([upd])
+        assert dyn.last_mode == "incremental"
+        assert abs(
+            float(result.total_weight) - _nx_msf_weight(result.graph)
+        ) < 1e-9, (seed, step, upd)
+    _check_exact(dyn.result(), (seed, "final"))
+
+
+def test_mixed_batches_and_duplicate_edges():
+    rng = np.random.default_rng(7)
+    n = 120
+    g = _random_graph(rng, n, 400)
+    dyn = DynamicMST(minimum_spanning_forest(g), resolve_threshold=10**9)
+    for _ in range(6):
+        batch = [_random_update(rng, dyn, n) for _ in range(8)]
+        result = dyn.apply(batch)
+        assert abs(
+            float(result.total_weight) - _nx_msf_weight(result.graph)
+        ) < 1e-9
+    _check_exact(dyn.result())
+
+
+def test_insert_joins_components_delete_splits():
+    # Two disjoint triangles.
+    g = Graph.from_edges(6, [
+        (0, 1, 1), (1, 2, 2), (0, 2, 3),
+        (3, 4, 1), (4, 5, 2), (3, 5, 3),
+    ])
+    dyn = DynamicMST(minimum_spanning_forest(g))
+    assert dyn.num_components == 2
+    r = dyn.apply([Update("insert", 2, 3, 10)])
+    assert dyn.last_mode == "incremental"
+    assert r.num_components == 1
+    assert r.total_weight == 1 + 2 + 1 + 2 + 10
+    # Deleting the bridge splits again — no replacement exists.
+    r = dyn.apply([Update("delete", 2, 3)])
+    assert r.num_components == 2
+    assert r.total_weight == 6
+    _check_exact(r)
+
+
+def test_delete_tree_edge_picks_minimum_replacement():
+    # A 4-cycle with a chord: deleting a tree edge must pull in the cheapest
+    # crossing edge, not just any.
+    g = Graph.from_edges(4, [
+        (0, 1, 1), (1, 2, 2), (2, 3, 1), (0, 3, 10), (1, 3, 5),
+    ])
+    dyn = DynamicMST(minimum_spanning_forest(g))
+    r = dyn.apply([Update("delete", 1, 2)])
+    assert dyn.last_mode == "incremental"
+    assert r.total_weight == 1 + 1 + 5  # (1,3) chosen over (0,3)
+    _check_exact(r)
+
+
+def test_reweight_directions():
+    g = Graph.from_edges(4, [(0, 1, 1), (1, 2, 2), (2, 3, 3), (0, 3, 9)])
+    dyn = DynamicMST(minimum_spanning_forest(g))
+    # Up-weighting a tree edge past the non-tree alternative swaps them.
+    r = dyn.apply([Update("reweight", 2, 3, 20)])
+    assert r.total_weight == 1 + 2 + 9
+    # Down-weighting a (now) non-tree edge swaps back.
+    r = dyn.apply([Update("reweight", 2, 3, 3)])
+    assert r.total_weight == 1 + 2 + 3
+    # No-op directions change nothing.
+    r = dyn.apply([
+        Update("reweight", 0, 1, 1),   # tree edge, same weight
+        Update("reweight", 0, 3, 11),  # non-tree edge heavier
+    ])
+    assert r.total_weight == 1 + 2 + 3
+    _check_exact(r)
+
+
+def test_insert_existing_edge_is_reweight_and_delete_missing_is_noop():
+    g = Graph.from_edges(3, [(0, 1, 5), (1, 2, 6), (0, 2, 7)])
+    dyn = DynamicMST(minimum_spanning_forest(g))
+    r = dyn.apply([Update("insert", 0, 2, 1)])  # exists: reweight to 1
+    assert r.total_weight == 1 + 5
+    r = dyn.apply([Update("delete", 0, 1)])
+    before = r.total_weight
+    r = dyn.apply([Update("delete", 0, 1)])  # now absent: no-op
+    assert r.total_weight == before
+    _check_exact(r)
+
+
+def test_float_weight_promotes_dtype():
+    g = Graph.from_edges(3, [(0, 1, 5), (1, 2, 6), (0, 2, 7)])
+    dyn = DynamicMST(minimum_spanning_forest(g))
+    r = dyn.apply([Update("insert", 0, 2, 5.5)])
+    assert r.graph.w.dtype.kind == "f"
+    assert abs(float(r.total_weight) - _nx_msf_weight(r.graph)) < 1e-9
+
+
+def test_oversized_batch_falls_back_to_supervised_resolve():
+    from distributed_ghs_implementation_tpu.obs.events import BUS
+
+    BUS.enable()
+    BUS.clear()
+    rng = np.random.default_rng(11)
+    n = 80
+    g = _random_graph(rng, n, 240)
+    dyn = DynamicMST(minimum_spanning_forest(g), resolve_threshold=4)
+    batch = [_random_update(rng, dyn, n) for _ in range(12)]
+    result = dyn.apply(batch)
+    assert dyn.last_mode == "resolve"
+    assert result.backend == "serve/resolve"
+    assert BUS.counters()["serve.dynamic.resolve"] == 1
+    assert BUS.counters().get("serve.dynamic.incremental", 0) == 0
+    assert abs(float(result.total_weight) - _nx_msf_weight(result.graph)) < 1e-9
+    _check_exact(result)
+    BUS.clear()
+
+
+def test_verification_failure_triggers_resolve(monkeypatch):
+    from distributed_ghs_implementation_tpu.obs.events import BUS
+
+    BUS.enable()
+    BUS.clear()
+    g = Graph.from_edges(4, [(0, 1, 1), (1, 2, 2), (2, 3, 3), (0, 3, 9)])
+    dyn = DynamicMST(minimum_spanning_forest(g), resolve_threshold=10**9)
+    monkeypatch.setattr(dyn, "_forest_ok", lambda: False)
+    result = dyn.apply([Update("reweight", 0, 1, 2)])
+    assert dyn.last_mode == "resolve"
+    assert BUS.counters()["serve.dynamic.verify_failed"] == 1
+    assert result.total_weight == 2 + 2 + 3
+    BUS.clear()
+
+
+def test_forest_check_rejects_cyclic_and_nonmaximal_masks():
+    g = Graph.from_edges(4, [(0, 1, 1), (1, 2, 2), (0, 2, 3), (2, 3, 4)])
+    dyn = DynamicMST(minimum_spanning_forest(g))
+    assert dyn._forest_ok()
+    # Cycle on {0,1,2} leaving node 3 unspanned: same edge count as a
+    # spanning tree (t == n - k_graph), so only the tree-subgraph component
+    # check catches it.
+    dyn._in_tree = np.array([True, True, True, False])
+    assert not dyn._forest_ok()
+    # Non-maximal: too few edges for the graph's connectivity.
+    dyn._in_tree = np.array([True, True, False, False])
+    assert not dyn._forest_ok()
+
+
+def test_validation_rejects_bad_updates():
+    g = Graph.from_edges(3, [(0, 1, 5), (1, 2, 6)])
+    dyn = DynamicMST(minimum_spanning_forest(g))
+    with pytest.raises(ValueError, match="unknown update kind"):
+        dyn.apply([Update("frobnicate", 0, 1, 2)])
+    with pytest.raises(ValueError, match="out of range"):
+        dyn.apply([Update("insert", 0, 99, 2)])
+    with pytest.raises(ValueError, match="self-loop"):
+        dyn.apply([Update("insert", 1, 1, 2)])
+    with pytest.raises(ValueError, match="requires a weight"):
+        dyn.apply([Update("insert", 0, 2)])
+    with pytest.raises(ValueError, match="non-numeric weight"):
+        dyn.apply([Update("insert", 0, 2, "abc")])
+    with pytest.raises(ValueError, match="non-finite weight"):
+        dyn.apply([Update("insert", 0, 2, float("nan"))])
+    with pytest.raises(ValueError, match="non-finite weight"):
+        dyn.apply([Update("reweight", 0, 1, float("inf"))])
+    # Validation failures happen before any mutation: not dirty, still usable.
+    assert not dyn.dirty
+    r = dyn.apply([Update("insert", 0, 2, 4)])
+    assert r.total_weight == 5 + 4

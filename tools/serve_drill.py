@@ -1,0 +1,214 @@
+#!/usr/bin/env python
+"""Serve drill: drive the MST query service and check every answer.
+
+Two modes:
+
+* ``--smoke`` — the CI gate: start ``ghs serve`` as a subprocess, drive the
+  JSONL protocol over its pipes (solve -> update -> repeat the original
+  solve), and assert the repeat is answered from cache — both via the
+  response's ``cached`` flag and via the ``serve.store.hit`` counter in the
+  ``stats`` op (the obs-bus proof that no solver ran).
+* default — an in-process replay: a seeded random graph, then ``--updates``
+  random insert/delete/reweight requests through :class:`MSTService`, every
+  response's MST weight checked against the SciPy oracle on an
+  independently-maintained mirror of the edge set. ``--chaos`` arms
+  ``GHS_FAULT_*``-style faults first (supervisor retries on the miss path,
+  torn cache writes when ``--disk-cache`` is set), so the drill doubles as
+  the serving layer's game-day. Armed ``GHS_FAULT_*`` environment variables
+  are honored in both modes.
+
+Exit code 0 iff every check passed. ``--output`` writes a JSON report.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _seed_graph(nodes: int, edges: int, seed: int):
+    from distributed_ghs_implementation_tpu.graphs.generators import (
+        gnm_random_graph,
+    )
+
+    return gnm_random_graph(nodes, edges, seed=seed)
+
+
+def _graph_edges(g):
+    return [[int(a), int(b), int(c)] for a, b, c in zip(g.u, g.v, g.w)]
+
+
+def run_smoke(args) -> dict:
+    """solve -> update -> repeat-solve over the real CLI pipes."""
+    g = _seed_graph(args.nodes, args.edges, args.seed)
+    edges = _graph_edges(g)
+    requests = [
+        {"op": "solve", "num_nodes": g.num_nodes, "edges": edges},
+        {"op": "update", "digest": None, "updates": []},  # digest patched below
+        {"op": "solve", "num_nodes": g.num_nodes, "edges": edges},
+        {"op": "stats"},
+        {"op": "shutdown"},
+    ]
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "distributed_ghs_implementation_tpu", "serve"],
+        stdin=subprocess.PIPE,
+        stdout=subprocess.PIPE,
+        text=True,
+        env={**os.environ, "JAX_PLATFORMS": os.environ.get("JAX_PLATFORMS", "cpu")},
+    )
+
+    def roundtrip(request):
+        proc.stdin.write(json.dumps(request) + "\n")
+        proc.stdin.flush()
+        line = proc.stdout.readline()
+        if not line:
+            raise RuntimeError("serve process closed its pipe early")
+        return json.loads(line)
+
+    checks = []
+    try:
+        first = roundtrip(requests[0])
+        checks.append(("first solve ok", bool(first.get("ok"))))
+        checks.append(("first solve is a miss", first.get("source") == "solved"))
+        requests[1]["digest"] = first.get("digest")
+        requests[1]["updates"] = [
+            {"kind": "insert", "u": 0, "v": g.num_nodes - 1, "w": 1}
+        ]
+        update = roundtrip(requests[1])
+        checks.append(("update ok", bool(update.get("ok"))))
+        checks.append(("update incremental", update.get("mode") == "incremental"))
+        repeat = roundtrip(requests[2])
+        checks.append(("repeat solve ok", bool(repeat.get("ok"))))
+        checks.append(("repeat is a cache hit", repeat.get("cached") is True))
+        checks.append(
+            ("repeat weight stable",
+             repeat.get("total_weight") == first.get("total_weight"))
+        )
+        stats = roundtrip(requests[3])
+        hits = stats.get("counters", {}).get("serve.store.hit", 0)
+        checks.append(("obs counter saw the hit", hits >= 1))
+        roundtrip(requests[4])
+    finally:
+        proc.stdin.close()
+        proc.wait(timeout=60)
+    return {
+        "mode": "smoke",
+        "checks": [{"name": n, "ok": bool(ok)} for n, ok in checks],
+        "ok": all(ok for _, ok in checks),
+    }
+
+
+def run_replay(args) -> dict:
+    """In-process update-stream replay, every step checked vs the oracle."""
+    from distributed_ghs_implementation_tpu.graphs.edgelist import Graph
+    from distributed_ghs_implementation_tpu.serve.service import MSTService
+    from distributed_ghs_implementation_tpu.utils.resilience import FAULTS
+    from distributed_ghs_implementation_tpu.utils.verify import scipy_mst_weight
+
+    if args.chaos:
+        # The miss path must survive transient device failures (supervisor
+        # retry), and the persistent cache a torn write mid-save.
+        FAULTS.arm("resilience.attempt.device", times=1)
+        if args.disk_cache:
+            FAULTS.arm("serve.store.save", times=1, kind="torn")
+
+    service = MSTService(disk_dir=args.disk_cache)
+    g = _seed_graph(args.nodes, args.edges, args.seed)
+    mirror = {
+        (int(a), int(b)): int(c) for a, b, c in zip(g.u, g.v, g.w)
+    }
+    response = service.handle(
+        {"op": "solve", "num_nodes": g.num_nodes, "edges": _graph_edges(g)}
+    )
+    if not response.get("ok"):
+        return {"mode": "replay", "ok": False, "error": response.get("error")}
+    digest = response["digest"]
+
+    rng = np.random.default_rng(args.seed + 1)
+    steps = []
+    ok = True
+    for step in range(args.updates):
+        kind = str(rng.choice(["insert", "delete", "reweight"]))
+        if kind == "delete" and mirror:
+            a, b = list(mirror)[int(rng.integers(0, len(mirror)))]
+            upd = {"kind": "delete", "u": a, "v": b}
+            del mirror[(a, b)]
+        elif kind == "reweight" and mirror:
+            a, b = list(mirror)[int(rng.integers(0, len(mirror)))]
+            w = int(rng.integers(1, 100))
+            upd = {"kind": "reweight", "u": a, "v": b, "w": w}
+            mirror[(a, b)] = w
+        else:
+            a, b = sorted(int(x) for x in rng.integers(0, g.num_nodes, 2))
+            if a == b:
+                continue
+            w = int(rng.integers(1, 100))
+            upd = {"kind": "insert", "u": a, "v": b, "w": w}
+            mirror[(a, b)] = w  # insert of an existing edge is a reweight
+        response = service.handle(
+            {"op": "update", "digest": digest, "updates": [upd]}
+        )
+        if not response.get("ok"):
+            steps.append({"step": step, "update": upd,
+                          "error": response.get("error")})
+            ok = False
+            break
+        digest = response["digest"]
+        pairs = np.asarray(list(mirror), dtype=np.int64).reshape(-1, 2)
+        oracle_graph = Graph.from_arrays(
+            g.num_nodes, pairs[:, 0], pairs[:, 1],
+            np.asarray(list(mirror.values()), dtype=np.int64),
+        )
+        expect = scipy_mst_weight(oracle_graph) if mirror else 0.0
+        good = abs(float(response["total_weight"]) - float(expect)) < 1e-6
+        ok = ok and good
+        steps.append(
+            {"step": step, "update": upd, "mode": response.get("mode"),
+             "weight": response["total_weight"], "oracle": expect, "ok": good}
+        )
+    stats = service.handle({"op": "stats"})
+    return {
+        "mode": "replay",
+        "chaos": bool(args.chaos),
+        "ok": ok,
+        "steps_run": len(steps),
+        "counters": stats.get("counters", {}),
+        "failures": [s for s in steps if not s.get("ok", True)],
+    }
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(prog="serve_drill", description=__doc__)
+    p.add_argument("--smoke", action="store_true",
+                   help="CI smoke: subprocess + JSONL pipes + cache-hit assert")
+    p.add_argument("--chaos", action="store_true",
+                   help="arm fault sites before the replay")
+    p.add_argument("--nodes", type=int, default=300)
+    p.add_argument("--edges", type=int, default=1200)
+    p.add_argument("--seed", type=int, default=17)
+    p.add_argument("--updates", type=int, default=25)
+    p.add_argument("--disk-cache", help="persistent cache dir for the replay")
+    p.add_argument("--output", help="write the JSON report here")
+    args = p.parse_args(argv)
+
+    report = run_smoke(args) if args.smoke else run_replay(args)
+    if args.output:
+        with open(args.output, "w") as f:
+            json.dump(report, f, indent=2)
+            f.write("\n")
+    print(json.dumps(report if args.smoke else {
+        k: v for k, v in report.items() if k != "counters"
+    }, indent=2))
+    print(f"serve drill: {'PASS' if report['ok'] else 'FAIL'}")
+    return 0 if report["ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
