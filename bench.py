@@ -48,6 +48,94 @@ RECORDED_ORACLE_WEIGHTS = {
 }
 
 
+def run_batch_bench(args) -> int:
+    """Batched-serving throughput: graphs/sec over K lanes vs the
+    sequential miss path, on same-bucket small graphs.
+
+    This is the serving-fleet metric (ISSUE round 9): every graph here is
+    a distinct cache miss, so the sequential baseline is one device
+    dispatch per graph and the batched run is ``ceil(N / lanes)``
+    dispatches through ``batch/``. Both clocks are warm (compiles and
+    per-graph rank construction cached), every batched result is checked
+    edge-for-edge against its sequential counterpart, and the metrics land
+    in the same ``ghs-bench-metrics-v1`` schema so `tools/bench_gate.py`
+    gates them against a committed baseline
+    (``docs/BENCH_BASELINE_BATCH.json``).
+    """
+    import numpy as np
+
+    from distributed_ghs_implementation_tpu.api import (
+        minimum_spanning_forest,
+        minimum_spanning_forest_batch,
+    )
+    from distributed_ghs_implementation_tpu.batch.engine import BatchEngine
+    from distributed_ghs_implementation_tpu.batch.policy import BatchPolicy
+    from distributed_ghs_implementation_tpu.graphs.generators import gnm_random_graph
+
+    graphs = [
+        gnm_random_graph(args.batch_nodes, args.batch_edges, seed=SEED * 1000 + i)
+        for i in range(args.batch_graphs)
+    ]
+    engine = BatchEngine(policy=BatchPolicy(max_lanes=args.batch_lanes))
+
+    # Warm both paths: compiles and the per-graph cached rank order.
+    seq = [minimum_spanning_forest(g) for g in graphs]
+    minimum_spanning_forest_batch(graphs, engine=engine)
+
+    seq_times, batch_times = [], []
+    for _ in range(args.repeats):
+        t0 = time.perf_counter()
+        for g in graphs:
+            minimum_spanning_forest(g)
+        seq_times.append(time.perf_counter() - t0)
+        t0 = time.perf_counter()
+        batched = minimum_spanning_forest_batch(graphs, engine=engine)
+        batch_times.append(time.perf_counter() - t0)
+
+    for s, b in zip(seq, batched):
+        if not np.array_equal(s.edge_ids, b.edge_ids):
+            print("BATCH PARITY FAILED vs sequential solve", file=sys.stderr)
+            return 1
+    n = len(graphs)
+    seq_gps = n / min(seq_times)
+    batch_gps = n / min(batch_times)
+    speedup = batch_gps / seq_gps
+    total_weight = int(sum(r.total_weight for r in seq))
+    out = {
+        "metric": f"batched MST graphs/sec, gnm({args.batch_nodes},"
+        f"{args.batch_edges}) x {n}, {args.batch_lanes} lanes",
+        "value": round(batch_gps, 1),
+        "unit": "graphs/s",
+        "seq_graphs_per_sec": round(seq_gps, 1),
+        "batch_speedup": round(speedup, 2),
+        "parity": "edge-exact vs sequential",
+    }
+    print(json.dumps(out))
+    if args.metrics_out:
+        with open(args.metrics_out, "w") as f:
+            json.dump(
+                {
+                    "schema": "ghs-bench-metrics-v1",
+                    "config": {
+                        "workload": f"batch-gnm({args.batch_nodes},"
+                        f"{args.batch_edges})x{args.batch_graphs}"
+                        f"-lanes{args.batch_lanes}",
+                    },
+                    "metrics": {
+                        "batch_graphs_per_sec": batch_gps,
+                        "seq_graphs_per_sec": seq_gps,
+                        "batch_speedup": speedup,
+                        "batch_solve_s": min(batch_times),
+                        "mst_weight": total_weight,
+                    },
+                },
+                f,
+                indent=2,
+            )
+            f.write("\n")
+    return 0
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(description=__doc__)
     p.add_argument("--scale", type=int, default=24, help="RMAT scale (2^scale vertices)")
@@ -60,7 +148,18 @@ def main(argv=None) -> int:
         help="also write the run's metrics in the bench-gate schema "
         "(tools/bench_gate.py compares such files across runs)",
     )
+    p.add_argument(
+        "--batch-lanes", type=int, default=0,
+        help="measure batched small-graph serving throughput at this lane "
+        "count instead of the RMAT bench (0 = RMAT bench)",
+    )
+    p.add_argument("--batch-graphs", type=int, default=64,
+                   help="graphs in the batched workload")
+    p.add_argument("--batch-nodes", type=int, default=128)
+    p.add_argument("--batch-edges", type=int, default=480)
     args = p.parse_args(argv)
+    if args.batch_lanes:
+        return run_batch_bench(args)
 
     from distributed_ghs_implementation_tpu.api import minimum_spanning_forest
     from distributed_ghs_implementation_tpu.graphs.generators import rmat_graph

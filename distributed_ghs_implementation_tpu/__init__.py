@@ -63,6 +63,7 @@ from distributed_ghs_implementation_tpu.api import (
     GHSAlgorithm,
     MSTResult,
     minimum_spanning_forest,
+    minimum_spanning_forest_batch,
     minimum_spanning_tree,
 )
 from distributed_ghs_implementation_tpu.graphs.edgelist import Graph
@@ -74,6 +75,7 @@ __all__ = [
     "Graph",
     "MSTResult",
     "minimum_spanning_forest",
+    "minimum_spanning_forest_batch",
     "minimum_spanning_tree",
     "__version__",
 ]

@@ -162,6 +162,35 @@ def minimum_spanning_forest(
     )
 
 
+def minimum_spanning_forest_batch(
+    graphs,
+    *,
+    backend: str = "device",
+    policy=None,
+    engine=None,
+) -> List[MSTResult]:
+    """Solve many independent graphs, coalescing same-bucket ones into
+    single device dispatches (the ``batch/`` lane engine).
+
+    Results are in input order and edge-for-edge identical to per-graph
+    :func:`minimum_spanning_forest` — lane stacking is a disjoint union,
+    and the global rank order makes each graph's MSF unique. Graphs too
+    large to batch (``policy.admits`` is false) bypass to supervised
+    single-graph solves, as do all graphs on non-``device`` backends
+    (batching is a single-device dispatch optimization). Pass a
+    ``batch.BatchPolicy`` to tune lane count/bucket ceilings, or a
+    prebuilt ``batch.BatchEngine`` to share its queue and telemetry.
+    """
+    graphs = list(graphs)
+    if backend != "device":
+        return [minimum_spanning_forest(g, backend=backend) for g in graphs]
+    if engine is None:
+        from distributed_ghs_implementation_tpu.batch.engine import BatchEngine
+
+        engine = BatchEngine(policy=policy)
+    return engine.solve_many(graphs)
+
+
 def minimum_spanning_tree(graph: Graph, *, backend: str = "device") -> MSTResult:
     """Like :func:`minimum_spanning_forest` but requires a connected graph."""
     result = minimum_spanning_forest(graph, backend=backend)

@@ -1,0 +1,26 @@
+"""Bucketed, vmapped multi-graph batch execution (docs/BATCHING.md).
+
+Independent small-graph solve requests waste the chip one dispatch at a
+time: the padded kernel shapes are identical across same-bucket graphs, so
+K of them can ride one compiled program. ``lanes`` stacks same-bucket
+graphs into lanes and solves them in a single dispatch, ``policy`` decides
+what batches with what (and what bypasses), and ``engine`` owns the queue
+behind the serving scheduler's miss path.
+"""
+
+from distributed_ghs_implementation_tpu.batch.engine import BatchEngine
+from distributed_ghs_implementation_tpu.batch.lanes import (
+    bucket_key,
+    lane_compile_stats,
+    solve_lanes,
+)
+from distributed_ghs_implementation_tpu.batch.policy import BatchPolicy, FormedBatch
+
+__all__ = [
+    "BatchEngine",
+    "BatchPolicy",
+    "FormedBatch",
+    "bucket_key",
+    "lane_compile_stats",
+    "solve_lanes",
+]

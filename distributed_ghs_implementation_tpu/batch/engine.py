@@ -1,0 +1,308 @@
+"""BatchEngine: the queue + supervised execution behind batched solves.
+
+Two entry points share one execution core:
+
+* :meth:`BatchEngine.solve_many` — synchronous: a whole request list is
+  formed into batches immediately (no waiting) and solved; the serving
+  scheduler's ``solve_batch`` miss path and the public
+  ``minimum_spanning_forest_batch`` both land here.
+* :meth:`BatchEngine.submit` — asynchronous: one graph joins the forming
+  queue and waits up to ``policy.max_wait_s`` for same-bucket lane-mates
+  (a full bucket dispatches immediately); concurrent cache-miss ``solve``
+  requests coalesce into device batches this way.
+
+Execution is supervised in the round-6 spirit but batch-shaped: a formed
+batch retries on *transient* failure (same classification and backoff as
+``utils.resilience``), and when retries exhaust it degrades to per-lane
+single-graph solves under the full supervisor ladder — so one poisoned
+lane (or one injected ``batch.attempt`` fault) never fails its lane-mates,
+and every lane's incidents stay separately attributable. Non-transient
+errors raise immediately (programming errors must not be papered over).
+
+Telemetry (``batch.*`` on the obs bus — docs/OBSERVABILITY.md):
+``batch.solve`` spans; ``batch.batches.formed`` / ``batch.lanes.formed`` /
+``batch.bypass`` / ``batch.retry`` / ``batch.lane.fallback`` /
+``batch.compile.hit|miss`` counters; ``batch.fill_ratio`` and
+``batch.queue.wait_s`` histograms; ``batch.queue.depth`` samples.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+from distributed_ghs_implementation_tpu.api import (
+    MSTResult,
+    minimum_spanning_forest,
+)
+from distributed_ghs_implementation_tpu.batch.lanes import bucket_key, solve_lanes
+from distributed_ghs_implementation_tpu.batch.policy import BatchPolicy
+from distributed_ghs_implementation_tpu.graphs.edgelist import Graph
+from distributed_ghs_implementation_tpu.obs.events import BUS
+from distributed_ghs_implementation_tpu.utils.resilience import (
+    FAULTS,
+    IncidentLog,
+    Supervisor,
+    SupervisorConfig,
+    is_transient,
+)
+
+
+class PendingSolve:
+    """One submitted solve; ``wait()`` blocks until its batch lands."""
+
+    __slots__ = ("graph", "event", "result", "error", "enqueued_at")
+
+    def __init__(self, graph: Graph):
+        self.graph = graph
+        self.event = threading.Event()
+        self.result: Optional[MSTResult] = None
+        self.error: Optional[BaseException] = None
+        self.enqueued_at = time.monotonic()
+
+    def wait(self, timeout: Optional[float] = None) -> MSTResult:
+        if not self.event.wait(timeout):
+            raise TimeoutError("batched solve did not complete in time")
+        if self.error is not None:
+            raise self.error
+        assert self.result is not None
+        return self.result
+
+
+class BatchEngine:
+    """Forms, supervises, and unpacks multi-graph device batches."""
+
+    def __init__(
+        self,
+        *,
+        policy: Optional[BatchPolicy] = None,
+        supervisor_config: Optional[SupervisorConfig] = None,
+        clock=time.monotonic,
+        sleep=time.sleep,
+    ):
+        self.policy = policy or BatchPolicy()
+        self.config = supervisor_config or SupervisorConfig()
+        self._clock = clock
+        self._sleep = sleep
+        self._dispatch = threading.Lock()  # one device batch in flight
+        self._cv = threading.Condition()
+        self._queue: List[PendingSolve] = []
+        self._worker: Optional[threading.Thread] = None
+        self._closed = False
+
+    # ------------------------------------------------------------------
+    # Synchronous entry
+    # ------------------------------------------------------------------
+    def solve_many(self, graphs: Sequence[Graph]) -> List[MSTResult]:
+        """Solve a request list; results in input order.
+
+        Forms batches immediately (the caller already holds the whole
+        list, so there is nothing to wait for); non-admitted graphs bypass
+        to supervised single-graph solves.
+        """
+        graphs = list(graphs)
+        results: List[Optional[MSTResult]] = [None] * len(graphs)
+        batches, bypass = self.policy.form(graphs)
+        for fb in batches:
+            members = [graphs[i] for i in fb.indices]
+            for i, result in zip(fb.indices, self._solve_formed(members)):
+                results[i] = result
+        for i in bypass:
+            BUS.count("batch.bypass")
+            results[i] = self._solve_single(graphs[i])
+        return results  # type: ignore[return-value]
+
+    # ------------------------------------------------------------------
+    # Asynchronous entry (the scheduler's per-request miss path)
+    # ------------------------------------------------------------------
+    def submit(self, graph: Graph) -> PendingSolve:
+        """Queue one solve for lane-forming; returns a waitable handle.
+
+        Non-admitted graphs solve inline in the calling thread (there is
+        no batch to wait for) and return an already-completed handle.
+        """
+        pending = PendingSolve(graph)
+        pending.enqueued_at = self._clock()  # queue timing honors the
+        if not self.policy.admits(graph):    # injectable clock throughout
+            BUS.count("batch.bypass")
+            try:
+                pending.result = self._solve_single(graph)
+            except BaseException as e:  # noqa: BLE001 — delivered via wait()
+                pending.error = e
+            pending.event.set()
+            return pending
+        with self._cv:
+            if self._closed:
+                raise RuntimeError("BatchEngine is closed")
+            self._queue.append(pending)
+            BUS.sample("batch.queue.depth", len(self._queue))
+            if self._worker is None:
+                self._worker = threading.Thread(
+                    target=self._worker_loop, name="batch-engine", daemon=True
+                )
+                self._worker.start()
+            self._cv.notify_all()
+        return pending
+
+    def close(self) -> None:
+        """Stop accepting submissions and drain the queue."""
+        with self._cv:
+            self._closed = True
+            self._cv.notify_all()
+        if self._worker is not None:
+            self._worker.join(timeout=30)
+
+    # ------------------------------------------------------------------
+    # Worker: the forming window
+    # ------------------------------------------------------------------
+    def _take_batch(self) -> Optional[List[PendingSolve]]:
+        """Under the lock: pop a full bucket, or the oldest item's bucket
+        once its wait expires. ``None`` means keep waiting."""
+        if not self._queue:
+            return None
+        by_bucket: Dict[tuple, List[PendingSolve]] = {}
+        for p in self._queue:
+            by_bucket.setdefault(bucket_key(p.graph), []).append(p)
+        for members in by_bucket.values():
+            if len(members) >= self.policy.max_lanes:
+                return members[: self.policy.max_lanes]
+        oldest = self._queue[0]
+        if self._clock() - oldest.enqueued_at >= self.policy.max_wait_s:
+            members = by_bucket[bucket_key(oldest.graph)]
+            return members[: self.policy.max_lanes]
+        return None
+
+    def _worker_loop(self) -> None:
+        while True:
+            with self._cv:
+                batch = self._take_batch()
+                while batch is None:
+                    if self._closed and not self._queue:
+                        return
+                    if self._queue:
+                        headroom = self.policy.max_wait_s - (
+                            self._clock() - self._queue[0].enqueued_at
+                        )
+                        self._cv.wait(timeout=max(headroom, 0.0005))
+                    else:
+                        self._cv.wait()
+                    batch = self._take_batch()
+                for p in batch:
+                    self._queue.remove(p)
+                BUS.sample("batch.queue.depth", len(self._queue))
+            now = self._clock()
+            for p in batch:
+                BUS.record("batch.queue.wait_s", now - p.enqueued_at)
+            try:
+                results = self._solve_formed([p.graph for p in batch])
+                for p, result in zip(batch, results):
+                    p.result = result
+            except BaseException as e:  # noqa: BLE001 — delivered via wait()
+                for p in batch:
+                    p.error = e
+            finally:
+                for p in batch:
+                    p.event.set()
+
+    # ------------------------------------------------------------------
+    # Execution core
+    # ------------------------------------------------------------------
+    def _solve_formed(self, graphs: List[Graph]) -> List[MSTResult]:
+        """One same-bucket batch: lane solve with retry, then per-lane
+        fallback isolation. Results in input order."""
+        lanes = self.policy.max_lanes
+        n_pad, m_pad = bucket_key(graphs[0])
+        BUS.count("batch.batches.formed")
+        BUS.count("batch.lanes.formed", len(graphs))
+        BUS.record("batch.fill_ratio", len(graphs) / lanes)
+        log = IncidentLog()
+        with BUS.span(
+            "batch.solve", cat="batch",
+            bucket_n=n_pad, bucket_m=m_pad, lanes=len(graphs), max_lanes=lanes,
+        ) as span:
+            for attempt in range(1, self.config.retries_per_rung + 2):
+                t0 = self._clock()
+                try:
+                    FAULTS.fire("batch.attempt")
+                    with self._dispatch:
+                        solved = solve_lanes(
+                            graphs, lanes=lanes, mode=self.policy.mode
+                        )
+                except Exception as e:  # noqa: BLE001 — classified below
+                    if not is_transient(e):
+                        log.add(
+                            rung="batch", attempt=attempt, outcome="fatal",
+                            error=repr(e), elapsed_s=self._clock() - t0,
+                            site="batch.attempt",
+                        )
+                        raise
+                    retrying = attempt <= self.config.retries_per_rung
+                    backoff = 0.0
+                    if retrying:
+                        backoff = min(
+                            self.config.backoff_base_s * (2 ** (attempt - 1)),
+                            self.config.backoff_cap_s,
+                        )
+                    log.add(
+                        rung="batch", attempt=attempt, outcome="transient",
+                        error=repr(e), elapsed_s=self._clock() - t0,
+                        backoff_s=backoff, site="batch.attempt",
+                    )
+                    BUS.count("batch.retry")
+                    if retrying and backoff > 0:
+                        self._sleep(backoff)
+                    continue
+                wall = self._clock() - t0
+                log.add(
+                    rung="batch", attempt=attempt, outcome="ok", elapsed_s=wall
+                )
+                span.set(attempts=attempt, outcome="ok")
+                incidents = log if len(log) > 1 else None
+                return [
+                    self._lane_result(g, *out, wall=wall, incidents=incidents)
+                    for g, out in zip(graphs, solved)
+                ]
+            # Retries exhausted: isolate lanes — each graph gets its own
+            # supervised solve so one bad lane cannot fail its lane-mates.
+            span.set(outcome="lane_fallback")
+            return [self._fallback_lane(g, log) for g in graphs]
+
+    def _lane_result(
+        self, graph, edge_ids, fragment, levels, *, wall, incidents
+    ) -> MSTResult:
+        num_components = (
+            int(np.unique(fragment).size) if graph.num_nodes else 0
+        )
+        return MSTResult(
+            graph=graph,
+            edge_ids=edge_ids,
+            num_levels=levels,
+            wall_time_s=wall,
+            backend=f"batch/{self.policy.mode}",
+            num_components=num_components,
+            incidents=incidents,
+        )
+
+    def _fallback_lane(self, graph: Graph, batch_log: IncidentLog) -> MSTResult:
+        """One isolated lane after batch retries exhausted. The lane's
+        incident log keeps the batch-level failure records in front of its
+        own supervised attempts (records are already on the bus — they are
+        re-linked here, not re-emitted), so a degraded response tells the
+        whole story: the batch failed first, then this lane solved alone."""
+        BUS.count("batch.lane.fallback")
+        result = self._solve_single(graph)
+        merged = IncidentLog()
+        merged.records = list(batch_log.records)
+        if result.incidents is not None:
+            merged.records.extend(result.incidents.records)
+        result.incidents = merged
+        return result
+
+    def _solve_single(self, graph: Graph) -> MSTResult:
+        """The bypass/fallback path: one supervised single-graph solve."""
+        return minimum_spanning_forest(
+            graph, supervised=True, supervisor=Supervisor(self.config)
+        )

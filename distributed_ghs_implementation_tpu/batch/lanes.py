@@ -1,0 +1,208 @@
+"""Lane stacking: K same-bucket graphs through one compiled solve.
+
+``models/boruvka.py`` already pads every graph to power-of-two ``(n_pad,
+m_pad)`` buckets so same-bucket graphs share a compiled kernel — but the
+sharing is only ever *serial*: one dispatch per graph, and on small graphs
+the chip idles between dispatches. This module stacks K same-bucket graphs
+into lanes and solves all of them in ONE dispatch, two ways:
+
+* ``"fused"`` (default) — block-diagonal: lane ``i``'s vertices shift by
+  ``i * n_pad`` and its ranks by ``i * m_pad``, turning the batch into one
+  disjoint-union graph the existing flat kernel (``_solve_from_iota``)
+  solves unchanged. Fragments never cross lanes, and the rank shift is
+  order-preserving within a lane, so the MSF of the union is exactly the
+  per-lane MSFs. Measured ~4x graphs/sec over serial dispatch on
+  128-vertex graphs (CPU; the win is amortized per-op/dispatch overhead).
+* ``"vmap"`` — ``jax.vmap`` of the same iota solve over a leading lane
+  axis. The batched ``while_loop`` runs every lane to the slowest lane's
+  level count with per-carry selects, which on small graphs eats the
+  dispatch savings — kept as the straightforward formulation and for
+  accelerators where the selects are free, not as the default.
+
+Compiles are bounded by construction: the solver cache keys on
+``(n_pad, m_pad, lanes, mode)``, so traffic drawn from B shape buckets
+costs at most B compilations no matter how many batches run
+(``batch.compile.hit`` / ``batch.compile.miss`` count the cache traffic).
+"""
+
+from __future__ import annotations
+
+import functools
+import threading
+from typing import Dict, List, Sequence, Tuple
+
+import jax
+import numpy as np
+
+from distributed_ghs_implementation_tpu.graphs.edgelist import Graph
+from distributed_ghs_implementation_tpu.models.boruvka import (
+    _next_pow2,
+    _solve_from_iota,
+)
+from distributed_ghs_implementation_tpu.obs.events import BUS
+
+_INT32_MAX = np.iinfo(np.int32).max
+
+BucketKey = Tuple[int, int]  # (n_pad, m_pad)
+
+
+def bucket_key(graph: Graph) -> BucketKey:
+    """The compiled-shape bucket a graph pads into: ``(n_pad, m_pad)``.
+
+    This is the SAME padding ``prepare_device_arrays`` applies (vertices to
+    the next power of two, undirected ranks to the next power of two — edge
+    slots are always ``2 * m_pad``), so two graphs with equal keys stack
+    into interchangeable lanes. Empty dimensions bucket at 1.
+    """
+    return (_next_pow2(max(1, graph.num_nodes)), _next_pow2(max(1, graph.num_edges)))
+
+
+# ----------------------------------------------------------------------
+# Compile cache: (n_pad, m_pad, lanes, mode) -> solver callable
+# ----------------------------------------------------------------------
+_SOLVER_CACHE: Dict[Tuple[int, int, int, str], object] = {}
+_CACHE_LOCK = threading.Lock()
+
+
+def lane_compile_stats() -> dict:
+    """Counters mirror onto the bus; this is the direct view for drills."""
+    return {
+        "entries": len(_SOLVER_CACHE),
+        "keys": sorted(_SOLVER_CACHE),
+    }
+
+
+def _get_solver(n_pad: int, m_pad: int, lanes: int, mode: str):
+    key = (n_pad, m_pad, lanes, mode)
+    with _CACHE_LOCK:
+        fn = _SOLVER_CACHE.get(key)
+        if fn is not None:
+            BUS.count("batch.compile.hit")
+            return fn
+        BUS.count("batch.compile.miss")
+        if mode == "fused":
+            fn = functools.partial(_solve_from_iota, num_nodes=lanes * n_pad)
+        elif mode == "vmap":
+            fn = jax.jit(
+                jax.vmap(functools.partial(_solve_from_iota, num_nodes=n_pad))
+            )
+        else:
+            raise ValueError(f"unknown lane mode {mode!r}; expected fused|vmap")
+        _SOLVER_CACHE[key] = fn
+        return fn
+
+
+# ----------------------------------------------------------------------
+# Stacking
+# ----------------------------------------------------------------------
+def _stack_fused(graphs: Sequence[Graph], n_pad: int, m_pad: int, lanes: int):
+    """Block-diagonal layout: one flat disjoint-union graph.
+
+    Pads are kept inert exactly as in the single-graph layout, just shifted
+    into their lane's block: slot pads are lane-local self-edges, rank pads
+    stay at the INT32_MAX sentinel (NOT shifted — shifting would overflow
+    and, worse, make a pad comparable), endpoint pads are the lane's vertex
+    0 (never chosen). Unfilled lanes are all-pad: zero real edges, n_pad
+    isolated vertices that cost one union-find no-op per level.
+    """
+    e_pad = 2 * m_pad
+    src = np.empty(lanes * e_pad, np.int32)
+    dst = np.empty(lanes * e_pad, np.int32)
+    rank = np.full(lanes * e_pad, _INT32_MAX, np.int32)
+    ra = np.empty(lanes * m_pad, np.int32)
+    rb = np.empty(lanes * m_pad, np.int32)
+    for i in range(lanes):
+        voff = i * n_pad
+        es, ee = i * e_pad, (i + 1) * e_pad
+        rs, re = i * m_pad, (i + 1) * m_pad
+        if i < len(graphs):
+            s, d, r, a, b = graphs[i].rank_arrays(
+                pad_edges_to=e_pad, pad_ranks_to=m_pad
+            )
+            src[es:ee] = s + voff
+            dst[es:ee] = d + voff
+            rank[es:ee] = np.where(r == _INT32_MAX, _INT32_MAX, r + i * m_pad)
+            ra[rs:re] = a + voff
+            rb[rs:re] = b + voff
+        else:
+            src[es:ee] = voff
+            dst[es:ee] = voff
+            ra[rs:re] = voff
+            rb[rs:re] = voff
+    return src, dst, rank, ra, rb
+
+
+def _stack_vmap(graphs: Sequence[Graph], n_pad: int, m_pad: int, lanes: int):
+    """Leading-lane-axis layout ``(lanes, ...)`` for the vmapped solver."""
+    e_pad = 2 * m_pad
+    src = np.zeros((lanes, e_pad), np.int32)
+    dst = np.zeros((lanes, e_pad), np.int32)
+    rank = np.full((lanes, e_pad), _INT32_MAX, np.int32)
+    ra = np.zeros((lanes, m_pad), np.int32)
+    rb = np.zeros((lanes, m_pad), np.int32)
+    for i, g in enumerate(graphs):
+        s, d, r, a, b = g.rank_arrays(pad_edges_to=e_pad, pad_ranks_to=m_pad)
+        src[i], dst[i], rank[i], ra[i], rb[i] = s, d, r, a, b
+    return src, dst, rank, ra, rb
+
+
+# ----------------------------------------------------------------------
+# The batch solve
+# ----------------------------------------------------------------------
+def solve_lanes(
+    graphs: Sequence[Graph],
+    *,
+    lanes: int | None = None,
+    mode: str = "fused",
+) -> List[Tuple[np.ndarray, np.ndarray, int]]:
+    """Solve K same-bucket graphs in one dispatch.
+
+    Returns one ``(edge_ids, fragment, levels)`` per input graph, in order
+    — the exact contract of ``models.boruvka.solve_graph`` (edge ids index
+    ``graph.u/v/w``, sorted; fragment trimmed to ``num_nodes``). ``lanes``
+    (default ``len(graphs)``) fixes the stacked lane count; extra lanes are
+    inert padding, so a policy can pin ``lanes = max_lanes`` and keep ONE
+    compiled shape per bucket regardless of fill. In ``"fused"`` mode
+    ``levels`` is the shared batch level count (the slowest lane's); in
+    ``"vmap"`` mode it is per-lane.
+    """
+    if not graphs:
+        return []
+    lanes = len(graphs) if lanes is None else int(lanes)
+    if lanes < len(graphs):
+        raise ValueError(f"lanes={lanes} < {len(graphs)} graphs")
+    n_pad, m_pad = bucket_key(graphs[0])
+    for g in graphs[1:]:
+        if bucket_key(g) != (n_pad, m_pad):
+            raise ValueError(
+                f"mixed buckets in one lane stack: {bucket_key(g)} vs "
+                f"{(n_pad, m_pad)} (the policy must group by bucket)"
+            )
+    if lanes * n_pad >= _INT32_MAX or lanes * m_pad >= _INT32_MAX:
+        raise ValueError(
+            f"bucket ({n_pad}, {m_pad}) x {lanes} lanes exceeds int32 id "
+            "space; the policy should bypass graphs this large"
+        )
+    solver = _get_solver(n_pad, m_pad, lanes, mode)
+    if mode == "fused":
+        arrays = _stack_fused(graphs, n_pad, m_pad, lanes)
+    else:
+        arrays = _stack_vmap(graphs, n_pad, m_pad, lanes)
+    mst_ranks, fragment, levels = jax.device_get(solver(*arrays))
+
+    out: List[Tuple[np.ndarray, np.ndarray, int]] = []
+    if mode == "fused":
+        lane_ranks = np.asarray(mst_ranks).reshape(lanes, m_pad)
+        lane_frag = np.asarray(fragment).reshape(lanes, n_pad)
+        for i, g in enumerate(graphs):
+            ranks = np.nonzero(lane_ranks[i])[0]
+            edge_ids = np.sort(g.edge_id_of_rank(ranks))
+            frag = lane_frag[i, : g.num_nodes] - i * n_pad
+            out.append((edge_ids, frag.astype(np.int32), int(levels)))
+    else:
+        for i, g in enumerate(graphs):
+            ranks = np.nonzero(np.asarray(mst_ranks[i]))[0]
+            edge_ids = np.sort(g.edge_id_of_rank(ranks))
+            frag = np.asarray(fragment[i])[: g.num_nodes]
+            out.append((edge_ids, frag, int(np.asarray(levels)[i])))
+    return out

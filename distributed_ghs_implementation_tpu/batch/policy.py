@@ -1,0 +1,96 @@
+"""Admission + batch-forming policy: which solves batch, with whom, when.
+
+The policy is pure decision logic (no device work, no threads) so the
+engine's queueing and the API's one-shot batching share one rule set:
+
+* **Admission** — a graph batches only if its shape bucket is small enough
+  that lane-stacking wins; oversize graphs *bypass* to the existing
+  single-graph path (which routes big graphs to the rank solver anyway —
+  batching is a small-graph throughput play, and one RMAT-20 lane would
+  stall 15 small ones).
+* **Forming** — admitted graphs group by :func:`lanes.bucket_key` and chunk
+  into at most ``max_lanes`` lanes, preserving arrival order. Every formed
+  batch solves at exactly ``max_lanes`` lanes (unfilled lanes are inert
+  padding), so each bucket costs ONE compiled shape no matter how batches
+  fill — the fill ratio is telemetry (``batch.fill_ratio``), not a compile
+  key.
+* **Waiting** — ``max_wait_s`` bounds how long the engine's queue holds a
+  lone request open for lane-mates before dispatching it anyway.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Sequence, Tuple
+
+from distributed_ghs_implementation_tpu.batch.lanes import BucketKey, bucket_key
+from distributed_ghs_implementation_tpu.graphs.edgelist import Graph
+from distributed_ghs_implementation_tpu.models.boruvka import (
+    ELL_AUTO_EDGE_THRESHOLD,
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class FormedBatch:
+    """One dispatchable batch: same-bucket input positions, arrival order."""
+
+    key: BucketKey
+    indices: Tuple[int, ...]
+
+
+@dataclasses.dataclass(frozen=True)
+class BatchPolicy:
+    """Batching knobs (docs/BATCHING.md has the tuning guidance).
+
+    ``max_lanes`` — lanes per device batch (and the compiled lane count).
+    ``max_wait_s`` — queue hold time for an unfilled batch (engine only).
+    ``max_bucket_edges`` / ``max_bucket_nodes`` — admission ceiling; graphs
+    padding past either bypass to the single-graph path (the default edge
+    ceiling is the solver's own small-graph routing threshold, below which
+    the flat bucketed kernel — the one lanes stack — is the fast path).
+    ``mode`` — lane execution: ``"fused"`` block-diagonal or ``"vmap"``.
+    """
+
+    max_lanes: int = 16
+    max_wait_s: float = 0.002
+    max_bucket_edges: int = ELL_AUTO_EDGE_THRESHOLD
+    max_bucket_nodes: int = 1 << 16
+    mode: str = "fused"
+
+    def __post_init__(self):
+        if self.max_lanes < 1:
+            raise ValueError(f"max_lanes must be >= 1, got {self.max_lanes}")
+        if self.max_wait_s < 0:
+            raise ValueError(f"max_wait_s must be >= 0, got {self.max_wait_s}")
+        if self.mode not in ("fused", "vmap"):
+            raise ValueError(f"unknown lane mode {self.mode!r}")
+
+    def admits(self, graph: Graph) -> bool:
+        """Can this graph ride a lane (vs bypassing to the single path)?"""
+        n_pad, m_pad = bucket_key(graph)
+        return n_pad <= self.max_bucket_nodes and m_pad <= self.max_bucket_edges
+
+    def form(
+        self, graphs: Sequence[Graph]
+    ) -> Tuple[List[FormedBatch], List[int]]:
+        """Partition a request list into formed batches + bypass positions.
+
+        Returns ``(batches, bypass)`` where each :class:`FormedBatch` holds
+        input positions of one same-bucket chunk (at most ``max_lanes``)
+        and ``bypass`` holds positions of non-admitted graphs. Together
+        they cover every input exactly once.
+        """
+        groups: Dict[BucketKey, List[int]] = {}
+        bypass: List[int] = []
+        for i, g in enumerate(graphs):
+            if self.admits(g):
+                groups.setdefault(bucket_key(g), []).append(i)
+            else:
+                bypass.append(i)
+        batches: List[FormedBatch] = []
+        for key, members in groups.items():
+            for at in range(0, len(members), self.max_lanes):
+                batches.append(
+                    FormedBatch(key=key, indices=tuple(members[at:at + self.max_lanes]))
+                )
+        return batches, bypass

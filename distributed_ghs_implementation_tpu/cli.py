@@ -352,6 +352,7 @@ def _cmd_serve(args) -> int:
         disk_dir=args.disk_cache,
         max_concurrent=args.max_concurrent,
         resolve_threshold=args.resolve_threshold,
+        batch_lanes=args.batch_lanes,
     )
     if args.input:
         with open(args.input) as f:
@@ -369,6 +370,8 @@ def _cmd_bench(args) -> int:
         argv.append("--no-verify")
     if args.metrics_out:
         argv += ["--metrics-out", args.metrics_out]
+    if args.batch_lanes:
+        argv += ["--batch-lanes", str(args.batch_lanes)]
     return bench_mod.main(argv)
 
 
@@ -521,6 +524,11 @@ def build_parser() -> argparse.ArgumentParser:
         help="update batches larger than this re-solve instead of applying "
         "incrementally (default: max(64, edges/10))",
     )
+    srv.add_argument(
+        "--batch-lanes", type=int, default=0,
+        help="coalesce device-backend cache misses into multi-graph device "
+        "batches of up to this many lanes (0 = off; docs/BATCHING.md)",
+    )
     srv.add_argument("--input",
                      help="read JSONL requests from this file instead of stdin")
     srv.set_defaults(fn=_cmd_serve)
@@ -533,6 +541,12 @@ def build_parser() -> argparse.ArgumentParser:
     b.add_argument("--no-verify", action="store_true")
     b.add_argument("--metrics-out",
                    help="write bench-gate metrics JSON here (tools/bench_gate.py)")
+    b.add_argument(
+        "--batch-lanes", type=int, default=0,
+        help="instead of the RMAT bench, measure batched small-graph "
+        "throughput (graphs/sec) at this lane count vs the sequential "
+        "miss path (bench.py --batch-lanes)",
+    )
     b.set_defaults(fn=_cmd_bench)
     return p
 

@@ -11,15 +11,24 @@ Request handling for the serve path, in order:
    queries at exactly one kernel dispatch.
 3. **Admission bound** — distinct misses solve under a semaphore
    (``max_concurrent``); excess requests queue. ``serve.queue.depth`` is
-   sampled on every transition so traces show pressure over time.
+   sampled on every transition so traces show pressure over time. With a
+   batch engine attached the engine's own forming queue + serialized
+   dispatch is the capacity bound instead (holding the semaphore while
+   waiting for lane-mates would forbid the very coalescing the engine is
+   for).
 4. **Supervised solve** — every miss runs through the round-6 resilience
    supervisor (watchdog, bounded retry, the sharded->device->stepped->host
    degradation ladder), so one flaky device never fails a request that a
-   degraded rung can still answer exactly.
+   degraded rung can still answer exactly. With a batch engine, device
+   misses instead run the engine's batch-shaped supervision (batch retry,
+   then per-lane ladder fallback — ``batch/engine.py``).
 
 ``solve_batch`` is the micro-batching entry: it dedups a whole request list
-by key first, solves each unique key once, and fans the results back out —
-duplicates inside a batch cost a dict lookup, not a solve.
+by key, registers ONE flight per distinct missed digest *before any solving
+starts* (duplicates inside the batch — and concurrent ``solve`` callers —
+join that flight instead of racing it), then solves the distinct misses as
+a group: through the batch engine when attached (same-bucket misses share
+device dispatches), else sequentially.
 """
 
 from __future__ import annotations
@@ -54,11 +63,13 @@ class SolveScheduler:
         backend: str = "device",
         max_concurrent: int = 2,
         supervisor_config=None,
+        batch_engine=None,
     ):
         if max_concurrent < 1:
             raise ValueError(f"max_concurrent must be >= 1, got {max_concurrent}")
         self.store = store if store is not None else ResultStore()
         self.backend = backend
+        self.batch_engine = batch_engine
         self._supervisor_config = supervisor_config
         self._sem = threading.BoundedSemaphore(max_concurrent)
         self._flights: dict = {}
@@ -75,12 +86,7 @@ class SolveScheduler:
         if cached is not None:
             return cached, "cache"
 
-        with self._lock:
-            flight = self._flights.get(key)
-            leader = flight is None
-            if leader:
-                flight = self._flights[key] = _Flight()
-                BUS.sample("serve.queue.depth", len(self._flights))
+        flight, leader = self._join_or_lead(key)
         if not leader:
             BUS.count("serve.scheduler.coalesced")
             flight.event.wait()
@@ -96,34 +102,25 @@ class SolveScheduler:
             if cached is not None:
                 flight.result = cached
                 return cached, "cache"
-            with self._sem:
-                with BUS.span(
-                    "serve.solve", cat="serve", backend=backend,
-                    nodes=graph.num_nodes, edges=graph.num_edges,
-                ):
-                    flight.result = minimum_spanning_forest(
-                        graph, backend=backend, supervised=True,
-                        supervisor=self._make_supervisor(),
-                    )
+            flight.result = self._solve_miss(graph, backend)
             self.store.put(key, flight.result)
         except BaseException as e:
             flight.error = e
             raise
         finally:
-            with self._lock:
-                del self._flights[key]
-                BUS.sample("serve.queue.depth", len(self._flights))
-            flight.event.set()
+            self._land(key, flight)
         return flight.result, "solved"
 
     def solve_batch(
         self, graphs: Sequence[Graph], *, backend: Optional[str] = None
     ) -> List[Tuple[MSTResult, str]]:
-        """Solve a batch, deduplicating by content key first (micro-batching:
-        duplicates inside the batch resolve against the leader's result)."""
+        """Solve a batch, deduplicating by content key first: duplicates
+        inside the batch resolve against one flight (never race), and the
+        distinct misses solve as a group (coalescing into device batches
+        when the batch engine is attached)."""
         backend = backend or self.backend
+        keys: List[str] = []
         unique: dict = {}
-        keys = []
         for g in graphs:
             key = solve_cache_key(g, backend=backend)
             keys.append(key)
@@ -131,18 +128,126 @@ class SolveScheduler:
                 BUS.count("serve.scheduler.coalesced")
             else:
                 unique[key] = g
-        solved = {
-            key: self.solve(g, backend=backend) for key, g in unique.items()
-        }
+
+        outcome: dict = {}
+        leaders: list = []  # (key, graph, flight)
+        joiners: list = []  # (key, flight)
+        for key, g in unique.items():
+            cached = self.store.get(key, graph=g)
+            if cached is not None:
+                outcome[key] = (cached, "cache")
+                continue
+            flight, leader = self._join_or_lead(key)
+            if leader:
+                # Leadership double-check, as in solve().
+                cached = self.store.get(key, graph=g, record_miss=False)
+                if cached is not None:
+                    flight.result = cached
+                    self._land(key, flight)
+                    outcome[key] = (cached, "cache")
+                else:
+                    leaders.append((key, g, flight))
+            else:
+                joiners.append((key, flight))
+
+        if leaders:
+            try:
+                results = self._solve_misses(
+                    [g for _, g, _ in leaders], backend
+                )
+            except BaseException as e:
+                for key, _, flight in leaders:
+                    flight.error = e
+                    self._land(key, flight)
+                raise
+            try:
+                for (key, _, flight), result in zip(leaders, results):
+                    flight.result = result
+                    self.store.put(key, result)
+                    self._land(key, flight)
+                    outcome[key] = (result, "solved")
+            except BaseException as e:
+                # A raise mid-publish (e.g. KeyboardInterrupt) must not
+                # leak the remaining flights — a leaked flight blocks its
+                # joiners forever. Land every unlanded leader (with its
+                # result when the solve already succeeded).
+                for key, _, flight in leaders:
+                    if not flight.event.is_set():
+                        if flight.result is None:
+                            flight.error = e
+                        self._land(key, flight)
+                raise
+
+        for key, flight in joiners:
+            BUS.count("serve.scheduler.coalesced")
+            flight.event.wait()
+            if flight.error is not None:
+                raise flight.error
+            outcome[key] = (flight.result, "coalesced")
+
         out: List[Tuple[MSTResult, str]] = []
         first = set()
         for key in keys:
-            if key in first:
-                out.append((solved[key][0], "coalesced"))
-            else:
-                first.add(key)
-                out.append(solved[key])
+            result, source = outcome[key]
+            out.append((result, source) if key not in first else (result, "coalesced"))
+            first.add(key)
         return out
+
+    # ------------------------------------------------------------------
+    def _join_or_lead(self, key: str) -> Tuple[_Flight, bool]:
+        """Atomically join the in-flight solve for ``key`` or become its
+        leader; returns ``(flight, is_leader)``."""
+        with self._lock:
+            flight = self._flights.get(key)
+            if flight is not None:
+                return flight, False
+            flight = self._flights[key] = _Flight()
+            BUS.sample("serve.queue.depth", len(self._flights))
+            return flight, True
+
+    def _land(self, key: str, flight: _Flight) -> None:
+        """Retire a flight and wake its joiners."""
+        with self._lock:
+            del self._flights[key]
+            BUS.sample("serve.queue.depth", len(self._flights))
+        flight.event.set()
+
+    def _solve_miss(self, graph: Graph, backend: str) -> MSTResult:
+        """One cache miss: batch-engine submission (device backend) or a
+        semaphore-bounded supervised solve. Graphs the engine's policy
+        would bypass anyway (oversize) stay on the semaphore path — the
+        engine only replaces the admission bound for solves it actually
+        queues and serializes."""
+        if (
+            self.batch_engine is not None
+            and backend == "device"
+            and self.batch_engine.policy.admits(graph)
+        ):
+            with BUS.span(
+                "serve.solve", cat="serve", backend="batch",
+                nodes=graph.num_nodes, edges=graph.num_edges,
+            ):
+                return self.batch_engine.submit(graph).wait()
+        with self._sem:
+            with BUS.span(
+                "serve.solve", cat="serve", backend=backend,
+                nodes=graph.num_nodes, edges=graph.num_edges,
+            ):
+                return minimum_spanning_forest(
+                    graph, backend=backend, supervised=True,
+                    supervisor=self._make_supervisor(),
+                )
+
+    def _solve_misses(
+        self, graphs: List[Graph], backend: str
+    ) -> List[MSTResult]:
+        """The distinct misses of one batch, as a group."""
+        if self.batch_engine is not None and backend == "device":
+            with BUS.span(
+                "serve.solve", cat="serve", backend="batch", misses=len(graphs)
+            ):
+                return self.batch_engine.solve_many(graphs)
+        return [self._solve_miss(g, backend) for g in graphs]
 
     # ------------------------------------------------------------------
     def _make_supervisor(self):

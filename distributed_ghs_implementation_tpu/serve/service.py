@@ -54,12 +54,22 @@ class MSTService:
         max_concurrent: int = 2,
         resolve_threshold: Optional[int] = None,
         max_sessions: int = _MAX_SESSIONS,
+        batch_lanes: int = 0,
     ):
         self.store = store if store is not None else ResultStore(
             capacity=store_capacity, disk_dir=disk_dir
         )
+        # batch_lanes > 0 attaches the lane engine: device-backend cache
+        # misses coalesce into multi-graph batches (batch/engine.py).
+        engine = None
+        if batch_lanes > 0:
+            from distributed_ghs_implementation_tpu.batch.engine import BatchEngine
+            from distributed_ghs_implementation_tpu.batch.policy import BatchPolicy
+
+            engine = BatchEngine(policy=BatchPolicy(max_lanes=batch_lanes))
         self.scheduler = SolveScheduler(
-            self.store, backend=backend, max_concurrent=max_concurrent
+            self.store, backend=backend, max_concurrent=max_concurrent,
+            batch_engine=engine,
         )
         self.backend = backend
         self.resolve_threshold = resolve_threshold
@@ -165,7 +175,7 @@ class MSTService:
         counters = {
             name: value
             for name, value in BUS.counters().items()
-            if name.startswith("serve.")
+            if name.startswith(("serve.", "batch."))
         }
         return {
             "ok": True,

@@ -1,0 +1,504 @@
+"""batch/ — bucketed multi-graph lane execution (round 9).
+
+Gates: lane solves are edge-for-edge identical to per-graph sequential
+solves (both lane modes), compiles stay bounded by shape-bucket count,
+the policy forms/bypasses correctly, the engine isolates lane failures,
+concurrent scheduler misses coalesce into device batches, and in-batch
+duplicate digests share one flight.
+"""
+
+import threading
+
+import numpy as np
+import pytest
+
+from distributed_ghs_implementation_tpu.api import (
+    minimum_spanning_forest,
+    minimum_spanning_forest_batch,
+)
+from distributed_ghs_implementation_tpu.batch.engine import BatchEngine
+from distributed_ghs_implementation_tpu.batch.lanes import (
+    bucket_key,
+    solve_lanes,
+)
+from distributed_ghs_implementation_tpu.batch.policy import BatchPolicy
+from distributed_ghs_implementation_tpu.graphs.edgelist import Graph
+from distributed_ghs_implementation_tpu.graphs.generators import (
+    gnm_random_graph,
+    line_graph,
+)
+from distributed_ghs_implementation_tpu.obs.events import BUS
+from distributed_ghs_implementation_tpu.utils.resilience import (
+    FAULTS,
+    SupervisorConfig,
+)
+
+
+@pytest.fixture(autouse=True)
+def _clean_global_bus():
+    BUS.enable()
+    BUS.clear()
+    yield
+    BUS.enable()
+    BUS.clear()
+
+
+def _fast_config():
+    return SupervisorConfig(retries_per_rung=1, backoff_base_s=0.0)
+
+
+# ----------------------------------------------------------------------
+# Lanes
+# ----------------------------------------------------------------------
+def test_bucket_key_matches_device_padding():
+    g = gnm_random_graph(100, 300, seed=1)
+    assert bucket_key(g) == (128, 512)
+    assert bucket_key(Graph.from_edges(0, [])) == (1, 1)
+    assert bucket_key(line_graph(9)) == (16, 8)
+
+
+@pytest.mark.parametrize("mode", ["fused", "vmap"])
+def test_solve_lanes_parity_same_bucket(mode):
+    graphs = [gnm_random_graph(100, 300, seed=s) for s in range(6)]
+    outs = solve_lanes(graphs, mode=mode)
+    for g, (edge_ids, fragment, levels) in zip(graphs, outs):
+        seq = minimum_spanning_forest(g)
+        assert np.array_equal(edge_ids, seq.edge_ids)
+        assert fragment.shape == (g.num_nodes,)
+        # One root per component, in this graph's own vertex id space.
+        assert np.unique(fragment).size == seq.num_components
+        assert fragment.min() >= 0 and fragment.max() < g.num_nodes
+        assert levels >= 1
+
+
+@pytest.mark.parametrize("mode", ["fused", "vmap"])
+def test_solve_lanes_padded_lanes_are_inert(mode):
+    graphs = [gnm_random_graph(60, 150, seed=s) for s in range(3)]
+    padded = solve_lanes(graphs, lanes=8, mode=mode)
+    tight = solve_lanes(graphs, mode=mode)
+    for (a, _, _), (b, _, _) in zip(padded, tight):
+        assert np.array_equal(a, b)
+
+
+def test_solve_lanes_rejects_mixed_buckets_and_bad_lane_count():
+    a = gnm_random_graph(60, 150, seed=1)
+    b = gnm_random_graph(600, 1500, seed=2)
+    with pytest.raises(ValueError, match="mixed buckets"):
+        solve_lanes([a, b])
+    with pytest.raises(ValueError, match="lanes"):
+        solve_lanes([a, a], lanes=1)
+
+
+def test_compile_cache_bounded_by_bucket_count():
+    """>= 64 mixed graphs across B buckets cost at most B compilations —
+    the ISSUE 4 acceptance bound, measured on the compile-cache counter."""
+    rng = np.random.default_rng(5)
+    graphs = []
+    for i in range(64):
+        nodes = int(rng.choice([48, 96, 200, 400]))
+        graphs.append(
+            gnm_random_graph(nodes, int(rng.integers(nodes, 3 * nodes)),
+                             seed=1000 + i)
+        )
+    buckets = {bucket_key(g) for g in graphs}
+    mark_miss = BUS.counters().get("batch.compile.miss", 0)
+    engine = BatchEngine(policy=BatchPolicy(max_lanes=8))
+    results = engine.solve_many(graphs)
+    compiles = BUS.counters().get("batch.compile.miss", 0) - mark_miss
+    assert compiles <= len(buckets)
+    for g, r in zip(graphs, results):
+        assert np.array_equal(
+            r.edge_ids, minimum_spanning_forest(g).edge_ids
+        )
+
+
+# ----------------------------------------------------------------------
+# Parity property: mixed sizes, duplicates, forests, degenerates
+# ----------------------------------------------------------------------
+@pytest.mark.parametrize("seed", [0, 1, 2])
+def test_batch_vs_sequential_parity_property(seed):
+    rng = np.random.default_rng(seed)
+    graphs = []
+    for i in range(12):
+        n = int(rng.integers(8, 300))
+        m = int(rng.integers(0, max(1, min(2 * n, n * (n - 1) // 2))))
+        graphs.append(
+            gnm_random_graph(
+                n, m, seed=seed * 100 + i,
+                ensure_connected=bool(rng.integers(0, 2)),
+            )
+        )
+    graphs.append(graphs[rng.integers(0, len(graphs))])  # duplicate
+    graphs.append(Graph.from_edges(4, []))  # empty edge set
+    graphs.append(Graph.from_edges(1, []))  # single vertex
+    results = minimum_spanning_forest_batch(graphs)
+    assert len(results) == len(graphs)
+    for g, r in zip(graphs, results):
+        seq = minimum_spanning_forest(g)
+        assert r.graph is g
+        assert np.array_equal(r.edge_ids, seq.edge_ids)
+        assert r.num_components == seq.num_components
+        assert r.total_weight == seq.total_weight
+
+
+def test_batch_api_non_device_backend_falls_back_sequential():
+    graphs = [gnm_random_graph(30, 90, seed=s) for s in range(2)]
+    results = minimum_spanning_forest_batch(graphs, backend="host")
+    for g, r in zip(graphs, results):
+        seq = minimum_spanning_forest(g)
+        assert np.array_equal(r.edge_ids, seq.edge_ids)
+        assert BUS.counters().get("batch.batches.formed", 0) == 0
+
+
+# ----------------------------------------------------------------------
+# Policy
+# ----------------------------------------------------------------------
+def test_policy_forms_by_bucket_and_chunks_at_max_lanes():
+    policy = BatchPolicy(max_lanes=4)
+    small = [gnm_random_graph(60, 150, seed=s) for s in range(10)]
+    big = [gnm_random_graph(600, 1500, seed=s) for s in range(2)]
+    graphs = small[:5] + big + small[5:]
+    batches, bypass = policy.form(graphs)
+    assert bypass == []
+    covered = sorted(i for fb in batches for i in fb.indices)
+    assert covered == list(range(len(graphs)))
+    assert all(len(fb.indices) <= 4 for fb in batches)
+    for fb in batches:
+        assert len({bucket_key(graphs[i]) for i in fb.indices}) == 1
+    # 10 small (3 chunks of 4/4/2) + 2 big (1 chunk).
+    assert len(batches) == 4
+
+
+def test_policy_oversize_bypass():
+    policy = BatchPolicy(max_bucket_nodes=64, max_bucket_edges=256)
+    ok = gnm_random_graph(50, 120, seed=1)
+    too_many_nodes = gnm_random_graph(100, 120, seed=2)
+    too_many_edges = gnm_random_graph(50, 400, seed=3)
+    assert policy.admits(ok)
+    assert not policy.admits(too_many_nodes)
+    assert not policy.admits(too_many_edges)
+    batches, bypass = policy.form([ok, too_many_nodes, too_many_edges])
+    assert bypass == [1, 2]
+    assert [fb.indices for fb in batches] == [(0,)]
+
+
+def test_policy_validation():
+    with pytest.raises(ValueError):
+        BatchPolicy(max_lanes=0)
+    with pytest.raises(ValueError):
+        BatchPolicy(max_wait_s=-1)
+    with pytest.raises(ValueError):
+        BatchPolicy(mode="turbo")
+
+
+# ----------------------------------------------------------------------
+# Engine
+# ----------------------------------------------------------------------
+def test_engine_oversize_bypass_counts_and_solves():
+    policy = BatchPolicy(max_lanes=4, max_bucket_nodes=64, max_bucket_edges=256)
+    engine = BatchEngine(policy=policy, supervisor_config=_fast_config())
+    graphs = [gnm_random_graph(50, 120, seed=1),
+              gnm_random_graph(300, 900, seed=2)]
+    results = engine.solve_many(graphs)
+    assert BUS.counters()["batch.bypass"] == 1
+    assert results[0].backend == "batch/fused"
+    assert results[1].backend.startswith("supervised/")
+    for g, r in zip(graphs, results):
+        assert np.array_equal(
+            r.edge_ids, minimum_spanning_forest(g).edge_ids
+        )
+
+
+def test_engine_retries_transient_batch_fault():
+    engine = BatchEngine(
+        policy=BatchPolicy(max_lanes=4),
+        supervisor_config=_fast_config(),
+    )
+    graphs = [gnm_random_graph(40, 100, seed=s) for s in range(3)]
+    with FAULTS.inject("batch.attempt", times=1):
+        results = engine.solve_many(graphs)
+    assert BUS.counters()["batch.retry"] == 1
+    for g, r in zip(graphs, results):
+        assert np.array_equal(
+            r.edge_ids, minimum_spanning_forest(g).edge_ids
+        )
+        # The retried attempt is visible on every lane's incident log.
+        assert r.incidents is not None
+        assert [rec.outcome for rec in r.incidents.records] == [
+            "transient", "ok"
+        ]
+
+
+def test_engine_exhausted_batch_falls_back_per_lane():
+    """Per-lane isolation: when every batch attempt fails, each lane solves
+    alone under the supervisor — one poisoned batch never fails requests."""
+    engine = BatchEngine(
+        policy=BatchPolicy(max_lanes=4),
+        supervisor_config=_fast_config(),
+    )
+    graphs = [gnm_random_graph(40, 100, seed=s) for s in range(3)]
+    with FAULTS.inject("batch.attempt", times=10):
+        results = engine.solve_many(graphs)
+    counters = BUS.counters()
+    assert counters["batch.lane.fallback"] == 3
+    for g, r in zip(graphs, results):
+        assert np.array_equal(
+            r.edge_ids, minimum_spanning_forest(g).edge_ids
+        )
+        assert r.backend.startswith("supervised/")
+
+
+def test_engine_nontransient_error_raises():
+    engine = BatchEngine(policy=BatchPolicy(max_lanes=4))
+    graphs = [gnm_random_graph(40, 100, seed=1)]
+
+    def boom(*a, **k):
+        raise ValueError("programming error")
+
+    import distributed_ghs_implementation_tpu.batch.engine as eng_mod
+
+    orig = eng_mod.solve_lanes
+    eng_mod.solve_lanes = boom
+    try:
+        with pytest.raises(ValueError, match="programming error"):
+            engine.solve_many(graphs)
+    finally:
+        eng_mod.solve_lanes = orig
+
+
+def test_engine_submit_coalesces_concurrent_misses():
+    """A full bucket dispatches immediately: K concurrent submits form ONE
+    device batch (deterministic — no timing luck, the forming window only
+    closes when the bucket fills or the generous wait expires)."""
+    engine = BatchEngine(
+        policy=BatchPolicy(max_lanes=4, max_wait_s=30.0),
+        supervisor_config=_fast_config(),
+    )
+    try:
+        graphs = [gnm_random_graph(40, 100, seed=s) for s in range(4)]
+        pendings = [engine.submit(g) for g in graphs]
+        results = [p.wait(timeout=60) for p in pendings]
+        counters = BUS.counters()
+        assert counters["batch.batches.formed"] == 1
+        assert counters["batch.lanes.formed"] == 4
+        for g, r in zip(graphs, results):
+            assert np.array_equal(
+                r.edge_ids, minimum_spanning_forest(g).edge_ids
+            )
+    finally:
+        engine.close()
+
+
+def test_engine_submit_lone_request_dispatches_after_wait():
+    engine = BatchEngine(
+        policy=BatchPolicy(max_lanes=8, max_wait_s=0.01),
+        supervisor_config=_fast_config(),
+    )
+    try:
+        g = gnm_random_graph(40, 100, seed=9)
+        result = engine.submit(g).wait(timeout=60)
+        assert np.array_equal(
+            result.edge_ids, minimum_spanning_forest(g).edge_ids
+        )
+        assert BUS.counters()["batch.batches.formed"] == 1
+    finally:
+        engine.close()
+
+
+# ----------------------------------------------------------------------
+# Scheduler integration
+# ----------------------------------------------------------------------
+def test_scheduler_batch_engine_miss_path():
+    from distributed_ghs_implementation_tpu.serve.scheduler import SolveScheduler
+
+    engine = BatchEngine(
+        policy=BatchPolicy(max_lanes=4, max_wait_s=0.005),
+        supervisor_config=_fast_config(),
+    )
+    try:
+        sched = SolveScheduler(batch_engine=engine)
+        g = gnm_random_graph(50, 150, seed=3)
+        result, source = sched.solve(g)
+        assert source == "solved"
+        assert result.backend == "batch/fused"
+        assert sched.solve(g)[1] == "cache"
+    finally:
+        engine.close()
+
+
+def test_scheduler_solve_batch_duplicates_share_one_flight():
+    """The round-9 satellite: duplicate digests inside one batch resolve
+    against a single flight — exactly one solve per distinct digest, even
+    when the duplicates are interleaved."""
+    from distributed_ghs_implementation_tpu.serve.scheduler import SolveScheduler
+
+    engine = BatchEngine(
+        policy=BatchPolicy(max_lanes=4),
+        supervisor_config=_fast_config(),
+    )
+    try:
+        sched = SolveScheduler(batch_engine=engine)
+        g1 = gnm_random_graph(40, 100, seed=1)
+        g1_again = Graph.from_edges(40, list(reversed(g1.edge_triples())))
+        g2 = gnm_random_graph(40, 100, seed=2)
+        out = sched.solve_batch([g1, g1_again, g2, g1])
+        assert [s for _, s in out] == [
+            "solved", "coalesced", "solved", "coalesced"
+        ]
+        assert out[0][0].total_weight == out[1][0].total_weight
+        # Exactly one device batch carried both distinct digests.
+        assert BUS.counters()["batch.batches.formed"] == 1
+        assert BUS.counters()["batch.lanes.formed"] == 2
+    finally:
+        engine.close()
+
+
+def test_scheduler_solve_batch_joins_inflight_solve():
+    """A batch arriving while another thread already leads a flight for one
+    of its digests joins that flight instead of re-solving."""
+    import time as _time
+
+    from distributed_ghs_implementation_tpu.serve import scheduler as sched_mod
+    from distributed_ghs_implementation_tpu.serve.scheduler import SolveScheduler
+
+    g_shared = gnm_random_graph(40, 100, seed=7)
+    g_other = gnm_random_graph(40, 100, seed=8)
+    gate = threading.Event()
+    entries: list = []
+    real = sched_mod.minimum_spanning_forest
+
+    def blocking_solve(graph, **kwargs):
+        entries.append(graph)
+        assert gate.wait(timeout=30)
+        return real(graph, **kwargs)
+
+    sched_mod.minimum_spanning_forest = blocking_solve
+    try:
+        sched = SolveScheduler()
+        solo: list = []
+        t = threading.Thread(
+            target=lambda: solo.append(sched.solve(g_shared))
+        )
+        t.start()
+        deadline = _time.monotonic() + 30
+        while not entries and _time.monotonic() < deadline:
+            _time.sleep(0.01)
+        assert entries  # the solo flight is in blocking_solve, unlanded
+        batch_out: list = []
+        t2 = threading.Thread(
+            target=lambda: batch_out.append(
+                sched.solve_batch([g_shared, g_other])
+            )
+        )
+        t2.start()
+        # The batch joins the live g_shared flight structurally (its join
+        # pass runs before any solving) and leads only g_other — wait for
+        # that second solver entry, then release both.
+        while len(entries) < 2 and _time.monotonic() < deadline:
+            _time.sleep(0.01)
+        assert len(entries) == 2
+        gate.set()
+        t.join(timeout=60)
+        t2.join(timeout=60)
+        assert solo[0][1] == "solved"
+        sources = dict(zip(["shared", "other"], [s for _, s in batch_out[0]]))
+        assert sources["shared"] == "coalesced"
+        assert sources["other"] == "solved"
+    finally:
+        sched_mod.minimum_spanning_forest = real
+
+
+def test_service_with_batch_lanes_end_to_end():
+    from distributed_ghs_implementation_tpu.serve.service import MSTService
+
+    svc = MSTService(batch_lanes=4)
+
+    def edges(g):
+        return [[int(a), int(b), int(c)] for a, b, c in zip(g.u, g.v, g.w)]
+
+    g = gnm_random_graph(60, 180, seed=11)
+    first = svc.handle({"op": "solve", "num_nodes": 60, "edges": edges(g)})
+    assert first["ok"] and first["source"] == "solved"
+    assert first["backend"] == "batch/fused"
+    repeat = svc.handle({"op": "solve", "num_nodes": 60, "edges": edges(g)})
+    assert repeat["source"] == "cache"
+    assert repeat["total_weight"] == first["total_weight"]
+    seq = minimum_spanning_forest(g)
+    assert first["total_weight"] == seq.total_weight
+    # batch.* counters surface through the stats op.
+    stats = svc.handle({"op": "stats"})
+    assert stats["counters"]["batch.lanes.formed"] >= 1
+
+
+def test_scheduler_oversize_miss_keeps_semaphore_path():
+    """An engine-attached scheduler must NOT route misses the engine's
+    policy would bypass through the unbounded submit() shortcut — oversize
+    graphs stay on the semaphore-bounded supervised path."""
+    from distributed_ghs_implementation_tpu.serve.scheduler import SolveScheduler
+
+    engine = BatchEngine(
+        policy=BatchPolicy(
+            max_lanes=4, max_bucket_nodes=32, max_bucket_edges=64
+        ),
+        supervisor_config=_fast_config(),
+    )
+    try:
+        sched = SolveScheduler(
+            batch_engine=engine, supervisor_config=_fast_config()
+        )
+        big = gnm_random_graph(100, 300, seed=4)
+        result, source = sched.solve(big)
+        assert source == "solved"
+        assert result.backend.startswith("supervised/")
+        assert BUS.counters().get("batch.batches.formed", 0) == 0
+        assert BUS.counters().get("batch.bypass", 0) == 0  # never submitted
+    finally:
+        engine.close()
+
+
+def test_scheduler_solve_batch_lands_flights_when_publish_raises():
+    """A raise mid-publish (store.put blowing up on leader 1 of 2) must
+    still land every leader's flight — a leaked flight would block all
+    future requests for that digest forever."""
+    from distributed_ghs_implementation_tpu.serve.scheduler import SolveScheduler
+
+    sched = SolveScheduler()
+    g1 = gnm_random_graph(40, 100, seed=21)
+    g2 = gnm_random_graph(40, 100, seed=22)
+    real_put = sched.store.put
+    calls = []
+
+    def failing_put(key, result):
+        calls.append(key)
+        raise RuntimeError("store exploded")
+
+    sched.store.put = failing_put
+    try:
+        with pytest.raises(RuntimeError, match="store exploded"):
+            sched.solve_batch([g1, g2])
+    finally:
+        sched.store.put = real_put
+    assert len(calls) == 1  # died on the first leader
+    assert sched._flights == {}  # nothing leaked
+    # The digests are solvable again (fresh flights, no hang).
+    out = sched.solve_batch([g1, g2])
+    assert [s for _, s in out] == ["solved", "solved"]
+
+
+def test_fallback_lane_incidents_include_batch_attempts():
+    """A degraded lane's incident log starts with the batch-level failures
+    that caused the fallback, then its own supervised attempts."""
+    engine = BatchEngine(
+        policy=BatchPolicy(max_lanes=4),
+        supervisor_config=_fast_config(),
+    )
+    graphs = [gnm_random_graph(40, 100, seed=s) for s in range(2)]
+    with FAULTS.inject("batch.attempt", times=10):
+        results = engine.solve_many(graphs)
+    for r in results:
+        assert r.incidents is not None
+        rungs = [rec.rung for rec in r.incidents.records]
+        assert rungs[:2] == ["batch", "batch"]  # first try + retry
+        assert rungs[-1] == "device"
+        assert r.incidents.records[-1].outcome == "ok"
+        assert r.incidents.final_rung == "device"

@@ -588,3 +588,46 @@ def test_host_level1_matches_device(case):
         rs._device_level1(jnp.asarray(vmin0), jnp.asarray(ra), jnp.asarray(rb))
     )
     assert np.array_equal(host, dev)
+
+
+# ----------------------------------------------------------------------
+# Shape-bucket helpers (round 9): the batch engine keys its lane stacking
+# and compile cache on these, so their boundary behavior is load-bearing.
+# ----------------------------------------------------------------------
+def test_next_pow2_boundaries():
+    from distributed_ghs_implementation_tpu.models.boruvka import _next_pow2
+
+    assert _next_pow2(0) == 1
+    assert _next_pow2(1) == 1
+    assert _next_pow2(2) == 2
+    assert _next_pow2(3) == 4
+    # Exact powers of two are fixed points; just-over doubles.
+    for k in range(1, 24):
+        p = 1 << k
+        assert _next_pow2(p) == p
+        assert _next_pow2(p + 1) == 2 * p
+        assert _next_pow2(p - 1) == p if p > 2 else True
+
+
+def test_bucket_size_boundaries():
+    from distributed_ghs_implementation_tpu.models.boruvka import _bucket_size
+
+    # Tiny sizes pass through (no padding below the quarter-step regime).
+    assert [_bucket_size(x) for x in range(0, 5)] == [1, 1, 2, 3, 4]
+    # Quarter steps: {1, 1.25, 1.5, 1.75} * 2^k.
+    assert _bucket_size(5) == 5    # 1.25 * 4
+    assert _bucket_size(6) == 6    # 1.5 * 4
+    assert _bucket_size(7) == 7    # 1.75 * 4
+    assert _bucket_size(8) == 8    # exact power of two is a fixed point
+    assert _bucket_size(9) == 10   # 1.25 * 8
+    assert _bucket_size(11) == 12
+    assert _bucket_size(13) == 14
+    assert _bucket_size(15) == 16
+    for k in range(3, 24):
+        p = 1 << k
+        assert _bucket_size(p) == p
+        assert _bucket_size(p + 1) == 5 * (p >> 2)  # 1.25x the next pow2's half
+    # Contract over a dense range: covers x, wastes at most 25%.
+    for x in range(1, 4097):
+        b = _bucket_size(x)
+        assert x <= b <= max(x + 1, (x * 5 + 3) // 4)

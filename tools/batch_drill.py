@@ -1,0 +1,236 @@
+#!/usr/bin/env python
+"""Batch drill: mixed-size batched solving checked for parity + isolation.
+
+Two modes, both exit 0 iff every check passed (``--output`` writes JSON):
+
+* ``--smoke`` — the CI gate for the lane engine's core contract: a mixed
+  batch of >= 64 random graphs (several shape buckets, duplicates, a
+  disconnected forest, an empty edge set, an oversize bypass) solved via
+  ``minimum_spanning_forest_batch`` must be (a) edge-for-edge identical to
+  per-graph sequential ``minimum_spanning_forest``, and (b) compiled at
+  most once per distinct shape bucket (``batch.compile.miss`` counts it).
+  The same traffic then replays through a ``batch_lanes``-enabled
+  ``MSTService`` scheduler to prove in-batch duplicate digests coalesce to
+  one flight and the cache absorbs the repeat.
+* ``--chaos`` — per-lane incident isolation: with the ``batch.attempt``
+  fault armed (and a transient device fault for the fallback path), every
+  batch attempt fails, the engine degrades to per-lane supervised solves,
+  and every result must STILL be oracle-exact with its incidents recorded
+  per lane. Armed ``GHS_FAULT_*`` environment variables are honored on
+  top of the drill's own arming.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _mixed_graphs(seed: int, count: int):
+    """>= ``count`` graphs over several buckets + structural edge cases."""
+    from distributed_ghs_implementation_tpu.graphs.edgelist import Graph
+    from distributed_ghs_implementation_tpu.graphs.generators import (
+        gnm_random_graph,
+        line_graph,
+    )
+
+    rng = np.random.default_rng(seed)
+    graphs = []
+    for i in range(count - 4):
+        nodes = int(rng.choice([48, 96, 200, 400]))
+        edges = int(rng.integers(nodes, 3 * nodes))
+        graphs.append(
+            gnm_random_graph(
+                nodes, edges, seed=seed + i,
+                ensure_connected=bool(i % 3),  # disconnected forests too
+            )
+        )
+    graphs.append(graphs[0])  # duplicate graph in the same batch
+    graphs.append(Graph.from_edges(6, []))  # empty edge set
+    graphs.append(line_graph(9))
+    # Oversize: pads beyond the default bucket ceiling -> must bypass.
+    graphs.append(gnm_random_graph(70_000, 140_000, seed=seed))
+    return graphs
+
+
+def run_smoke(args) -> dict:
+    from distributed_ghs_implementation_tpu.api import (
+        minimum_spanning_forest,
+        minimum_spanning_forest_batch,
+    )
+    from distributed_ghs_implementation_tpu.batch.lanes import bucket_key
+    from distributed_ghs_implementation_tpu.batch.policy import BatchPolicy
+    from distributed_ghs_implementation_tpu.graphs.generators import gnm_random_graph
+    from distributed_ghs_implementation_tpu.obs.events import BUS
+    from distributed_ghs_implementation_tpu.serve.service import MSTService
+
+    BUS.enable()
+    BUS.clear()
+    graphs = _mixed_graphs(args.seed, args.graphs)
+    policy = BatchPolicy(max_lanes=args.lanes)
+    batchable = [g for g in graphs if policy.admits(g)]
+    buckets = {bucket_key(g) for g in batchable}
+
+    checks = []
+    results = minimum_spanning_forest_batch(graphs, policy=policy)
+    parity = all(
+        np.array_equal(
+            r.edge_ids, minimum_spanning_forest(g).edge_ids
+        )
+        for g, r in zip(graphs, results)
+    )
+    checks.append(("batch == sequential, edge-for-edge", parity))
+    counters = BUS.counters()
+    compiles = counters.get("batch.compile.miss", 0)
+    checks.append(
+        (f"compilations ({compiles}) <= shape buckets ({len(buckets)})",
+         compiles <= len(buckets))
+    )
+    checks.append(
+        ("oversize graph bypassed", counters.get("batch.bypass", 0) >= 1)
+    )
+    checks.append(
+        (f"lanes formed == batchable graphs ({len(batchable)})",
+         counters.get("batch.lanes.formed", 0) == len(batchable))
+    )
+
+    # Scheduler replay: duplicates inside one request list share a flight,
+    # and the whole list is answered from cache on repeat.
+    svc = MSTService(batch_lanes=args.lanes)
+    small = [gnm_random_graph(64, 160, seed=args.seed + i) for i in range(8)]
+    request = small + [small[0], small[3]]
+    out = svc.scheduler.solve_batch(request)
+    sources = [s for _, s in out]
+    checks.append(
+        ("scheduler: one solve per distinct digest",
+         sources.count("solved") == len(small)
+         and sources.count("coalesced") == 2)
+    )
+    again = svc.scheduler.solve_batch(request)
+    checks.append(
+        ("scheduler: repeat batch is all cache hits",
+         {s for _, s in again} <= {"cache", "coalesced"})
+    )
+    weights_match = all(
+        a.total_weight == b.total_weight
+        for (a, _), (b, _) in zip(out, again)
+    )
+    checks.append(("scheduler: repeat weights stable", weights_match))
+
+    return {
+        "mode": "smoke",
+        "graphs": len(graphs),
+        "buckets": len(buckets),
+        "compilations": compiles,
+        "checks": [{"name": n, "ok": bool(ok)} for n, ok in checks],
+        "ok": all(ok for _, ok in checks),
+    }
+
+
+def run_chaos(args) -> dict:
+    from distributed_ghs_implementation_tpu.api import minimum_spanning_forest_batch
+    from distributed_ghs_implementation_tpu.batch.policy import BatchPolicy
+    from distributed_ghs_implementation_tpu.obs.events import BUS
+    from distributed_ghs_implementation_tpu.utils.resilience import (
+        FAULTS,
+        SupervisorConfig,
+    )
+    from distributed_ghs_implementation_tpu.utils.verify import scipy_mst_weight
+
+    BUS.enable()
+    BUS.clear()
+    FAULTS.reload_env()  # operator-armed GHS_FAULT_* ride along
+    graphs = _mixed_graphs(args.seed, args.graphs)
+    policy = BatchPolicy(max_lanes=args.lanes)
+    config = SupervisorConfig(retries_per_rung=1, backoff_base_s=0.0)
+    # Every batch attempt (first try + retry) fails transiently -> the
+    # engine must fall back to per-lane supervised solves; the first few
+    # of those hit a transient device fault too (retry inside the lane).
+    FAULTS.arm("batch.attempt", times=10_000)
+    FAULTS.arm("resilience.attempt.device", times=3)
+
+    from distributed_ghs_implementation_tpu.batch.engine import BatchEngine
+
+    engine = BatchEngine(policy=policy, supervisor_config=config)
+    results = minimum_spanning_forest_batch(graphs, engine=engine)
+    FAULTS.reset()
+
+    checks = []
+    exact = all(
+        abs(float(r.total_weight) - float(scipy_mst_weight(g))) < 1e-6
+        if g.num_edges else r.total_weight == 0
+        for g, r in zip(graphs, results)
+    )
+    checks.append(("all weights oracle-exact under chaos", exact))
+    counters = BUS.counters()
+    batchable = sum(policy.admits(g) for g in graphs)
+    checks.append(
+        (f"every lane fell back in isolation ({batchable})",
+         counters.get("batch.lane.fallback", 0) == batchable)
+    )
+    checks.append(
+        ("batch retries recorded", counters.get("batch.retry", 0) >= 1)
+    )
+    # Edge-less graphs short-circuit before the supervisor attempts run,
+    # so their (still isolated) fallback carries an empty incident log.
+    isolated = all(
+        r.incidents is not None and len(r.incidents) >= 1
+        for g, r in zip(graphs, results)
+        if policy.admits(g) and g.num_edges
+    )
+    checks.append(("per-lane incidents recorded", isolated))
+    device_retries = sum(
+        1
+        for g, r in zip(graphs, results)
+        if r.incidents is not None
+        for rec in r.incidents.records
+        if rec.rung == "device" and rec.outcome == "transient"
+    )
+    checks.append(
+        ("transient lane faults isolated to their lanes (3 armed)",
+         device_retries == 3)
+    )
+    return {
+        "mode": "chaos",
+        "graphs": len(graphs),
+        "lane_fallbacks": counters.get("batch.lane.fallback", 0),
+        "batch_retries": counters.get("batch.retry", 0),
+        "checks": [{"name": n, "ok": bool(ok)} for n, ok in checks],
+        "ok": all(ok for _, ok in checks),
+    }
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(prog="batch_drill", description=__doc__)
+    p.add_argument("--smoke", action="store_true",
+                   help="mixed-batch parity + compile-bound + scheduler dedup")
+    p.add_argument("--chaos", action="store_true",
+                   help="fault-armed run asserting per-lane isolation")
+    p.add_argument("--graphs", type=int, default=68,
+                   help="graphs in the mixed batch (>= 64 for the CI gate)")
+    p.add_argument("--lanes", type=int, default=16)
+    p.add_argument("--seed", type=int, default=19)
+    p.add_argument("--output", help="write the JSON report here")
+    args = p.parse_args(argv)
+
+    if args.chaos and not args.smoke:
+        report = run_chaos(args)
+    else:
+        report = run_smoke(args)
+    if args.output:
+        with open(args.output, "w") as f:
+            json.dump(report, f, indent=2)
+            f.write("\n")
+    print(json.dumps(report, indent=2))
+    print(f"batch drill: {'PASS' if report['ok'] else 'FAIL'}")
+    return 0 if report["ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
