@@ -11,16 +11,32 @@ behind the serving scheduler's miss path.
 from distributed_ghs_implementation_tpu.batch.engine import BatchEngine
 from distributed_ghs_implementation_tpu.batch.lanes import (
     bucket_key,
+    bucket_of,
+    compiled_bucket_keys,
     lane_compile_stats,
+    precompile_bucket,
     solve_lanes,
 )
 from distributed_ghs_implementation_tpu.batch.policy import BatchPolicy, FormedBatch
+from distributed_ghs_implementation_tpu.batch.warmup import (
+    WarmupPlan,
+    load_bucket_record,
+    run_warmup,
+    save_bucket_record,
+)
 
 __all__ = [
     "BatchEngine",
     "BatchPolicy",
     "FormedBatch",
+    "WarmupPlan",
     "bucket_key",
+    "bucket_of",
+    "compiled_bucket_keys",
     "lane_compile_stats",
+    "load_bucket_record",
+    "precompile_bucket",
+    "run_warmup",
+    "save_bucket_record",
     "solve_lanes",
 ]

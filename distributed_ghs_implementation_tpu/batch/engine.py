@@ -19,15 +19,25 @@ lane (or one injected ``batch.attempt`` fault) never fails its lane-mates,
 and every lane's incidents stay separately attributable. Non-transient
 errors raise immediately (programming errors must not be papered over).
 
+``solve_many`` is **pipelined** (``policy.pipeline_depth``, default
+double-buffered): a background former thread stacks batch *k+1*'s host
+arrays while batch *k* executes on the device, handing off through a
+bounded queue — the device never waits on host-side padding/stacking.
+Results, retries, and incident handling are identical to the synchronous
+path: execution still runs through the same supervised core, and the
+former thread touches no device state.
+
 Telemetry (``batch.*`` on the obs bus — docs/OBSERVABILITY.md):
 ``batch.solve`` spans; ``batch.batches.formed`` / ``batch.lanes.formed`` /
 ``batch.bypass`` / ``batch.retry`` / ``batch.lane.fallback`` /
-``batch.compile.hit|miss`` counters; ``batch.fill_ratio`` and
-``batch.queue.wait_s`` histograms; ``batch.queue.depth`` samples.
+``batch.compile.hit|miss`` / ``batch.pipeline.batches`` counters;
+``batch.fill_ratio``, ``batch.queue.wait_s``, ``batch.form_s``, and
+``batch.pipeline.stall_s`` histograms; ``batch.queue.depth`` samples.
 """
 
 from __future__ import annotations
 
+import queue as queue_mod
 import threading
 import time
 from typing import Dict, List, Optional, Sequence
@@ -38,7 +48,12 @@ from distributed_ghs_implementation_tpu.api import (
     MSTResult,
     minimum_spanning_forest,
 )
-from distributed_ghs_implementation_tpu.batch.lanes import bucket_key, solve_lanes
+from distributed_ghs_implementation_tpu.batch.lanes import (
+    StackedBatch,
+    bucket_key,
+    execute_stacked,
+    stack_lanes,
+)
 from distributed_ghs_implementation_tpu.batch.policy import BatchPolicy
 from distributed_ghs_implementation_tpu.graphs.edgelist import Graph
 from distributed_ghs_implementation_tpu.obs.events import BUS
@@ -101,19 +116,116 @@ class BatchEngine:
 
         Forms batches immediately (the caller already holds the whole
         list, so there is nothing to wait for); non-admitted graphs bypass
-        to supervised single-graph solves.
+        to supervised single-graph solves. With ``policy.pipeline_depth >=
+        2`` and more than one formed batch, forming is pipelined: batch
+        *k+1* stacks on a background thread while batch *k* executes.
         """
         graphs = list(graphs)
         results: List[Optional[MSTResult]] = [None] * len(graphs)
         batches, bypass = self.policy.form(graphs)
-        for fb in batches:
-            members = [graphs[i] for i in fb.indices]
-            for i, result in zip(fb.indices, self._solve_formed(members)):
-                results[i] = result
+        if (
+            self.policy.pipeline_depth >= 2
+            and len(batches) >= 2
+            and self._pipeline_worthwhile(batches)
+        ):
+            self._solve_batches_pipelined(graphs, batches, results)
+        else:
+            for fb in batches:
+                members = [graphs[i] for i in fb.indices]
+                for i, result in zip(fb.indices, self._solve_formed(members)):
+                    results[i] = result
         for i in bypass:
             BUS.count("batch.bypass")
             results[i] = self._solve_single(graphs[i])
         return results  # type: ignore[return-value]
+
+    def _pipeline_worthwhile(self, batches) -> bool:
+        """Is there enough host stacking per batch to hide behind device
+        execution? A batch's stacked arrays hold ``8 * lanes * m_pad``
+        int32 elements (3 edge-slot arrays of ``2 * m_pad`` + 2 rank
+        arrays of ``m_pad``, all times ``lanes``); below the policy floor
+        the former thread's handoff overhead outweighs the overlap
+        (docs/BENCH_NOTES.md "Round 10" has the measurements)."""
+        lanes = self.policy.max_lanes
+        return any(
+            8 * lanes * fb.key[1] >= self.policy.pipeline_min_stack_elems
+            for fb in batches
+        )
+
+    def _solve_batches_pipelined(
+        self, graphs: List[Graph], batches, results: List[Optional[MSTResult]]
+    ) -> None:
+        """Double-buffered dispatch: one background former thread stacks
+        upcoming batches' host arrays into a bounded handoff queue
+        (capacity ``pipeline_depth - 1``) while this thread executes.
+
+        The former does pure host work (``stack_lanes`` touches no device
+        state and no shared caches), so overlap is safe; execution itself
+        still runs through :meth:`_solve_formed`'s retry/fallback ladder,
+        keeping results and incidents identical to the synchronous path. A
+        forming error is delivered as a ``None`` stack and reproduced by
+        re-stacking on this thread — stacking is deterministic, so the
+        error surfaces with exactly the synchronous path's classification
+        and incident records.
+        """
+        handoff: "queue_mod.Queue" = queue_mod.Queue(
+            maxsize=max(1, self.policy.pipeline_depth - 1)
+        )
+        stop = threading.Event()
+
+        def former() -> None:
+            for fb in batches:
+                # The WHOLE per-batch body is guarded: an unexpected error
+                # (bad indices from a broken policy, an obs exporter blowing
+                # up) must reach the dispatcher as an item, never kill this
+                # thread silently — a dead former would hang the timeout-
+                # less handoff.get() forever.
+                try:
+                    members = [graphs[i] for i in fb.indices]
+                    t0 = self._clock()
+                    try:
+                        stacked = stack_lanes(
+                            members, lanes=self.policy.max_lanes,
+                            mode=self.policy.mode,
+                        )
+                    except BaseException:  # noqa: BLE001 — redone at dispatch
+                        stacked = None  # deterministic: re-raised by re-stack
+                    BUS.record("batch.form_s", self._clock() - t0)
+                    item: object = (fb, members, stacked)
+                except BaseException as e:  # noqa: BLE001 — raised at dispatch
+                    item = e
+                while not stop.is_set():
+                    try:
+                        handoff.put(item, timeout=0.05)
+                        break
+                    except queue_mod.Full:
+                        continue
+                if stop.is_set():
+                    return
+
+        thread = threading.Thread(target=former, name="batch-former", daemon=True)
+        thread.start()
+        try:
+            for _ in range(len(batches)):
+                t0 = self._clock()
+                got = handoff.get()
+                if isinstance(got, BaseException):
+                    raise got  # the sync path would have raised it here too
+                fb, members, stacked = got
+                BUS.record("batch.pipeline.stall_s", self._clock() - t0)
+                BUS.count("batch.pipeline.batches")
+                for i, result in zip(
+                    fb.indices, self._solve_formed(members, stacked=stacked)
+                ):
+                    results[i] = result
+        finally:
+            stop.set()
+            while thread.is_alive():
+                try:  # unblock a former stuck on a full handoff queue
+                    handoff.get_nowait()
+                except queue_mod.Empty:
+                    pass
+                thread.join(timeout=0.05)
 
     # ------------------------------------------------------------------
     # Asynchronous entry (the scheduler's per-request miss path)
@@ -210,9 +322,19 @@ class BatchEngine:
     # ------------------------------------------------------------------
     # Execution core
     # ------------------------------------------------------------------
-    def _solve_formed(self, graphs: List[Graph]) -> List[MSTResult]:
+    def _solve_formed(
+        self,
+        graphs: List[Graph],
+        stacked: Optional[StackedBatch] = None,
+    ) -> List[MSTResult]:
         """One same-bucket batch: lane solve with retry, then per-lane
-        fallback isolation. Results in input order."""
+        fallback isolation. Results in input order.
+
+        ``stacked`` carries pre-formed host arrays from the pipelined
+        former; when absent (synchronous path, or a former that failed)
+        the stack is built here, inside the attempt's error classification.
+        A retry re-dispatches the same immutable stack without re-forming.
+        """
         lanes = self.policy.max_lanes
         n_pad, m_pad = bucket_key(graphs[0])
         BUS.count("batch.batches.formed")
@@ -227,10 +349,12 @@ class BatchEngine:
                 t0 = self._clock()
                 try:
                     FAULTS.fire("batch.attempt")
-                    with self._dispatch:
-                        solved = solve_lanes(
+                    if stacked is None:
+                        stacked = stack_lanes(
                             graphs, lanes=lanes, mode=self.policy.mode
                         )
+                    with self._dispatch:
+                        solved = execute_stacked(stacked)
                 except Exception as e:  # noqa: BLE001 — classified below
                     if not is_transient(e):
                         log.add(

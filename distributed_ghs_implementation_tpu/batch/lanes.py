@@ -23,15 +23,34 @@ Compiles are bounded by construction: the solver cache keys on
 ``(n_pad, m_pad, lanes, mode)``, so traffic drawn from B shape buckets
 costs at most B compilations no matter how many batches run
 (``batch.compile.hit`` / ``batch.compile.miss`` count the cache traffic).
+
+Every cache entry is an **ahead-of-time compiled executable**
+(``jax.jit(...).lower().compile()`` against the bucket's exact input
+shapes), so a bucket can be compiled before any request needs it —
+``batch/warmup.py`` drives exactly that, and :func:`precompile_bucket`
+counts its compiles as ``compile.warmup`` instead of ``compile.miss`` so
+cold vs warm traffic is distinguishable in traces (docs/OBSERVABILITY.md,
+``compile.*`` taxonomy). On accelerators the fused path donates its input
+buffers (they are consumed by the solve); on CPU donation is unsupported
+and skipped.
+
+Stacking and execution are separable: :func:`stack_lanes` does the pure
+host work (padding, shifting, array assembly) and returns a
+:class:`StackedBatch`; :func:`execute_stacked` runs the device dispatch
+and unpacks per-lane results. ``batch/engine.py`` uses the split to form
+batch *k+1* on a background thread while batch *k* executes.
 """
 
 from __future__ import annotations
 
+import dataclasses
 import functools
 import threading
+import time
 from typing import Dict, List, Sequence, Tuple
 
 import jax
+import jax.numpy as jnp
 import numpy as np
 
 from distributed_ghs_implementation_tpu.graphs.edgelist import Graph
@@ -44,23 +63,38 @@ from distributed_ghs_implementation_tpu.obs.events import BUS
 _INT32_MAX = np.iinfo(np.int32).max
 
 BucketKey = Tuple[int, int]  # (n_pad, m_pad)
+SolverKey = Tuple[int, int, int, str]  # (n_pad, m_pad, lanes, mode)
+
+
+def bucket_of(num_nodes: int, num_edges: int) -> BucketKey:
+    """The compiled-shape bucket a ``(nodes, edges)`` workload pads into.
+
+    THE one encoding of the bucketing rule (``prepare_device_arrays``'s
+    padding: vertices to the next power of two, undirected ranks to the
+    next power of two — edge slots are always ``2 * m_pad``); warmup specs
+    and request-time keys both route through it, so a declared bucket is a
+    hit bucket by construction. Empty dimensions bucket at 1.
+    """
+    return (_next_pow2(max(1, num_nodes)), _next_pow2(max(1, num_edges)))
 
 
 def bucket_key(graph: Graph) -> BucketKey:
-    """The compiled-shape bucket a graph pads into: ``(n_pad, m_pad)``.
-
-    This is the SAME padding ``prepare_device_arrays`` applies (vertices to
-    the next power of two, undirected ranks to the next power of two — edge
-    slots are always ``2 * m_pad``), so two graphs with equal keys stack
-    into interchangeable lanes. Empty dimensions bucket at 1.
-    """
-    return (_next_pow2(max(1, graph.num_nodes)), _next_pow2(max(1, graph.num_edges)))
+    """:func:`bucket_of` for a built ``Graph`` — two graphs with equal
+    keys stack into interchangeable lanes."""
+    return bucket_of(graph.num_nodes, graph.num_edges)
 
 
 # ----------------------------------------------------------------------
-# Compile cache: (n_pad, m_pad, lanes, mode) -> solver callable
+# Compile cache: (n_pad, m_pad, lanes, mode) -> AOT-compiled executable
+#
+# The lock guards only the dict lookups/inserts; compiles run OUTSIDE it
+# (one to two seconds each) with per-key pending events, so a warm
+# bucket's cache hit never stalls behind an unrelated bucket's cold
+# compile — and two threads racing the same cold bucket still compile it
+# exactly once.
 # ----------------------------------------------------------------------
-_SOLVER_CACHE: Dict[Tuple[int, int, int, str], object] = {}
+_SOLVER_CACHE: Dict[SolverKey, object] = {}
+_PENDING_COMPILES: Dict[SolverKey, threading.Event] = {}
 _CACHE_LOCK = threading.Lock()
 
 
@@ -72,24 +106,121 @@ def lane_compile_stats() -> dict:
     }
 
 
-def _get_solver(n_pad: int, m_pad: int, lanes: int, mode: str):
-    key = (n_pad, m_pad, lanes, mode)
+def compiled_bucket_keys() -> List[SolverKey]:
+    """The solver keys compiled so far — the record warmup replay persists."""
     with _CACHE_LOCK:
-        fn = _SOLVER_CACHE.get(key)
-        if fn is not None:
-            BUS.count("batch.compile.hit")
-            return fn
-        BUS.count("batch.compile.miss")
-        if mode == "fused":
-            fn = functools.partial(_solve_from_iota, num_nodes=lanes * n_pad)
-        elif mode == "vmap":
-            fn = jax.jit(
-                jax.vmap(functools.partial(_solve_from_iota, num_nodes=n_pad))
-            )
+        return sorted(_SOLVER_CACHE)
+
+
+def clear_solver_cache() -> None:
+    """Drop every compiled lane solver (tests simulate a process restart)."""
+    with _CACHE_LOCK:
+        _SOLVER_CACHE.clear()
+
+
+def _lane_input_shapes(n_pad: int, m_pad: int, lanes: int, mode: str):
+    """The exact input avals a bucket's solver compiles against."""
+    e_pad = 2 * m_pad
+    if mode == "fused":
+        edge = jax.ShapeDtypeStruct((lanes * e_pad,), jnp.int32)
+        rank = jax.ShapeDtypeStruct((lanes * m_pad,), jnp.int32)
+    else:
+        edge = jax.ShapeDtypeStruct((lanes, e_pad), jnp.int32)
+        rank = jax.ShapeDtypeStruct((lanes, m_pad), jnp.int32)
+    return edge, edge, edge, rank, rank
+
+
+def _donate_inputs() -> bool:
+    """Donate fused-path input buffers only where donation is implemented
+    (accelerators); on CPU XLA ignores it with a warning per compile."""
+    return jax.default_backend() in ("tpu", "gpu")
+
+
+def _compile_bucket(n_pad: int, m_pad: int, lanes: int, mode: str):
+    """AOT-compile one bucket's solver: trace+lower+compile now, so the
+    executable is ready before (or instead of) the first request."""
+    shapes = _lane_input_shapes(n_pad, m_pad, lanes, mode)
+    if mode == "fused":
+        fn = functools.partial(_solve_from_iota, num_nodes=lanes * n_pad)
+        if _donate_inputs():
+            fn = jax.jit(fn, donate_argnums=(0, 1, 2, 3, 4))
         else:
-            raise ValueError(f"unknown lane mode {mode!r}; expected fused|vmap")
-        _SOLVER_CACHE[key] = fn
+            fn = jax.jit(fn)
+    elif mode == "vmap":
+        fn = jax.jit(jax.vmap(functools.partial(_solve_from_iota, num_nodes=n_pad)))
+    else:
+        raise ValueError(f"unknown lane mode {mode!r}; expected fused|vmap")
+    return fn.lower(*shapes).compile()
+
+
+def _get_solver(n_pad: int, m_pad: int, lanes: int, mode: str, *, phase: str = "request"):
+    """The bucket's compiled executable, building it on first need.
+
+    ``phase`` labels who paid for a compile: ``"request"`` (a live solve
+    stalled on it — the cold-start spike warmup exists to remove) or
+    ``"warmup"`` (precompiled ahead of traffic). Cache hits always count
+    as ``compile.hit`` — a warmup-precompiled bucket is a *hit* at request
+    time, never a fresh compile.
+    """
+    key = (n_pad, m_pad, lanes, mode)
+    while True:
+        with _CACHE_LOCK:
+            fn = _SOLVER_CACHE.get(key)
+            if fn is not None:
+                BUS.count("batch.compile.hit")
+                BUS.count("compile.hit")
+                return fn
+            pending = _PENDING_COMPILES.get(key)
+            if pending is None:
+                pending = _PENDING_COMPILES[key] = threading.Event()
+                BUS.count("batch.compile.miss")
+                BUS.count(f"compile.{'warmup' if phase == 'warmup' else 'miss'}")
+                break  # this thread leads the compile, outside the lock
+        # Another thread is compiling this key: wait, then re-read the
+        # cache (on the leader's failure the loop elects a new leader).
+        pending.wait()
+    try:
+        t0 = time.perf_counter()
+        with BUS.span(
+            "compile.bucket", cat="compile",
+            n_pad=n_pad, m_pad=m_pad, lanes=lanes, mode=mode, phase=phase,
+        ):
+            fn = _compile_bucket(n_pad, m_pad, lanes, mode)
+        BUS.record("compile.time_s", time.perf_counter() - t0)
+        with _CACHE_LOCK:
+            _SOLVER_CACHE[key] = fn
         return fn
+    finally:
+        with _CACHE_LOCK:
+            del _PENDING_COMPILES[key]
+        pending.set()
+
+
+def precompile_bucket(
+    n_pad: int, m_pad: int, lanes: int, mode: str = "fused"
+) -> bool:
+    """Compile a bucket's lane solver ahead of serving (idempotent).
+
+    Returns ``True`` if this call compiled, ``False`` if the bucket was
+    already cached. The compile lands on the bus as ``compile.warmup``
+    (plus ``batch.compile.miss`` — it *is* a lane-solver compilation, just
+    not one a request waited on). Rejects geometries the request path
+    itself rejects (int32 id-space overflow in ``stack_lanes``) — a
+    warmup must never compile a solver no request can reach.
+    """
+    if lanes < 1:
+        raise ValueError(f"lanes must be >= 1, got {lanes}")
+    if lanes * n_pad >= _INT32_MAX or lanes * m_pad >= _INT32_MAX:
+        raise ValueError(
+            f"bucket ({n_pad}, {m_pad}) x {lanes} lanes exceeds int32 id "
+            "space; no request-path stack can ever use this solver"
+        )
+    with _CACHE_LOCK:
+        cached = (n_pad, m_pad, lanes, mode) in _SOLVER_CACHE
+    if cached:
+        return False
+    _get_solver(n_pad, m_pad, lanes, mode, phase="warmup")
+    return True
 
 
 # ----------------------------------------------------------------------
@@ -146,6 +277,92 @@ def _stack_vmap(graphs: Sequence[Graph], n_pad: int, m_pad: int, lanes: int):
     return src, dst, rank, ra, rb
 
 
+@dataclasses.dataclass(frozen=True)
+class StackedBatch:
+    """One formed batch's host-side arrays, ready to dispatch.
+
+    The stack is immutable and re-dispatchable: the engine's retry loop
+    re-executes the same :class:`StackedBatch` without re-stacking (the
+    arrays are host copies — donation only consumes the per-call device
+    buffers).
+    """
+
+    graphs: Tuple[Graph, ...]
+    n_pad: int
+    m_pad: int
+    lanes: int
+    mode: str
+    arrays: tuple
+
+
+def stack_lanes(
+    graphs: Sequence[Graph],
+    *,
+    lanes: int | None = None,
+    mode: str = "fused",
+) -> StackedBatch:
+    """The pure host half of a lane solve: validate and stack the arrays.
+
+    Safe to run on a background thread while another batch executes — it
+    touches no device state and no shared caches.
+    """
+    if not graphs:
+        raise ValueError("cannot stack an empty batch")
+    lanes = len(graphs) if lanes is None else int(lanes)
+    if lanes < len(graphs):
+        raise ValueError(f"lanes={lanes} < {len(graphs)} graphs")
+    n_pad, m_pad = bucket_key(graphs[0])
+    for g in graphs[1:]:
+        if bucket_key(g) != (n_pad, m_pad):
+            raise ValueError(
+                f"mixed buckets in one lane stack: {bucket_key(g)} vs "
+                f"{(n_pad, m_pad)} (the policy must group by bucket)"
+            )
+    if lanes * n_pad >= _INT32_MAX or lanes * m_pad >= _INT32_MAX:
+        raise ValueError(
+            f"bucket ({n_pad}, {m_pad}) x {lanes} lanes exceeds int32 id "
+            "space; the policy should bypass graphs this large"
+        )
+    if mode == "fused":
+        arrays = _stack_fused(graphs, n_pad, m_pad, lanes)
+    elif mode == "vmap":
+        arrays = _stack_vmap(graphs, n_pad, m_pad, lanes)
+    else:
+        raise ValueError(f"unknown lane mode {mode!r}; expected fused|vmap")
+    return StackedBatch(
+        graphs=tuple(graphs), n_pad=n_pad, m_pad=m_pad,
+        lanes=lanes, mode=mode, arrays=arrays,
+    )
+
+
+def execute_stacked(stacked: StackedBatch) -> List[Tuple[np.ndarray, np.ndarray, int]]:
+    """The device half: one dispatch of a stacked batch + per-lane unpack."""
+    solver = _get_solver(
+        stacked.n_pad, stacked.m_pad, stacked.lanes, stacked.mode
+    )
+    mst_ranks, fragment, levels = jax.device_get(solver(*stacked.arrays))
+
+    graphs, lanes, n_pad, m_pad = (
+        stacked.graphs, stacked.lanes, stacked.n_pad, stacked.m_pad
+    )
+    out: List[Tuple[np.ndarray, np.ndarray, int]] = []
+    if stacked.mode == "fused":
+        lane_ranks = np.asarray(mst_ranks).reshape(lanes, m_pad)
+        lane_frag = np.asarray(fragment).reshape(lanes, n_pad)
+        for i, g in enumerate(graphs):
+            ranks = np.nonzero(lane_ranks[i])[0]
+            edge_ids = np.sort(g.edge_id_of_rank(ranks))
+            frag = lane_frag[i, : g.num_nodes] - i * n_pad
+            out.append((edge_ids, frag.astype(np.int32), int(levels)))
+    else:
+        for i, g in enumerate(graphs):
+            ranks = np.nonzero(np.asarray(mst_ranks[i]))[0]
+            edge_ids = np.sort(g.edge_id_of_rank(ranks))
+            frag = np.asarray(fragment[i])[: g.num_nodes]
+            out.append((edge_ids, frag, int(np.asarray(levels)[i])))
+    return out
+
+
 # ----------------------------------------------------------------------
 # The batch solve
 # ----------------------------------------------------------------------
@@ -168,41 +385,4 @@ def solve_lanes(
     """
     if not graphs:
         return []
-    lanes = len(graphs) if lanes is None else int(lanes)
-    if lanes < len(graphs):
-        raise ValueError(f"lanes={lanes} < {len(graphs)} graphs")
-    n_pad, m_pad = bucket_key(graphs[0])
-    for g in graphs[1:]:
-        if bucket_key(g) != (n_pad, m_pad):
-            raise ValueError(
-                f"mixed buckets in one lane stack: {bucket_key(g)} vs "
-                f"{(n_pad, m_pad)} (the policy must group by bucket)"
-            )
-    if lanes * n_pad >= _INT32_MAX or lanes * m_pad >= _INT32_MAX:
-        raise ValueError(
-            f"bucket ({n_pad}, {m_pad}) x {lanes} lanes exceeds int32 id "
-            "space; the policy should bypass graphs this large"
-        )
-    solver = _get_solver(n_pad, m_pad, lanes, mode)
-    if mode == "fused":
-        arrays = _stack_fused(graphs, n_pad, m_pad, lanes)
-    else:
-        arrays = _stack_vmap(graphs, n_pad, m_pad, lanes)
-    mst_ranks, fragment, levels = jax.device_get(solver(*arrays))
-
-    out: List[Tuple[np.ndarray, np.ndarray, int]] = []
-    if mode == "fused":
-        lane_ranks = np.asarray(mst_ranks).reshape(lanes, m_pad)
-        lane_frag = np.asarray(fragment).reshape(lanes, n_pad)
-        for i, g in enumerate(graphs):
-            ranks = np.nonzero(lane_ranks[i])[0]
-            edge_ids = np.sort(g.edge_id_of_rank(ranks))
-            frag = lane_frag[i, : g.num_nodes] - i * n_pad
-            out.append((edge_ids, frag.astype(np.int32), int(levels)))
-    else:
-        for i, g in enumerate(graphs):
-            ranks = np.nonzero(np.asarray(mst_ranks[i]))[0]
-            edge_ids = np.sort(g.edge_id_of_rank(ranks))
-            frag = np.asarray(fragment[i])[: g.num_nodes]
-            out.append((edge_ids, frag, int(np.asarray(levels)[i])))
-    return out
+    return execute_stacked(stack_lanes(graphs, lanes=lanes, mode=mode))

@@ -49,6 +49,16 @@ class BatchPolicy:
     ceiling is the solver's own small-graph routing threshold, below which
     the flat bucketed kernel — the one lanes stack — is the fast path).
     ``mode`` — lane execution: ``"fused"`` block-diagonal or ``"vmap"``.
+    ``pipeline_depth`` — how many batches the engine's forming stage may
+    run ahead of device execution in ``solve_many`` (2 = double-buffered:
+    batch *k+1* stacks on a background thread while batch *k* executes;
+    1 = fully synchronous, forming and execution strictly alternate).
+    ``pipeline_min_stack_elems`` — smallest per-batch stacked array size
+    (elements: ``8 * max_lanes * m_pad``) worth pipelining; below it the
+    former thread's handoff overhead beats the overlap win (measured on
+    CPU: 4x128-vertex lanes lose ~10% pipelined, 16 lanes win ~1.8x on
+    run medians — docs/BENCH_NOTES.md) and ``solve_many`` stays
+    synchronous. 0 forces pipelining whenever there are >= 2 batches.
     """
 
     max_lanes: int = 16
@@ -56,6 +66,8 @@ class BatchPolicy:
     max_bucket_edges: int = ELL_AUTO_EDGE_THRESHOLD
     max_bucket_nodes: int = 1 << 16
     mode: str = "fused"
+    pipeline_depth: int = 2
+    pipeline_min_stack_elems: int = 32768
 
     def __post_init__(self):
         if self.max_lanes < 1:
@@ -64,6 +76,15 @@ class BatchPolicy:
             raise ValueError(f"max_wait_s must be >= 0, got {self.max_wait_s}")
         if self.mode not in ("fused", "vmap"):
             raise ValueError(f"unknown lane mode {self.mode!r}")
+        if self.pipeline_depth < 1:
+            raise ValueError(
+                f"pipeline_depth must be >= 1, got {self.pipeline_depth}"
+            )
+        if self.pipeline_min_stack_elems < 0:
+            raise ValueError(
+                f"pipeline_min_stack_elems must be >= 0, got "
+                f"{self.pipeline_min_stack_elems}"
+            )
 
     def admits(self, graph: Graph) -> bool:
         """Can this graph ride a lane (vs bypassing to the single path)?"""

@@ -1,0 +1,298 @@
+"""AOT bucket warmup: compile the serving hot path before traffic arrives.
+
+The first query landing on a new ``(n_pad, m_pad, lanes, mode)`` bucket
+used to pay full XLA tracing+compilation *inside* the request — a
+multi-hundred-ms p99 spike that repeats on every process restart. This
+module removes it by precompiling a declared set of buckets ahead of
+serving:
+
+* **Ladder** — :func:`default_ladder` enumerates power-of-two shape
+  buckets up to a ceiling (every graph shape maps into one of them), and
+  :func:`parse_bucket_list` turns an operator-declared ``"128x512,..."``
+  spec (raw node/edge counts; they bucket the same way requests do) into
+  the exact buckets those workloads hit.
+* **Replay** — :func:`save_bucket_record` persists the solver keys a live
+  process actually compiled (``lanes.compiled_bucket_keys``), and
+  :func:`load_bucket_record` turns the file back into a plan, so a restart
+  precompiles precisely yesterday's traffic.
+* **Run** — :func:`run_warmup` AOT-compiles each bucket's lane solver
+  (``lanes.precompile_bucket`` → ``jax.jit(...).lower().compile()``) and,
+  unless disabled, also warms the single-graph fused kernel for the same
+  shape bucket (the bypass/fallback/non-batched path) by executing it once
+  on an inert all-pad stack — that run exits after one level, so the cost
+  is the compile, not a solve.
+
+Warmup compiles land on the obs bus as ``compile.warmup`` (request-time
+compiles are ``compile.miss``), so "zero request-time compiles" is an
+assertable property: after a warmup covering the traffic's buckets, the
+query phase must add no ``compile.miss`` counts (``tools/serve_drill.py
+--warmup-smoke`` gates exactly this in CI). Pair with the persistent XLA
+compile cache (``utils/compile_cache.py``) and even the warmup compiles
+are disk reads after the first boot.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import time
+from typing import List, Sequence, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from distributed_ghs_implementation_tpu.batch.lanes import (
+    SolverKey,
+    bucket_of,
+    compiled_bucket_keys,
+    precompile_bucket,
+)
+from distributed_ghs_implementation_tpu.models.boruvka import (
+    ELL_AUTO_EDGE_THRESHOLD,
+    _next_pow2,
+    _solve_from_iota,
+)
+from distributed_ghs_implementation_tpu.obs.events import BUS
+
+RECORD_SCHEMA = "ghs-warmup-buckets-v1"
+
+_INT32_MAX = np.iinfo(np.int32).max
+
+#: Single-graph warm ceiling: buckets past these never run the fused iota
+#: kernel (``solve_graph`` routes them to the rank solver), so warming
+#: them would pay a huge boot-time compile no request ever hits. Matches
+#: ``BatchPolicy``'s default admission ceiling.
+MAX_SINGLE_WARM_EDGES = ELL_AUTO_EDGE_THRESHOLD
+MAX_SINGLE_WARM_NODES = 1 << 16
+
+
+def warmable_single(n_pad: int, m_pad: int) -> bool:
+    """Would a graph in this bucket actually hit the fused single-graph
+    kernel (vs routing to the rank solver at scale)?"""
+    return n_pad <= MAX_SINGLE_WARM_NODES and m_pad <= MAX_SINGLE_WARM_EDGES
+
+
+@dataclasses.dataclass(frozen=True)
+class WarmupPlan:
+    """What to precompile before serving.
+
+    ``buckets`` are padded shape buckets ``(n_pad, m_pad)``; each is
+    compiled at ``lanes`` lanes in ``mode`` (``lanes == 0`` skips the lane
+    solver — a service running without the batch engine only needs the
+    single-graph kernel). ``keys`` are exact replayed solver keys (each
+    carries its own lane count/mode). ``warm_single`` additionally warms
+    the single-graph fused kernel per distinct shape bucket.
+    """
+
+    buckets: Tuple[Tuple[int, int], ...] = ()
+    lanes: int = 0
+    mode: str = "fused"
+    keys: Tuple[SolverKey, ...] = ()
+    warm_single: bool = True
+
+    def is_empty(self) -> bool:
+        return not self.buckets and not self.keys
+
+
+def parse_bucket_list(spec: str) -> List[Tuple[int, int]]:
+    """Parse ``"128x512,300x1200"`` into padded shape buckets.
+
+    Entries are RAW workload sizes (nodes x edges), bucketed exactly like
+    requests are, so operators declare traffic shapes, not XLA shapes.
+    Duplicate buckets collapse. ``"auto"`` yields :func:`default_ladder`.
+    """
+    spec = spec.strip()
+    if not spec:
+        return []
+    if spec.lower() in ("auto", "ladder"):
+        return default_ladder()
+    out: List[Tuple[int, int]] = []
+    for entry in spec.split(","):
+        entry = entry.strip()
+        if not entry:
+            continue
+        parts = entry.lower().split("x")
+        if len(parts) != 2:
+            raise ValueError(
+                f"bad bucket spec {entry!r}; expected NODESxEDGES, e.g. 128x512"
+            )
+        n, m = int(parts[0]), int(parts[1])
+        if n < 1 or m < 1:
+            raise ValueError(f"bad bucket spec {entry!r}: sizes must be positive")
+        b = bucket_of(n, m)
+        if b not in out:
+            out.append(b)
+    return out
+
+
+def default_ladder(
+    *,
+    min_nodes: int = 64,
+    max_nodes: int = 4096,
+    edge_factors: Sequence[int] = (2, 4),
+) -> List[Tuple[int, int]]:
+    """A generic small-graph bucket ladder: power-of-two node counts from
+    ``min_nodes`` to ``max_nodes``, each at the given edge/node factors.
+
+    This is the no-information default for ``--warmup-buckets auto``; a
+    deployment that knows its traffic should declare exact sizes or replay
+    a recorded bucket file instead.
+    """
+    ladder: List[Tuple[int, int]] = []
+    n = _next_pow2(max(2, min_nodes))
+    while n <= max_nodes:
+        for f in edge_factors:
+            b = bucket_of(n, f * n)
+            if b not in ladder:
+                ladder.append(b)
+        n *= 2
+    return ladder
+
+
+# ----------------------------------------------------------------------
+# Record / replay
+# ----------------------------------------------------------------------
+def save_bucket_record(
+    path: str,
+    shape_buckets: Sequence[Tuple[int, int]] = (),
+    *,
+    include_compiled: bool = True,
+) -> int:
+    """Persist warmable buckets for replay; returns the entry count.
+
+    ``shape_buckets`` are traffic-observed ``(n_pad, m_pad)`` buckets
+    (recorded with ``lanes=0``; the replaying service normalizes them to
+    its own lane geometry). With ``include_compiled`` the record also
+    snapshots the lane-solver keys this process compiled —
+    ``include_compiled=False`` is what ``serve --warmup-record`` uses, so
+    a record driven purely by ``seen_buckets`` converges to actual
+    traffic instead of accumulating every bucket a prior warmup ladder
+    ever compiled.
+    """
+    keys = compiled_bucket_keys() if include_compiled else []
+    covered = {(n, m) for n, m, _, _ in keys}
+    for n_pad, m_pad in shape_buckets:
+        if (n_pad, m_pad) not in covered:
+            keys.append((n_pad, m_pad, 0, "fused"))
+            covered.add((n_pad, m_pad))
+    with open(path, "w") as f:
+        json.dump(
+            {
+                "schema": RECORD_SCHEMA,
+                "buckets": [list(k) for k in keys],
+            },
+            f,
+            indent=2,
+        )
+        f.write("\n")
+    return len(keys)
+
+
+def load_bucket_record(path: str) -> WarmupPlan:
+    """Load a recorded bucket file into a replayable :class:`WarmupPlan`."""
+    with open(path) as f:
+        record = json.load(f)
+    if record.get("schema") != RECORD_SCHEMA:
+        raise ValueError(
+            f"{path}: bad warmup record schema {record.get('schema')!r} "
+            f"(expected {RECORD_SCHEMA})"
+        )
+    keys = tuple(
+        (int(n), int(m), int(lanes), str(mode))
+        for n, m, lanes, mode in record.get("buckets", [])
+    )
+    return WarmupPlan(keys=keys)
+
+
+def merge_plans(*plans: WarmupPlan) -> WarmupPlan:
+    """Union of several plans (CLI: ``--warmup-buckets`` + ``--warmup-replay``)."""
+    buckets: List[Tuple[int, int]] = []
+    keys: List[SolverKey] = []
+    lanes, mode, warm_single = 0, "fused", True
+    for p in plans:
+        for b in p.buckets:
+            if b not in buckets:
+                buckets.append(b)
+        for k in p.keys:
+            if k not in keys:
+                keys.append(k)
+        lanes = max(lanes, p.lanes)
+        if p.lanes:
+            mode = p.mode
+        warm_single = warm_single and p.warm_single
+    return WarmupPlan(
+        buckets=tuple(buckets), lanes=lanes, mode=mode,
+        keys=tuple(keys), warm_single=warm_single,
+    )
+
+
+# ----------------------------------------------------------------------
+# Execution
+# ----------------------------------------------------------------------
+def _warm_single_graph_kernel(n_pad: int, m_pad: int) -> None:
+    """Warm the single-graph fused kernel for one shape bucket by solving
+    an inert all-pad stack: self-edge slots, sentinel ranks. The level
+    loop exits after one no-progress level, so the call costs one compile
+    (or nothing when the jit cache / persistent cache already has it) —
+    this is the path bypass, fallback, and non-batched serving hit.
+    """
+    e_pad = 2 * m_pad
+    src = jnp.zeros(e_pad, jnp.int32)
+    rank = jnp.full(e_pad, _INT32_MAX, jnp.int32)
+    ra = jnp.zeros(m_pad, jnp.int32)
+    _solve_from_iota(src, src, rank, ra, ra, num_nodes=n_pad)
+
+
+def run_warmup(plan: WarmupPlan) -> dict:
+    """Execute a warmup plan; returns a report dict.
+
+    Idempotent: already-compiled buckets are skipped (and reported as
+    ``cached``). The whole phase is one ``compile.warmup_phase`` span so a
+    trace shows exactly what boot paid for.
+    """
+    report = {
+        "buckets": 0,
+        "compiled": 0,
+        "cached": 0,
+        "skipped": 0,
+        "single_warmed": 0,
+        "wall_s": 0.0,
+    }
+    if plan.is_empty():
+        return report
+    t0 = time.perf_counter()
+    keys: List[SolverKey] = list(plan.keys)
+    if plan.lanes > 0:
+        for n_pad, m_pad in plan.buckets:
+            k = (n_pad, m_pad, plan.lanes, plan.mode)
+            if k not in keys:
+                keys.append(k)
+    with BUS.span(
+        "compile.warmup_phase", cat="compile",
+        lane_buckets=len(keys), shape_buckets=len(plan.buckets),
+    ) as span:
+        for n_pad, m_pad, lanes, mode in keys:
+            if lanes < 1:
+                continue  # shape-only record entry: single-graph warm below
+            if not warmable_single(n_pad, m_pad):
+                # Past the admission ceiling the request path bypasses the
+                # lane engine entirely — a typo'd spec must not stall boot
+                # on a giant compile no request can reach.
+                report["skipped"] += 1
+                continue
+            report["buckets"] += 1
+            if precompile_bucket(n_pad, m_pad, lanes, mode):
+                report["compiled"] += 1
+            else:
+                report["cached"] += 1
+        if plan.warm_single:
+            shapes = {(n, m) for n, m in plan.buckets}
+            shapes.update((n, m) for n, m, _, _ in keys)
+            for n_pad, m_pad in sorted(shapes):
+                if not warmable_single(n_pad, m_pad):
+                    continue  # routed to the rank solver, never this kernel
+                _warm_single_graph_kernel(n_pad, m_pad)
+                report["single_warmed"] += 1
+        span.set(compiled=report["compiled"], cached=report["cached"])
+    report["wall_s"] = time.perf_counter() - t0
+    return report

@@ -346,6 +346,35 @@ def _cmd_serve(args) -> int:
         serve_loop,
     )
 
+    # Persistent compile cache first (default ON for serve): config must
+    # land before the first compile — warmup's included.
+    if not args.no_compile_cache:
+        from distributed_ghs_implementation_tpu.utils.compile_cache import (
+            enable_persistent_cache,
+        )
+
+        cache_dir = enable_persistent_cache(args.compile_cache_dir)
+        if cache_dir:
+            print(f"compile cache: {cache_dir}", file=sys.stderr)
+
+    warmup_plan = None
+    if args.warmup_buckets or args.warmup_replay:
+        from distributed_ghs_implementation_tpu.batch import warmup as warmup_mod
+
+        plans = []
+        if args.warmup_buckets:
+            plans.append(
+                warmup_mod.WarmupPlan(
+                    buckets=tuple(
+                        warmup_mod.parse_bucket_list(args.warmup_buckets)
+                    ),
+                    lanes=args.batch_lanes,
+                )
+            )
+        if args.warmup_replay:
+            plans.append(warmup_mod.load_bucket_record(args.warmup_replay))
+        warmup_plan = warmup_mod.merge_plans(*plans)
+
     service = MSTService(
         backend=args.backend,
         store_capacity=args.cache_entries,
@@ -353,11 +382,31 @@ def _cmd_serve(args) -> int:
         max_concurrent=args.max_concurrent,
         resolve_threshold=args.resolve_threshold,
         batch_lanes=args.batch_lanes,
+        warmup=warmup_plan,
     )
-    if args.input:
-        with open(args.input) as f:
-            return serve_loop(f, sys.stdout, service)
-    return serve_loop(sys.stdin, sys.stdout, service)
+    if service.warmup_report is not None:
+        print(f"warmup: {json.dumps(service.warmup_report)}", file=sys.stderr)
+    try:
+        if args.input:
+            with open(args.input) as f:
+                return serve_loop(f, sys.stdout, service)
+        return serve_loop(sys.stdin, sys.stdout, service)
+    finally:
+        if args.warmup_record:
+            from distributed_ghs_implementation_tpu.batch import warmup as warmup_mod
+
+            # Traffic-only record: the shapes requests actually hit, not
+            # whatever a warmup ladder happened to compile — replayed
+            # records converge to real traffic across restarts.
+            count = warmup_mod.save_bucket_record(
+                args.warmup_record,
+                shape_buckets=list(service.seen_buckets),
+                include_compiled=False,
+            )
+            print(
+                f"warmup record: {count} bucket(s) -> {args.warmup_record}",
+                file=sys.stderr,
+            )
 
 
 def _cmd_bench(args) -> int:
@@ -372,6 +421,8 @@ def _cmd_bench(args) -> int:
         argv += ["--metrics-out", args.metrics_out]
     if args.batch_lanes:
         argv += ["--batch-lanes", str(args.batch_lanes)]
+    if args.warmup:
+        argv.append("--warmup")
     return bench_mod.main(argv)
 
 
@@ -529,6 +580,33 @@ def build_parser() -> argparse.ArgumentParser:
         help="coalesce device-backend cache misses into multi-graph device "
         "batches of up to this many lanes (0 = off; docs/BATCHING.md)",
     )
+    srv.add_argument(
+        "--warmup-buckets",
+        help="AOT-precompile these workload shapes before serving: "
+        "comma-separated NODESxEDGES (e.g. 128x512,300x1200; shapes bucket "
+        "exactly like requests do) or 'auto' for the default ladder",
+    )
+    srv.add_argument(
+        "--warmup-replay",
+        help="AOT-precompile the buckets recorded in this file (written by "
+        "--warmup-record on a prior run)",
+    )
+    srv.add_argument(
+        "--warmup-record",
+        help="on exit, record the buckets this process compiled to this "
+        "file (feed it to --warmup-replay after a restart)",
+    )
+    srv.add_argument(
+        "--compile-cache-dir",
+        help="persistent XLA compile-cache directory (default "
+        "$GHS_COMPILE_CACHE_DIR or ~/.cache/ghs-xla, under a per-machine "
+        "subdirectory so heterogeneous hosts never share AOT executables)",
+    )
+    srv.add_argument(
+        "--no-compile-cache", action="store_true",
+        help="disable the persistent XLA compile cache (on by default for "
+        "serve: restarts reuse compiled executables)",
+    )
     srv.add_argument("--input",
                      help="read JSONL requests from this file instead of stdin")
     srv.set_defaults(fn=_cmd_serve)
@@ -546,6 +624,11 @@ def build_parser() -> argparse.ArgumentParser:
         help="instead of the RMAT bench, measure batched small-graph "
         "throughput (graphs/sec) at this lane count vs the sequential "
         "miss path (bench.py --batch-lanes)",
+    )
+    b.add_argument(
+        "--warmup", action="store_true",
+        help="with --batch-lanes: AOT-precompile the bucket before the "
+        "cold-first-query clock (bench.py --warmup)",
     )
     b.set_defaults(fn=_cmd_bench)
     return p

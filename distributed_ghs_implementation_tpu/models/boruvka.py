@@ -28,6 +28,7 @@ from __future__ import annotations
 
 import functools
 import math
+import time
 from typing import NamedTuple, Tuple
 
 import jax
@@ -358,8 +359,6 @@ def solve_arrays_stepped(
     wall_time_s)`` fires after each stepped level. Returns
     ``(mst_ranks, fragment, levels)``.
     """
-    import time
-
     n = fragment0.shape[0]
     if initial_state is not None:
         fragment, mst_ranks, levels = initial_state

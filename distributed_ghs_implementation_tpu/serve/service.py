@@ -24,10 +24,15 @@ Errors never kill the loop: a malformed line or a failed request produces
 from __future__ import annotations
 
 import collections
+import dataclasses
 import json
 from typing import IO, Optional
 
 from distributed_ghs_implementation_tpu.api import MSTResult
+from distributed_ghs_implementation_tpu.batch.warmup import (
+    bucket_of,
+    warmable_single,
+)
 from distributed_ghs_implementation_tpu.graphs.edgelist import Graph
 from distributed_ghs_implementation_tpu.obs.events import BUS
 from distributed_ghs_implementation_tpu.serve.dynamic import DynamicMST
@@ -55,6 +60,7 @@ class MSTService:
         resolve_threshold: Optional[int] = None,
         max_sessions: int = _MAX_SESSIONS,
         batch_lanes: int = 0,
+        warmup=None,
     ):
         self.store = store if store is not None else ResultStore(
             capacity=store_capacity, disk_dir=disk_dir
@@ -79,6 +85,42 @@ class MSTService:
         self._sessions: "collections.OrderedDict[str, object]" = (
             collections.OrderedDict()
         )
+        # Shape buckets traffic actually hit (insertion-ordered) — the
+        # warmup record's input, so even a no-batch-engine serve records
+        # what a restart should warm (single-graph kernels).
+        self.seen_buckets: "collections.OrderedDict[tuple, None]" = (
+            collections.OrderedDict()
+        )
+        # Warmup phase: precompile the declared buckets BEFORE the first
+        # request, so a pre-declared bucket's first query runs against an
+        # already-compiled executable (compile.warmup vs compile.miss on
+        # the bus tells warm from cold — docs/SERVING.md "Warmup").
+        self.warmup_report = None
+        if warmup is not None:
+            from distributed_ghs_implementation_tpu.batch.warmup import (
+                WarmupPlan,
+                run_warmup,
+            )
+
+            if not isinstance(warmup, WarmupPlan):
+                raise TypeError(
+                    f"warmup must be a batch.warmup.WarmupPlan, got "
+                    f"{type(warmup).__name__}"
+                )
+            # Normalize the plan to THIS service's lane geometry: replayed
+            # keys recorded at a different --batch-lanes (or declared bare
+            # shape buckets) must warm the solvers this process actually
+            # dispatches — otherwise the first query pays a request-time
+            # compile despite warmup "succeeding".
+            shapes = tuple(dict.fromkeys(
+                tuple(warmup.buckets)
+                + tuple((n, m) for n, m, _, _ in warmup.keys)
+            ))
+            warmup = dataclasses.replace(
+                warmup, buckets=shapes, keys=(), lanes=batch_lanes,
+                mode=engine.policy.mode if engine else "fused",
+            )
+            self.warmup_report = run_warmup(warmup)
 
     # ------------------------------------------------------------------
     def handle(self, request: dict) -> dict:
@@ -105,6 +147,12 @@ class MSTService:
     def _handle_solve(self, request: dict) -> dict:
         graph = self._load_graph(request)
         backend = request.get("backend", self.backend)
+        bucket = bucket_of(graph.num_nodes, graph.num_edges)
+        if warmable_single(*bucket):
+            # Oversize buckets route to the rank solver, not the fused
+            # kernel warmup compiles — recording them would make replay
+            # pay boot-time compiles no request ever hits.
+            self.seen_buckets[bucket] = None
         result, source = self.scheduler.solve(graph, backend=backend)
         digest = graph.digest()
         self._remember(digest, result, backend)
@@ -175,15 +223,18 @@ class MSTService:
         counters = {
             name: value
             for name, value in BUS.counters().items()
-            if name.startswith(("serve.", "batch."))
+            if name.startswith(("serve.", "batch.", "compile."))
         }
-        return {
+        out = {
             "ok": True,
             "op": "stats",
             "counters": counters,
             "store": self.store.stats(),
             "sessions": len(self._sessions),
         }
+        if self.warmup_report is not None:
+            out["warmup"] = self.warmup_report
+        return out
 
     # ------------------------------------------------------------------
     def _load_graph(self, request: dict) -> Graph:

@@ -1,5 +1,9 @@
 """Utilities: verification oracles, resilience, visualization, reporting."""
 
+from distributed_ghs_implementation_tpu.utils.compile_cache import (
+    cache_stats,
+    enable_persistent_cache,
+)
 from distributed_ghs_implementation_tpu.utils.resilience import (
     FAULTS,
     Supervisor,
@@ -16,6 +20,8 @@ __all__ = [
     "FAULTS",
     "Supervisor",
     "SupervisorConfig",
+    "cache_stats",
+    "enable_persistent_cache",
     "networkx_mst_weight",
     "scipy_mst_weight",
     "supervised_solve",

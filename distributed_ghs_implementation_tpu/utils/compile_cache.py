@@ -1,0 +1,224 @@
+"""Persistent XLA compile cache: restarts reuse compiled executables.
+
+Without it, every process restart re-pays XLA compilation for every kernel
+shape it touches — for a serving process that is the cold-start tax the
+warmup phase (``batch/warmup.py``) then multiplies by the bucket count.
+:func:`enable_persistent_cache` turns on JAX's on-disk compilation cache
+(thresholds zeroed so even small kernels persist), which drops repeat
+compiles to a disk read. ``ghs serve`` enables it by default
+(``--no-compile-cache`` opts out, ``--compile-cache-dir`` relocates it);
+the default directory is ``$GHS_COMPILE_CACHE_DIR`` or
+``~/.cache/ghs-xla``, with a per-machine-type subdirectory
+(:func:`_platform_fingerprint`) so a shared home directory across a
+heterogeneous fleet can never reload another CPU's AOT executables.
+
+The module also bridges JAX's internal cache telemetry onto the obs bus
+(``compile.*`` taxonomy, docs/OBSERVABILITY.md) so cold vs warm is visible
+in traces and ``stats``:
+
+* counters ``compile.persistent.hit`` / ``compile.persistent.miss`` — the
+  on-disk cache's own hit/miss stream (a "miss" here still populates the
+  disk for the next restart);
+* histograms ``compile.backend_s`` (actual XLA backend compiles) and
+  ``compile.cache_retrieval_s`` (deserializing a cached executable) — the
+  two durations whose gap IS the cache's value.
+
+Relationship to the package ``__init__``: that hook enables the same JAX
+cache for *accelerator* sessions at import time (where a cold compile
+costs ~10 s/shape) and deliberately skips CPU. ``enable_persistent_cache``
+is the explicit, serving-grade version: any platform, thresholds zeroed
+(serve's lane solvers are many small kernels), and when the import-time
+hook already configured a directory this function reuses it rather than
+repointing — one cache per deployment, whoever enabled it first.
+
+Everything degrades gracefully: on a JAX build without the config knobs or
+monitoring hooks the functions no-op and return ``None``/``False`` — the
+solver stack never depends on the cache existing.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from typing import Optional
+
+from distributed_ghs_implementation_tpu.obs.events import BUS
+
+#: Monitoring-event suffix -> obs counter name (anything else under the
+#: compilation-cache prefix lands as ``compile.persistent.<suffix>``).
+_EVENT_COUNTERS = {
+    "cache_hits": "compile.persistent.hit",
+    "cache_misses": "compile.persistent.miss",
+}
+_CACHE_EVENT_PREFIX = "/jax/compilation_cache/"
+_DURATION_HISTS = {
+    "/jax/core/compile/backend_compile_duration": "compile.backend_s",
+    "/jax/compilation_cache/cache_retrieval_time_sec": "compile.cache_retrieval_s",
+}
+
+_state = {"dir": None, "bridge_installed": False}
+_lock = threading.Lock()
+
+
+def default_cache_dir() -> str:
+    # GHS_TPU_COMPILE_CACHE is the package __init__'s knob for the same
+    # cache — honoring it keeps one directory per deployment.
+    return (
+        os.environ.get("GHS_COMPILE_CACHE_DIR")
+        or os.environ.get("GHS_TPU_COMPILE_CACHE")
+        or os.path.join(os.path.expanduser("~"), ".cache", "ghs-xla")
+    )
+
+
+def _platform_fingerprint() -> str:
+    """A cache-namespace token for the executing hardware.
+
+    Cached CPU executables embed ISA-feature assumptions (the package
+    ``__init__`` documents observed "+prefer-no-scatter ... SIGILL"
+    loader warnings from cross-machine reloads), so the DEFAULT cache
+    directory is namespaced per backend + CPU feature set: a shared home
+    directory across a heterogeneous fleet gets one subcache per distinct
+    machine type instead of one poisoned pool. Accelerators namespace by
+    device kind (their executables are device-bound anyway).
+    """
+    import hashlib
+    import platform as plat
+
+    import jax
+
+    backend = jax.default_backend()
+    if backend != "cpu":
+        try:
+            kind = jax.devices()[0].device_kind.replace(" ", "-")
+        except Exception:
+            kind = backend
+        return f"{backend}-{kind}"
+    features = ""
+    try:
+        with open("/proc/cpuinfo") as f:
+            for line in f:
+                if line.startswith(("flags", "Features")):
+                    features = line.strip()
+                    break
+    except OSError:
+        pass
+    token = f"{plat.machine()}|{features}"
+    return f"cpu-{plat.machine()}-{hashlib.sha256(token.encode()).hexdigest()[:12]}"
+
+
+def _on_event(event: str, **kw) -> None:
+    if event.startswith(_CACHE_EVENT_PREFIX):
+        suffix = event[len(_CACHE_EVENT_PREFIX):]
+        BUS.count(_EVENT_COUNTERS.get(suffix, f"compile.persistent.{suffix}"))
+
+
+def _on_duration(event: str, duration_s: float, **kw) -> None:
+    hist = _DURATION_HISTS.get(event)
+    if hist is not None:
+        BUS.record(hist, duration_s)
+
+
+def _install_monitoring_bridge() -> bool:
+    """Route JAX's cache/compile telemetry onto the obs bus (idempotent)."""
+    with _lock:
+        if _state["bridge_installed"]:
+            return True
+        try:
+            from jax import monitoring
+
+            monitoring.register_event_listener(_on_event)
+            monitoring.register_event_duration_secs_listener(_on_duration)
+        except Exception:  # pragma: no cover — older/renamed monitoring API
+            return False
+        _state["bridge_installed"] = True
+        return True
+
+
+def enable_persistent_cache(cache_dir: Optional[str] = None) -> Optional[str]:
+    """Enable JAX's on-disk compilation cache; returns the directory in use.
+
+    Idempotent (re-enabling with a different directory repoints the
+    cache). Thresholds are zeroed so every compile persists — this repo's
+    kernels are small and numerous, exactly the population the default
+    min-compile-time filter would skip. Returns ``None`` when the JAX
+    build doesn't support the cache config (the caller proceeds uncached).
+    """
+    import jax
+
+    if cache_dir is None:
+        # The package __init__ may have configured the cache already (TPU
+        # sessions); reuse its directory instead of splitting the cache.
+        try:
+            configured = jax.config.jax_compilation_cache_dir
+        except Exception:
+            configured = None
+        if configured:
+            cache_dir = configured
+        else:
+            # Default location: namespace per machine type so reloading
+            # another CPU's AOT executables (SIGILL risk) is impossible
+            # by construction. An explicit cache_dir is the operator's
+            # exact path — no namespacing.
+            cache_dir = os.path.join(default_cache_dir(), _platform_fingerprint())
+    path = os.path.abspath(cache_dir)
+    try:
+        os.makedirs(path, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", path)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+    except Exception:
+        BUS.instant("compile.cache.unavailable", cat="compile")
+        return None
+    try:
+        # A process that already compiled something has a lazily-initialized
+        # cache bound to the OLD dir (or to none); rebind it. Best-effort —
+        # on a JAX without this internal the config alone covers the common
+        # enable-before-first-compile case (serve does exactly that).
+        from jax._src import compilation_cache as _jax_cc
+
+        _jax_cc.reset_cache()
+    except Exception:  # pragma: no cover
+        pass
+    _install_monitoring_bridge()
+    with _lock:
+        _state["dir"] = path
+    BUS.instant("compile.cache.enabled", cat="compile", dir=path)
+    return path
+
+
+def disable_persistent_cache() -> None:
+    """Turn the on-disk cache back off (tests restore global state)."""
+    import jax
+
+    try:
+        jax.config.update("jax_compilation_cache_dir", None)
+    except Exception:
+        return
+    try:
+        from jax._src import compilation_cache as _jax_cc
+
+        _jax_cc.reset_cache()
+    except Exception:  # pragma: no cover
+        pass
+    with _lock:
+        _state["dir"] = None
+
+
+def cache_stats() -> dict:
+    """Disk-side view of the persistent cache (for stats/drill artifacts)."""
+    path = _state["dir"]
+    stats = {
+        "enabled": path is not None,
+        "dir": path,
+        "entries": 0,
+        "bytes": 0,
+    }
+    if path and os.path.isdir(path):
+        for name in os.listdir(path):
+            if name.endswith("-cache"):
+                stats["entries"] += 1
+            try:
+                stats["bytes"] += os.path.getsize(os.path.join(path, name))
+            except OSError:
+                continue
+    return stats

@@ -257,13 +257,13 @@ def test_engine_nontransient_error_raises():
 
     import distributed_ghs_implementation_tpu.batch.engine as eng_mod
 
-    orig = eng_mod.solve_lanes
-    eng_mod.solve_lanes = boom
+    orig = eng_mod.execute_stacked
+    eng_mod.execute_stacked = boom
     try:
         with pytest.raises(ValueError, match="programming error"):
             engine.solve_many(graphs)
     finally:
-        eng_mod.solve_lanes = orig
+        eng_mod.execute_stacked = orig
 
 
 def test_engine_submit_coalesces_concurrent_misses():

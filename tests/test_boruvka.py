@@ -631,3 +631,60 @@ def test_bucket_size_boundaries():
     for x in range(1, 4097):
         b = _bucket_size(x)
         assert x <= b <= max(x + 1, (x * 5 + 3) // 4)
+
+
+# ----------------------------------------------------------------------
+# _compact_kernel padding semantics (the host-stepped shrink path)
+# ----------------------------------------------------------------------
+def test_compact_kernel_dead_slots_stay_sentinel():
+    """Compaction packs alive slots (src_f != dst_f) in order; every dead
+    and every pad slot must come out as the inert pattern — vertex-0
+    self-edge, rank INT32_MAX — so a later MOE can never pick one. In
+    particular a dead slot's REAL rank must not leak into the buffer."""
+    from distributed_ghs_implementation_tpu.models.boruvka import _compact_kernel
+    from distributed_ghs_implementation_tpu.ops.segment_ops import INT32_MAX
+
+    src_f = np.array([1, 2, 2, 5, 7, 7, 9], np.int32)
+    dst_f = np.array([1, 3, 2, 6, 7, 8, 9], np.int32)
+    rank = np.array([10, 11, 12, 13, 14, 15, 16], np.int32)
+    new_src, new_dst, new_rank = map(
+        np.asarray, _compact_kernel(src_f, dst_f, rank, 4)
+    )
+    assert new_src.tolist() == [2, 5, 7, 0]
+    assert new_dst.tolist() == [3, 6, 8, 0]
+    assert new_rank.tolist() == [11, 13, 15, INT32_MAX]
+    # Dead slots' ranks (10, 12, 14, 16) never appear in the output.
+    assert not set(new_rank.tolist()) & {10, 12, 14, 16}
+
+
+def test_compact_kernel_all_dead_is_all_sentinel():
+    from distributed_ghs_implementation_tpu.models.boruvka import _compact_kernel
+    from distributed_ghs_implementation_tpu.ops.segment_ops import INT32_MAX
+
+    same = np.array([3, 3, 0, 7], np.int32)
+    rank = np.array([1, 2, 3, 4], np.int32)
+    new_src, new_dst, new_rank = map(
+        np.asarray, _compact_kernel(same, same, rank, 2)
+    )
+    assert new_src.tolist() == [0, 0]
+    assert new_dst.tolist() == [0, 0]
+    assert new_rank.tolist() == [INT32_MAX, INT32_MAX]
+
+
+def test_compact_kernel_undersized_buffer_truncates_safely():
+    """``out_size`` below the alive count must not crash or scribble out of
+    bounds: the overflow scatters drop, keeping the FIRST ``out_size``
+    alive slots in slot order (the callers never request this — they size
+    by the alive count — but the kernel's contract is safe truncation)."""
+    from distributed_ghs_implementation_tpu.models.boruvka import _compact_kernel
+
+    src_f = np.array([0, 1, 2, 3, 4, 5], np.int32)
+    dst_f = np.array([9, 8, 7, 6, 5, 4], np.int32)  # every slot alive
+    rank = np.arange(20, 26, dtype=np.int32)
+    new_src, new_dst, new_rank = map(
+        np.asarray, _compact_kernel(src_f, dst_f, rank, 4)
+    )
+    assert new_src.shape == (4,)
+    assert new_src.tolist() == [0, 1, 2, 3]
+    assert new_dst.tolist() == [9, 8, 7, 6]
+    assert new_rank.tolist() == [20, 21, 22, 23]

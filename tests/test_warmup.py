@@ -1,0 +1,540 @@
+"""Warm-path executor (round 10): AOT bucket precompilation, warmup
+record/replay, the persistent compile cache plumbing, and pipelined
+batch dispatch.
+
+Gates: a warmup-precompiled bucket serves its first query with ZERO
+request-time lane-solver compiles (``compile.miss`` stays 0 and the
+request counts ``batch.compile.hit`` / ``compile.hit`` — never a fresh
+compile); record -> restart -> replay round-trips to the same guarantee;
+and the pipelined ``solve_many`` is result- and incident-identical to the
+synchronous path.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+from distributed_ghs_implementation_tpu.api import minimum_spanning_forest
+from distributed_ghs_implementation_tpu.batch.engine import BatchEngine
+from distributed_ghs_implementation_tpu.batch.lanes import (
+    bucket_key,
+    clear_solver_cache,
+    compiled_bucket_keys,
+    precompile_bucket,
+)
+from distributed_ghs_implementation_tpu.batch.policy import BatchPolicy
+from distributed_ghs_implementation_tpu.batch.warmup import (
+    WarmupPlan,
+    bucket_of,
+    default_ladder,
+    load_bucket_record,
+    merge_plans,
+    parse_bucket_list,
+    run_warmup,
+    save_bucket_record,
+)
+from distributed_ghs_implementation_tpu.graphs.generators import gnm_random_graph
+from distributed_ghs_implementation_tpu.obs.events import BUS
+from distributed_ghs_implementation_tpu.utils.resilience import (
+    FAULTS,
+    SupervisorConfig,
+)
+
+
+@pytest.fixture(autouse=True)
+def _clean_global_bus():
+    BUS.enable()
+    BUS.clear()
+    yield
+    BUS.enable()
+    BUS.clear()
+
+
+def _fast_config():
+    return SupervisorConfig(retries_per_rung=1, backoff_base_s=0.0)
+
+
+def _counter(name: str) -> float:
+    return BUS.counters().get(name, 0)
+
+
+# ----------------------------------------------------------------------
+# Plans: parsing, ladders, merging, record files
+# ----------------------------------------------------------------------
+def test_parse_bucket_list_buckets_raw_sizes():
+    # Raw workload sizes bucket exactly like requests do, duplicates collapse.
+    assert parse_bucket_list("128x512,300x1200") == [(128, 512), (512, 2048)]
+    assert parse_bucket_list("100x300, 128x512") == [(128, 512)]
+    assert parse_bucket_list("") == []
+
+
+def test_parse_bucket_list_auto_is_the_ladder():
+    ladder = parse_bucket_list("auto")
+    assert ladder == default_ladder()
+    assert ladder
+    for n, m in ladder:
+        assert n & (n - 1) == 0 and m & (m - 1) == 0  # padded shapes
+
+
+def test_parse_bucket_list_rejects_garbage():
+    with pytest.raises(ValueError, match="bucket spec"):
+        parse_bucket_list("128")
+    with pytest.raises(ValueError):
+        parse_bucket_list("ax b")
+    with pytest.raises(ValueError, match="positive"):
+        parse_bucket_list("0x8")
+
+
+def test_merge_plans_unions_and_keeps_lane_geometry():
+    a = WarmupPlan(buckets=((128, 512),), lanes=4)
+    b = WarmupPlan(buckets=((128, 512), (256, 1024)), keys=((64, 256, 8, "fused"),))
+    merged = merge_plans(a, b)
+    assert merged.buckets == ((128, 512), (256, 1024))
+    assert merged.keys == ((64, 256, 8, "fused"),)
+    assert merged.lanes == 4
+
+
+def test_bucket_record_round_trip(tmp_path):
+    clear_solver_cache()
+    precompile_bucket(64, 256, 4, "fused")
+    path = str(tmp_path / "buckets.json")
+    assert save_bucket_record(path) == 1
+    plan = load_bucket_record(path)
+    assert plan.keys == ((64, 256, 4, "fused"),)
+
+
+def test_load_bucket_record_rejects_bad_schema(tmp_path):
+    path = tmp_path / "bad.json"
+    path.write_text('{"schema": "something-else", "buckets": []}')
+    with pytest.raises(ValueError, match="schema"):
+        load_bucket_record(str(path))
+
+
+# ----------------------------------------------------------------------
+# AOT precompilation: zero request-time compiles
+# ----------------------------------------------------------------------
+def test_precompiled_bucket_serves_first_query_without_compiling():
+    """The tentpole guarantee: after warmup covers a bucket, the first
+    request on it is a compile-cache HIT — ``compile.miss`` stays zero."""
+    clear_solver_cache()
+    graphs = [gnm_random_graph(50, 150, seed=s) for s in range(3)]
+    n_pad, m_pad = bucket_key(graphs[0])
+    assert precompile_bucket(n_pad, m_pad, 4, "fused") is True
+    assert _counter("compile.warmup") == 1
+    assert _counter("compile.miss") == 0
+    # Idempotent: a second precompile is a cache hit, not a recompile.
+    assert precompile_bucket(n_pad, m_pad, 4, "fused") is False
+
+    engine = BatchEngine(policy=BatchPolicy(max_lanes=4))
+    results = engine.solve_many(graphs)
+    assert _counter("compile.miss") == 0
+    assert _counter("compile.hit") >= 1
+    assert _counter("batch.compile.hit") >= 1
+    for g, r in zip(graphs, results):
+        assert np.array_equal(r.edge_ids, minimum_spanning_forest(g).edge_ids)
+
+
+def test_run_warmup_reports_compiled_vs_cached():
+    clear_solver_cache()
+    plan = WarmupPlan(buckets=((64, 256),), lanes=4)
+    first = run_warmup(plan)
+    assert first["compiled"] == 1 and first["cached"] == 0
+    assert first["single_warmed"] == 1
+    again = run_warmup(plan)
+    assert again["compiled"] == 0 and again["cached"] == 1
+    assert run_warmup(WarmupPlan()) == {
+        "buckets": 0, "compiled": 0, "cached": 0, "skipped": 0,
+        "single_warmed": 0, "wall_s": 0.0,
+    }
+
+
+def test_warmup_replay_round_trip_restart_compiles_nothing_at_request_time():
+    """Record buckets from live traffic -> 'restart' (solver cache
+    cleared) -> replay -> the query phase performs zero request-time
+    compiles (the satellite-4 acceptance)."""
+    clear_solver_cache()
+    graphs = [gnm_random_graph(40, 100, seed=s) for s in range(4)]
+    engine = BatchEngine(policy=BatchPolicy(max_lanes=4))
+    engine.solve_many(graphs)  # cold process: this pays a request-time compile
+    assert _counter("compile.miss") >= 1
+    import tempfile
+
+    with tempfile.TemporaryDirectory() as d:
+        record = os.path.join(d, "buckets.json")
+        assert save_bucket_record(record) >= 1
+
+        clear_solver_cache()  # simulated restart
+        BUS.clear()
+        run_warmup(load_bucket_record(record))
+        assert _counter("compile.warmup") >= 1
+        assert _counter("compile.miss") == 0
+
+        engine2 = BatchEngine(policy=BatchPolicy(max_lanes=4))
+        results = engine2.solve_many(graphs)
+        assert _counter("compile.miss") == 0  # zero request-time compiles
+        assert _counter("batch.compile.hit") >= 1
+        for g, r in zip(graphs, results):
+            assert np.array_equal(
+                r.edge_ids, minimum_spanning_forest(g).edge_ids
+            )
+
+
+def test_scheduler_solve_batch_after_warmup_is_a_compile_hit():
+    """The satellite-3 fix: a warmup-precompiled bucket reached through
+    ``solve_batch`` counts as a compile-cache hit, never a fresh compile."""
+    from distributed_ghs_implementation_tpu.serve.scheduler import SolveScheduler
+
+    clear_solver_cache()
+    g1 = gnm_random_graph(50, 150, seed=31)
+    g2 = gnm_random_graph(50, 150, seed=32)
+    n_pad, m_pad = bucket_key(g1)
+    precompile_bucket(n_pad, m_pad, 4, "fused")
+    misses_after_warmup = _counter("batch.compile.miss")
+    engine = BatchEngine(
+        policy=BatchPolicy(max_lanes=4), supervisor_config=_fast_config()
+    )
+    sched = SolveScheduler(batch_engine=engine)
+    out = sched.solve_batch([g1, g2])
+    assert [s for _, s in out] == ["solved", "solved"]
+    assert _counter("batch.compile.miss") == misses_after_warmup  # no new ones
+    assert _counter("batch.compile.hit") >= 1
+    assert _counter("compile.miss") == 0
+
+
+def test_service_warmup_phase(tmp_path):
+    from distributed_ghs_implementation_tpu.serve.service import MSTService
+
+    clear_solver_cache()
+    svc = MSTService(
+        batch_lanes=2,
+        warmup=WarmupPlan(buckets=(bucket_of(60, 180),)),
+    )
+    assert svc.warmup_report is not None
+    assert svc.warmup_report["compiled"] >= 1
+    # The service filled in its own lane geometry (lanes=2).
+    assert (64, 256, 2, "fused") in compiled_bucket_keys()
+    g = gnm_random_graph(60, 180, seed=11)
+    edges = [[int(a), int(b), int(c)] for a, b, c in zip(g.u, g.v, g.w)]
+    first = svc.handle({"op": "solve", "num_nodes": 60, "edges": edges})
+    assert first["ok"] and first["backend"] == "batch/fused"
+    stats = svc.handle({"op": "stats"})
+    assert stats["warmup"]["compiled"] >= 1
+    assert stats["counters"].get("compile.warmup", 0) >= 1
+    assert stats["counters"].get("compile.miss", 0) == 0  # warm first query
+    assert stats["counters"].get("compile.hit", 0) >= 1
+
+
+def test_service_rejects_non_plan_warmup():
+    from distributed_ghs_implementation_tpu.serve.service import MSTService
+
+    with pytest.raises(TypeError, match="WarmupPlan"):
+        MSTService(warmup={"buckets": [(64, 256)]})
+
+
+# ----------------------------------------------------------------------
+# Persistent compile cache
+# ----------------------------------------------------------------------
+def test_persistent_cache_enable_and_stats(tmp_path):
+    import jax
+
+    from distributed_ghs_implementation_tpu.utils import compile_cache as cc
+
+    d = str(tmp_path / "xla-cache")
+    try:
+        assert cc.enable_persistent_cache(d) == os.path.abspath(d)
+        assert os.path.isdir(d)
+        # Compile something novel so an entry lands on disk.
+        fn = jax.jit(lambda x: x * 3 + 7)
+        np.asarray(fn(np.arange(16, dtype=np.int32)))
+        stats = cc.cache_stats()
+        assert stats["enabled"] and stats["dir"] == os.path.abspath(d)
+        assert stats["entries"] >= 1
+        assert stats["bytes"] > 0
+    finally:
+        cc.disable_persistent_cache()
+    assert cc.cache_stats()["enabled"] is False
+
+
+# ----------------------------------------------------------------------
+# Pipelined dispatch
+# ----------------------------------------------------------------------
+def test_pipelined_solve_many_parity_and_counters():
+    graphs = [gnm_random_graph(60, 150, seed=s) for s in range(12)]
+    engine = BatchEngine(
+        policy=BatchPolicy(
+            max_lanes=4, pipeline_depth=2, pipeline_min_stack_elems=0
+        ),
+        supervisor_config=_fast_config(),
+    )
+    results = engine.solve_many(graphs)
+    counters = BUS.counters()
+    assert counters["batch.batches.formed"] == 3
+    assert counters["batch.pipeline.batches"] == 3
+    assert counters["batch.lanes.formed"] == 12
+    hists = BUS.histograms()
+    assert hists["batch.form_s"]["count"] == 3
+    assert hists["batch.pipeline.stall_s"]["count"] == 3
+    for g, r in zip(graphs, results):
+        seq = minimum_spanning_forest(g)
+        assert np.array_equal(r.edge_ids, seq.edge_ids)
+        assert r.backend == "batch/fused"
+
+
+def test_pipeline_depth_one_is_fully_synchronous():
+    graphs = [gnm_random_graph(60, 150, seed=s) for s in range(8)]
+    engine = BatchEngine(
+        policy=BatchPolicy(max_lanes=4, pipeline_depth=1),
+        supervisor_config=_fast_config(),
+    )
+    results = engine.solve_many(graphs)
+    counters = BUS.counters()
+    assert counters["batch.batches.formed"] == 2
+    assert "batch.pipeline.batches" not in counters
+    for g, r in zip(graphs, results):
+        assert np.array_equal(r.edge_ids, minimum_spanning_forest(g).edge_ids)
+
+
+def test_single_batch_skips_the_pipeline():
+    graphs = [gnm_random_graph(60, 150, seed=s) for s in range(3)]
+    engine = BatchEngine(
+        policy=BatchPolicy(
+            max_lanes=4, pipeline_depth=2, pipeline_min_stack_elems=0
+        )
+    )
+    engine.solve_many(graphs)
+    assert "batch.pipeline.batches" not in BUS.counters()
+
+
+def test_pipelined_retry_and_fallback_identical_to_sync():
+    """Injected batch faults behave exactly as on the synchronous path:
+    every batch degrades to per-lane supervised solves, results stay
+    correct, incidents stay per-lane."""
+    graphs = [gnm_random_graph(40, 100, seed=s) for s in range(8)]
+    engine = BatchEngine(
+        policy=BatchPolicy(
+            max_lanes=4, pipeline_depth=2, pipeline_min_stack_elems=0
+        ),
+        supervisor_config=_fast_config(),
+    )
+    with FAULTS.inject("batch.attempt", times=100):
+        results = engine.solve_many(graphs)
+    counters = BUS.counters()
+    assert counters["batch.pipeline.batches"] == 2
+    assert counters["batch.lane.fallback"] == 8
+    for g, r in zip(graphs, results):
+        assert np.array_equal(r.edge_ids, minimum_spanning_forest(g).edge_ids)
+        assert r.backend.startswith("supervised/")
+        assert r.incidents is not None
+        assert [rec.rung for rec in r.incidents.records][:2] == ["batch", "batch"]
+
+
+def test_pipelined_forming_error_propagates_like_sync():
+    """A former-thread stacking failure surfaces as the same exception the
+    synchronous path raises (re-stacked on the dispatch thread), and the
+    former shuts down instead of leaking."""
+    import distributed_ghs_implementation_tpu.batch.engine as eng_mod
+
+    graphs = [gnm_random_graph(60, 150, seed=s) for s in range(8)]
+    engine = BatchEngine(
+        policy=BatchPolicy(
+            max_lanes=4, pipeline_depth=2, pipeline_min_stack_elems=0
+        ),
+        supervisor_config=_fast_config(),
+    )
+
+    def boom(*a, **k):
+        raise ValueError("stacking exploded")
+
+    orig = eng_mod.stack_lanes
+    eng_mod.stack_lanes = boom
+    try:
+        with pytest.raises(ValueError, match="stacking exploded"):
+            engine.solve_many(graphs)
+    finally:
+        eng_mod.stack_lanes = orig
+
+
+def test_policy_rejects_bad_pipeline_depth():
+    with pytest.raises(ValueError, match="pipeline_depth"):
+        BatchPolicy(pipeline_depth=0)
+
+
+def test_small_stacks_stay_synchronous_by_default():
+    """The default ``pipeline_min_stack_elems`` floor: tiny per-batch
+    stacks (where handoff overhead beats the overlap) run synchronously
+    even at pipeline_depth=2."""
+    graphs = [gnm_random_graph(60, 150, seed=s) for s in range(8)]  # 2 batches
+    engine = BatchEngine(policy=BatchPolicy(max_lanes=4))
+    results = engine.solve_many(graphs)
+    counters = BUS.counters()
+    assert counters["batch.batches.formed"] == 2
+    assert "batch.pipeline.batches" not in counters
+    for g, r in zip(graphs, results):
+        assert np.array_equal(r.edge_ids, minimum_spanning_forest(g).edge_ids)
+
+
+def test_shape_only_record_entries_warm_single_graph_kernel(tmp_path):
+    """A serve without the lane engine records traffic shapes with
+    ``lanes=0``; replay warms the single-graph kernel for them and
+    precompiles no lane solver."""
+    clear_solver_cache()
+    path = str(tmp_path / "rec.json")
+    assert save_bucket_record(path, shape_buckets=[(128, 4)]) == 1
+    plan = load_bucket_record(path)
+    assert plan.keys == ((128, 4, 0, "fused"),)
+    report = run_warmup(plan)
+    assert report["buckets"] == 0 and report["compiled"] == 0
+    assert report["single_warmed"] == 1
+    assert compiled_bucket_keys() == []  # no lane solver materialized
+
+
+def test_concurrent_get_solver_compiles_once():
+    """Two threads racing a cold bucket: one leads the compile (outside
+    the cache lock), the other waits and reads the published entry —
+    exactly one ``batch.compile.miss``, and a hit on an UNRELATED warm
+    bucket is never blocked behind it."""
+    import threading
+
+    from distributed_ghs_implementation_tpu.batch.lanes import _get_solver
+
+    clear_solver_cache()
+    results = []
+
+    def worker():
+        results.append(_get_solver(32, 64, 3, "fused"))
+
+    threads = [threading.Thread(target=worker) for _ in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=120)
+    assert len(results) == 4
+    assert all(r is results[0] for r in results)  # one shared executable
+    assert _counter("batch.compile.miss") == 1
+    assert _counter("batch.compile.hit") == 3
+
+
+def test_oversize_buckets_are_not_single_warmed():
+    """Buckets the solver routes to the rank solver must never be warmed
+    through the fused kernel (a replay would otherwise pay boot-time
+    compiles no request ever hits) — and the service must not record
+    them."""
+    from distributed_ghs_implementation_tpu.batch.warmup import (
+        MAX_SINGLE_WARM_EDGES,
+        warmable_single,
+    )
+
+    assert warmable_single(64, 256)
+    assert not warmable_single(64, 2 * MAX_SINGLE_WARM_EDGES)
+    report = run_warmup(
+        WarmupPlan(buckets=((64, 2 * MAX_SINGLE_WARM_EDGES),), lanes=0)
+    )
+    assert report["single_warmed"] == 0
+
+
+def test_service_records_seen_buckets_for_warmup_record():
+    from distributed_ghs_implementation_tpu.serve.service import MSTService
+
+    svc = MSTService()
+    g = gnm_random_graph(60, 180, seed=21)
+    edges = [[int(a), int(b), int(c)] for a, b, c in zip(g.u, g.v, g.w)]
+    svc.handle({"op": "solve", "num_nodes": 60, "edges": edges})
+    assert list(svc.seen_buckets) == [(64, 256)]
+
+
+def test_precompile_bucket_rejects_request_unreachable_geometry():
+    with pytest.raises(ValueError, match="int32 id space"):
+        precompile_bucket(1 << 30, 1 << 20, 16, "fused")
+    with pytest.raises(ValueError, match="lanes"):
+        precompile_bucket(64, 256, 0, "fused")
+
+
+def test_run_warmup_skips_buckets_past_the_admission_ceiling():
+    """A typo'd spec must not stall boot compiling a lane solver the
+    request path's admission check would never route to."""
+    from distributed_ghs_implementation_tpu.batch.warmup import (
+        MAX_SINGLE_WARM_EDGES,
+    )
+
+    clear_solver_cache()
+    report = run_warmup(
+        WarmupPlan(buckets=((64, 4 * MAX_SINGLE_WARM_EDGES),), lanes=4)
+    )
+    assert report["skipped"] == 1
+    assert report["compiled"] == 0 and report["buckets"] == 0
+    assert compiled_bucket_keys() == []
+
+
+def test_service_normalizes_replayed_lane_geometry():
+    """A record taken at --batch-lanes 16 replayed into --batch-lanes 2
+    must warm THIS process's solvers — zero request-time compiles."""
+    from distributed_ghs_implementation_tpu.serve.service import MSTService
+
+    clear_solver_cache()
+    plan = WarmupPlan(keys=((64, 256, 16, "fused"),))  # recorded elsewhere
+    svc = MSTService(batch_lanes=2, warmup=plan)
+    assert (64, 256, 2, "fused") in compiled_bucket_keys()  # normalized
+    assert (64, 256, 16, "fused") not in compiled_bucket_keys()
+    g = gnm_random_graph(60, 180, seed=12)
+    edges = [[int(a), int(b), int(c)] for a, b, c in zip(g.u, g.v, g.w)]
+    first = svc.handle({"op": "solve", "num_nodes": 60, "edges": edges})
+    assert first["ok"] and first["backend"] == "batch/fused"
+    stats = svc.handle({"op": "stats"})
+    assert stats["counters"].get("compile.miss", 0) == 0
+
+
+def test_traffic_only_record_excludes_warmup_ladder(tmp_path):
+    """serve-style records converge to traffic: a compiled ladder bucket
+    is NOT recorded unless traffic hit its shape."""
+    clear_solver_cache()
+    precompile_bucket(512, 2048, 4, "fused")  # a ladder compile, no traffic
+    path = str(tmp_path / "rec.json")
+    assert save_bucket_record(
+        path, shape_buckets=[(64, 256)], include_compiled=False
+    ) == 1
+    assert load_bucket_record(path).keys == ((64, 256, 0, "fused"),)
+
+
+def test_pipelined_former_unexpected_error_raises_not_hangs():
+    """An error OUTSIDE stack_lanes in the former (e.g. a broken policy
+    emitting out-of-range indices) must surface as the exception the
+    synchronous path would raise — never a dead thread + eternal
+    handoff.get()."""
+    from distributed_ghs_implementation_tpu.batch.policy import FormedBatch
+
+    engine = BatchEngine(
+        policy=BatchPolicy(
+            max_lanes=4, pipeline_depth=2, pipeline_min_stack_elems=0
+        ),
+        supervisor_config=_fast_config(),
+    )
+    graphs = [gnm_random_graph(60, 150, seed=s) for s in range(4)]
+    bad = [
+        FormedBatch(key=(64, 256), indices=(0, 99)),  # 99 out of range
+        FormedBatch(key=(64, 256), indices=(1, 2)),
+    ]
+    results = [None] * len(graphs)
+    with pytest.raises(IndexError):
+        engine._solve_batches_pipelined(graphs, bad, results)
+
+
+def test_bench_gate_throughput_floor_is_multiplicative():
+    """At CI's loose --time-tolerance 5.0 an additive floor would be
+    negative (gating nothing); the multiplicative floor still fails a
+    broken-pipeline-style collapse."""
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "bench_gate_for_test",
+        os.path.join(os.path.dirname(__file__), "..", "tools", "bench_gate.py"),
+    )
+    bg = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(bg)
+    base = {"schema": bg.SCHEMA, "metrics": {"batch_graphs_per_sec": 1000.0}}
+    collapsed = {"schema": bg.SCHEMA, "metrics": {"batch_graphs_per_sec": 50.0}}
+    ok, lines = bg.compare(base, collapsed, time_tolerance=5.0)
+    assert not ok and any("FAIL" in line for line in lines)
+    fine = {"schema": bg.SCHEMA, "metrics": {"batch_graphs_per_sec": 400.0}}
+    ok, _ = bg.compare(base, fine, time_tolerance=5.0)
+    assert ok

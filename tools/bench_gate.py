@@ -45,13 +45,23 @@ DEFAULT_BASELINE = os.path.join(
 
 #: Metric-kind overrides; everything else is classified by suffix
 #: (``*_s`` time, ``*_per_sec`` throughput, default count).
-#: ``batch_speedup`` is a wall-clock ratio, so it gates like a throughput
-#: (floor), never like a deterministic count.
+#: ``batch_speedup`` / ``pipeline_speedup`` are wall-clock ratios, so they
+#: gate like throughputs (floor), never like deterministic counts. The
+#: round-10 latency keys (``cold_first_solve_s``, ``warm_solve_p50_s`` /
+#: ``warm_solve_p95_s``, ``solve_p50_s`` / ``solve_p95_s``, ``warmup_s``)
+#: need no override — the ``*_s`` suffix already gates them as wall-times
+#: (ceiling at ``1 + time_tolerance``); they are listed in the baseline
+#: files so a latency regression fails the gate like any other slowdown.
+#: Caveat: ``cold_first_solve_s`` is dominated by XLA compile wall time
+#: and the sync/pipeline pair by scheduler jitter (docs/BENCH_NOTES.md
+#: measures a 5x spread on a 2-core box), so gate those only at CI's
+#: loose ``--time-tolerance 5.0``, never at the tight local default.
 KINDS = {
     "mst_weight": "exact",
     "protocol_mst_weight": "exact",
     "batch_mst_weight": "exact",
     "batch_speedup": "throughput",
+    "pipeline_speedup": "throughput",
 }
 
 
@@ -173,10 +183,13 @@ def compare(
     """Per-metric verdicts; returns ``(ok, report_lines)``.
 
     A *regression* is: slower than ``(1 + time_tolerance) x`` baseline,
-    lower throughput than ``(1 - time_tolerance) x``, a count above
-    ``(1 + count_tolerance) x``, or any change at all to an exact metric.
-    Improvements never fail the gate (they're reported, so a suspicious
-    10x "improvement" is still visible).
+    throughput below ``1 / (1 + time_tolerance) x`` (the multiplicative
+    mirror of the time ceiling — an additive ``1 - tolerance`` floor goes
+    negative past tolerance 1.0 and gates nothing, exactly at the loose
+    settings CI uses), a count above ``(1 + count_tolerance) x``, or any
+    change at all to an exact metric. Improvements never fail the gate
+    (they're reported, so a suspicious 10x "improvement" is still
+    visible).
     """
     lines: List[str] = []
     ok = True
@@ -210,11 +223,12 @@ def compare(
                 f"({ratio:.2f}x, limit {1 + time_tolerance:.2f}x)"
             )
         elif kind == "throughput":
-            good = ratio >= 1 - time_tolerance
+            floor = 1 / (1 + time_tolerance)
+            good = ratio >= floor
             verdict = "ok" if good else "FAIL"
             lines.append(
                 f"{verdict} {name}: {value:.1f} vs {base:.1f} "
-                f"({ratio:.2f}x, floor {1 - time_tolerance:.2f}x)"
+                f"({ratio:.2f}x, floor {floor:.2f}x)"
             )
         else:  # count
             good = ratio <= 1 + count_tolerance

@@ -1,13 +1,21 @@
 #!/usr/bin/env python
 """Serve drill: drive the MST query service and check every answer.
 
-Two modes:
+Three modes:
 
 * ``--smoke`` — the CI gate: start ``ghs serve`` as a subprocess, drive the
   JSONL protocol over its pipes (solve -> update -> repeat the original
   solve), and assert the repeat is answered from cache — both via the
   response's ``cached`` flag and via the ``serve.store.hit`` counter in the
   ``stats`` op (the obs-bus proof that no solver ran).
+* ``--warmup-smoke`` — the warm-path gate: start ``ghs serve`` with
+  ``--batch-lanes`` and ``--warmup-buckets`` covering the drill's graph
+  shape, drive two distinct solves on that bucket, and assert via the
+  ``compile.*`` counters in ``stats`` that the warmup compiled
+  (``compile.warmup >= 1``) and the query phase compiled NOTHING
+  (no ``compile.miss``) — the "zero request-time XLA compiles" acceptance
+  from docs/SERVING.md. The report carries the compile counters (CI
+  uploads it as the compile-cache stats artifact).
 * default — an in-process replay: a seeded random graph, then ``--updates``
   random insert/delete/reweight requests through :class:`MSTService`, every
   response's MST weight checked against the SciPy oracle on an
@@ -27,6 +35,7 @@ import json
 import os
 import subprocess
 import sys
+import time
 
 import numpy as np
 
@@ -101,6 +110,89 @@ def run_smoke(args) -> dict:
     return {
         "mode": "smoke",
         "checks": [{"name": n, "ok": bool(ok)} for n, ok in checks],
+        "ok": all(ok for _, ok in checks),
+    }
+
+
+def run_warmup_smoke(args) -> dict:
+    """Warmup serve, query the pre-declared bucket, assert zero
+    request-time compiles (``compile.miss``) via the stats op."""
+    g1 = _seed_graph(args.nodes, args.edges, args.seed)
+    g2 = _seed_graph(args.nodes, args.edges, args.seed + 1)
+    cache_dir = args.compile_cache_dir or "serve_compile_cache"
+    proc = subprocess.Popen(
+        [
+            sys.executable, "-m", "distributed_ghs_implementation_tpu",
+            "serve",
+            "--batch-lanes", "4",
+            "--warmup-buckets", f"{args.nodes}x{args.edges}",
+            "--compile-cache-dir", cache_dir,
+        ],
+        stdin=subprocess.PIPE,
+        stdout=subprocess.PIPE,
+        text=True,
+        env={**os.environ, "JAX_PLATFORMS": os.environ.get("JAX_PLATFORMS", "cpu")},
+    )
+
+    def roundtrip(request):
+        proc.stdin.write(json.dumps(request) + "\n")
+        proc.stdin.flush()
+        line = proc.stdout.readline()
+        if not line:
+            raise RuntimeError("serve process closed its pipe early")
+        return json.loads(line)
+
+    checks = []
+    counters = {}
+    warmup_report = None
+    latencies = []
+    try:
+        # A throwaway stats roundtrip absorbs subprocess boot + the warmup
+        # phase, so the timed solves below measure warm QUERY latency, not
+        # interpreter startup.
+        boot = roundtrip({"op": "stats"})
+        checks.append(("serve booted", bool(boot.get("ok"))))
+        for i, g in enumerate((g1, g2), 1):
+            t0 = time.perf_counter()
+            response = roundtrip(
+                {"op": "solve", "num_nodes": g.num_nodes, "edges": _graph_edges(g)}
+            )
+            latencies.append(round(time.perf_counter() - t0, 4))
+            checks.append((f"solve {i} ok", bool(response.get("ok"))))
+            checks.append((f"solve {i} is a miss", response.get("source") == "solved"))
+            checks.append(
+                (f"solve {i} rode the lane engine",
+                 str(response.get("backend", "")).startswith("batch/"))
+            )
+        stats = roundtrip({"op": "stats"})
+        counters = stats.get("counters", {})
+        warmup_report = stats.get("warmup")
+        checks.append(("warmup ran", bool(warmup_report)))
+        checks.append(
+            ("warmup compiled the bucket",
+             counters.get("compile.warmup", 0) >= 1)
+        )
+        checks.append(
+            ("zero request-time compiles (compile.miss)",
+             counters.get("compile.miss", 0) == 0)
+        )
+        checks.append(
+            ("queries hit the precompiled solver",
+             counters.get("batch.compile.hit", 0) >= 2)
+        )
+        roundtrip({"op": "shutdown"})
+    finally:
+        proc.stdin.close()
+        proc.wait(timeout=120)
+    return {
+        "mode": "warmup-smoke",
+        "checks": [{"name": n, "ok": bool(ok)} for n, ok in checks],
+        "query_latency_s": latencies,
+        "warmup": warmup_report,
+        "compile_counters": {
+            k: v for k, v in counters.items() if k.startswith("compile.")
+        },
+        "compile_cache_dir": cache_dir,
         "ok": all(ok for _, ok in checks),
     }
 
@@ -188,6 +280,11 @@ def main(argv=None) -> int:
     p = argparse.ArgumentParser(prog="serve_drill", description=__doc__)
     p.add_argument("--smoke", action="store_true",
                    help="CI smoke: subprocess + JSONL pipes + cache-hit assert")
+    p.add_argument("--warmup-smoke", action="store_true",
+                   help="CI warm-path smoke: serve --warmup-buckets, assert "
+                   "zero request-time compiles via compile.* counters")
+    p.add_argument("--compile-cache-dir",
+                   help="persistent compile-cache dir for --warmup-smoke")
     p.add_argument("--chaos", action="store_true",
                    help="arm fault sites before the replay")
     p.add_argument("--nodes", type=int, default=300)
@@ -198,14 +295,19 @@ def main(argv=None) -> int:
     p.add_argument("--output", help="write the JSON report here")
     args = p.parse_args(argv)
 
-    report = run_smoke(args) if args.smoke else run_replay(args)
+    if args.smoke:
+        report = run_smoke(args)
+    elif args.warmup_smoke:
+        report = run_warmup_smoke(args)
+    else:
+        report = run_replay(args)
     if args.output:
         with open(args.output, "w") as f:
             json.dump(report, f, indent=2)
             f.write("\n")
-    print(json.dumps(report if args.smoke else {
+    print(json.dumps({
         k: v for k, v in report.items() if k != "counters"
-    }, indent=2))
+    } if report["mode"] == "replay" else report, indent=2))
     print(f"serve drill: {'PASS' if report['ok'] else 'FAIL'}")
     return 0 if report["ok"] else 1
 
