@@ -49,8 +49,11 @@ RECORDED_ORACLE_WEIGHTS = {
 
 
 def _pctl(samples, p: float) -> float:
-    xs = sorted(samples)
-    return xs[min(len(xs) - 1, int(round(p * (len(xs) - 1))))]
+    # The repo-wide nearest-rank rule (obs.events.quantile): bench
+    # percentiles stay comparable with histogram and SLO-report quantiles.
+    from distributed_ghs_implementation_tpu.obs.events import quantile
+
+    return quantile(samples, p)
 
 
 def run_batch_bench(args) -> int:

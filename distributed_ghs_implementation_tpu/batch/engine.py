@@ -57,6 +57,7 @@ from distributed_ghs_implementation_tpu.batch.lanes import (
 from distributed_ghs_implementation_tpu.batch.policy import BatchPolicy
 from distributed_ghs_implementation_tpu.graphs.edgelist import Graph
 from distributed_ghs_implementation_tpu.obs.events import BUS
+from distributed_ghs_implementation_tpu.obs.slo import current_class
 from distributed_ghs_implementation_tpu.utils.resilience import (
     FAULTS,
     IncidentLog,
@@ -65,11 +66,21 @@ from distributed_ghs_implementation_tpu.utils.resilience import (
     is_transient,
 )
 
+# Ceiling on distinct per-class queue-wait histogram names one engine will
+# create (each histogram is permanent process state on the global bus).
+_CLS_HIST_CAP = 16
+
 
 class PendingSolve:
-    """One submitted solve; ``wait()`` blocks until its batch lands."""
+    """One submitted solve; ``wait()`` blocks until its batch lands.
 
-    __slots__ = ("graph", "event", "result", "error", "enqueued_at")
+    ``cls`` snapshots the submitting request's SLO class tag
+    (``obs.slo.current_class``) — the worker thread that eventually forms
+    the batch has no request context of its own, so queue-wait telemetry
+    is attributed from the tag captured here at submit time.
+    """
+
+    __slots__ = ("graph", "event", "result", "error", "enqueued_at", "cls")
 
     def __init__(self, graph: Graph):
         self.graph = graph
@@ -77,6 +88,7 @@ class PendingSolve:
         self.result: Optional[MSTResult] = None
         self.error: Optional[BaseException] = None
         self.enqueued_at = time.monotonic()
+        self.cls = current_class()
 
     def wait(self, timeout: Optional[float] = None) -> MSTResult:
         if not self.event.wait(timeout):
@@ -104,6 +116,7 @@ class BatchEngine:
         self._sleep = sleep
         self._dispatch = threading.Lock()  # one device batch in flight
         self._cv = threading.Condition()
+        self._cls_seen: set = set()  # distinct per-class histogram labels
         self._queue: List[PendingSolve] = []
         self._worker: Optional[threading.Thread] = None
         self._closed = False
@@ -307,7 +320,20 @@ class BatchEngine:
                 BUS.sample("batch.queue.depth", len(self._queue))
             now = self._clock()
             for p in batch:
-                BUS.record("batch.queue.wait_s", now - p.enqueued_at)
+                wait_s = now - p.enqueued_at
+                BUS.record("batch.queue.wait_s", wait_s)
+                if p.cls is not None and (
+                    p.cls in self._cls_seen
+                    or len(self._cls_seen) < _CLS_HIST_CAP
+                ):
+                    # Per-class forming-queue wait: histograms survive ring
+                    # overflow, so obs.slo can attach this to each class's
+                    # report even on long drills (obs/slo.py joins on the
+                    # "batch.queue.wait_s.<cls>" name). Distinct labels are
+                    # capped — histograms live forever in the process-global
+                    # bus, and the label ultimately comes from request JSON.
+                    self._cls_seen.add(p.cls)
+                    BUS.record(f"batch.queue.wait_s.{p.cls}", wait_s)
             try:
                 results = self._solve_formed([p.graph for p in batch])
                 for p, result in zip(batch, results):

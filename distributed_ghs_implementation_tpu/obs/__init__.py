@@ -24,3 +24,11 @@ from distributed_ghs_implementation_tpu.obs.export import (  # noqa: F401
     write_chrome_trace,
     write_events_jsonl,
 )
+from distributed_ghs_implementation_tpu.obs.slo import (  # noqa: F401
+    ClassStats,
+    current_class,
+    gate_metrics,
+    summarize_bus,
+    summarize_jsonl,
+    tagged_class,
+)

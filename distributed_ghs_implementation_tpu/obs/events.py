@@ -31,9 +31,10 @@ queue-depth samples, incremental-vs-resolve update routing).
 from __future__ import annotations
 
 import os
+import random
 import threading
 import time
-from typing import Any, Dict, List, Optional, Tuple
+from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 # Chrome-trace phase codes carried on every record (export stays a rename).
 PH_COMPLETE = "X"  # span with a duration
@@ -44,7 +45,26 @@ PH_COUNTER = "C"  # counter sample on a timeline track
 #   (ph, name, cat, ts_ns, dur_ns, tid, args_dict_or_None)
 EventTuple = Tuple[str, str, str, int, int, int, Optional[Dict[str, Any]]]
 
-_HIST_SAMPLE_CAP = 512  # bounded per-histogram sample window for percentiles
+_HIST_SAMPLE_CAP = 512  # bounded per-histogram reservoir for percentiles
+_HIST_SEED = 0x5EED  # fixed reservoir seed: summaries are run-reproducible
+
+
+def quantile(
+    samples: Sequence[float], p: float, *, presorted: bool = False
+) -> float:
+    """Nearest-rank quantile of a sample sequence.
+
+    The ONE quantile rule every percentile in the repo uses — histogram
+    summaries, the SLO accounting layer (``obs.slo``), and ``bench.py``'s
+    warm-latency metrics — so a p99 in one report is comparable to a p99
+    in another. Empty input returns 0.0 (a report field, not an error).
+    ``presorted=True`` skips the sort for callers taking several quantiles
+    of one sample set.
+    """
+    if not samples:
+        return 0.0
+    xs = samples if presorted else sorted(samples)
+    return xs[min(len(xs) - 1, int(round(p * (len(xs) - 1))))]
 
 
 class _NullSpan:
@@ -104,17 +124,27 @@ class _Span:
 
 
 class _Hist:
-    """Running aggregate + bounded sample ring (percentiles stay O(cap))."""
+    """Running aggregate + bounded uniform reservoir (percentiles stay
+    O(cap) in memory and unbiased over arbitrarily long runs).
 
-    __slots__ = ("count", "total", "vmin", "vmax", "samples", "_w")
+    The previous implementation overwrote the 512-sample buffer
+    round-robin — a sliding window of *recent* values, which skews long-run
+    tail quantiles toward whatever the process did last (a load drill's
+    p99 would forget its own warm phase). Algorithm R reservoir sampling
+    keeps each of the ``count`` observations in the sample set with equal
+    probability ``cap/count``; the RNG is seeded per histogram, so two runs
+    over the same observation sequence summarize identically.
+    """
 
-    def __init__(self):
+    __slots__ = ("count", "total", "vmin", "vmax", "samples", "_rng")
+
+    def __init__(self, seed: int = _HIST_SEED):
         self.count = 0
         self.total = 0.0
         self.vmin = float("inf")
         self.vmax = float("-inf")
         self.samples: List[float] = []
-        self._w = 0
+        self._rng = random.Random(seed)
 
     def add(self, value: float) -> None:
         self.count += 1
@@ -125,24 +155,25 @@ class _Hist:
             self.vmax = value
         if len(self.samples) < _HIST_SAMPLE_CAP:
             self.samples.append(value)
-        else:  # overwrite round-robin: a sliding window of recent values
-            self.samples[self._w % _HIST_SAMPLE_CAP] = value
-            self._w += 1
+        else:  # Algorithm R: keep with probability cap/count, evict uniform
+            j = self._rng.randrange(self.count)
+            if j < _HIST_SAMPLE_CAP:
+                self.samples[j] = value
 
     def summary(self) -> dict:
         if not self.count:
             return {"count": 0}
         s = sorted(self.samples)
-        q = lambda p: s[min(len(s) - 1, int(p * (len(s) - 1)))]  # noqa: E731
         return {
             "count": self.count,
             "sum": self.total,
             "mean": self.total / self.count,
             "min": self.vmin,
             "max": self.vmax,
-            "p50": q(0.50),
-            "p90": q(0.90),
-            "p99": q(0.99),
+            "p50": quantile(s, 0.50, presorted=True),
+            "p90": quantile(s, 0.90, presorted=True),
+            "p95": quantile(s, 0.95, presorted=True),
+            "p99": quantile(s, 0.99, presorted=True),
         }
 
 

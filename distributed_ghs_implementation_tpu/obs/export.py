@@ -111,8 +111,25 @@ def write_chrome_trace(bus: EventBus, path: str) -> str:
 
 
 def write_events_jsonl(bus: EventBus, path: str) -> str:
-    """Events one-per-line + a trailing metadata line (counters/histograms)."""
+    """Events one-per-line, bracketed by metadata: a LEADING header line
+    (ring capacity + dropped count at export time) and a TRAILING line with
+    the counter/histogram totals. The header exists so a log truncated
+    mid-write — the normal state of a file another process is tailing —
+    still tells the reader whether the ring overflowed; a measurement that
+    dropped events must be flagged, never silently under-counted."""
     with open(path, "w") as f:
+        f.write(
+            json.dumps(
+                {
+                    "ph": "M",
+                    "kind": "header",
+                    "schema": "ghs-obs-jsonl-v1",
+                    "capacity": bus.capacity,
+                    "events_dropped": bus.dropped,
+                }
+            )
+            + "\n"
+        )
         for ph, name, cat, ts_ns, dur_ns, tid, args in bus.events():
             rec = {
                 "ph": ph,
@@ -140,29 +157,54 @@ def write_events_jsonl(bus: EventBus, path: str) -> str:
 
 
 def read_events_jsonl(path: str) -> Tuple[List[dict], dict]:
-    """Parse a JSONL event log; returns ``(event_dicts, metadata)``."""
+    """Parse a JSONL event log; returns ``(event_dicts, metadata)``.
+
+    Tolerant of files still being written (or truncated by a crash): a
+    line that fails to parse — typically the torn final line of a
+    concurrent writer — is *skipped and counted* (``lines_skipped`` in the
+    metadata), never raised. Metadata merges the leading header under the
+    trailing totals line, so a log cut off before its trailing ``"M"``
+    line still reports the header's ``events_dropped``.
+    """
     events: List[dict] = []
+    header: dict = {}
     meta: dict = {}
+    skipped = 0
     with open(path) as f:
         for line in f:
             line = line.strip()
             if not line:
                 continue
-            rec = json.loads(line)
+            try:
+                rec = json.loads(line)
+            except json.JSONDecodeError:
+                skipped += 1
+                continue
+            if not isinstance(rec, dict):
+                skipped += 1
+                continue
             if rec.get("ph") == "M":
-                meta = rec
+                if rec.get("kind") == "header":
+                    header = rec
+                else:
+                    meta = rec
             else:
                 events.append(rec)
-    return events, meta
+    merged = {**header, **meta}
+    merged.pop("kind", None)
+    if skipped:
+        merged["lines_skipped"] = skipped
+    return events, merged
 
 
 def snapshot_from_jsonl(path: str) -> dict:
     """Rebuild a :meth:`EventBus.snapshot`-shaped dict from a JSONL log."""
     events, meta = read_events_jsonl(path)
     spans, instants = aggregate_span_stats(
-        (rec["ph"], rec["name"], rec.get("dur_us", 0.0) / 1e6) for rec in events
+        (rec.get("ph"), rec.get("name"), rec.get("dur_us", 0.0) / 1e6)
+        for rec in events
     )
-    return {
+    snap = {
         "schema": "ghs-obs-snapshot-v1",
         "spans": spans,
         "instants": instants,
@@ -171,6 +213,9 @@ def snapshot_from_jsonl(path: str) -> dict:
         "events_retained": len(events),
         "events_dropped": meta.get("events_dropped", 0),
     }
+    if meta.get("lines_skipped"):
+        snap["lines_skipped"] = meta["lines_skipped"]
+    return snap
 
 
 def _fmt_s(seconds: float) -> str:
@@ -224,6 +269,16 @@ def render_stats(snapshot: dict) -> str:
         f"events: {snapshot.get('events_retained', 0)} retained, "
         f"{dropped} dropped (ring overflow)"
     )
+    if dropped:
+        lines.append(
+            f"WARNING: ring overflow dropped {dropped} events — span tables "
+            "above under-count; counters/histograms are still complete"
+        )
+    if snapshot.get("lines_skipped"):
+        lines.append(
+            f"WARNING: {snapshot['lines_skipped']} unparseable JSONL "
+            "line(s) skipped (torn write?)"
+        )
     return "\n".join(lines)
 
 

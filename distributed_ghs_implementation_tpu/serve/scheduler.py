@@ -39,7 +39,16 @@ from typing import List, Optional, Sequence, Tuple
 from distributed_ghs_implementation_tpu.api import MSTResult, minimum_spanning_forest
 from distributed_ghs_implementation_tpu.graphs.edgelist import Graph
 from distributed_ghs_implementation_tpu.obs.events import BUS
+from distributed_ghs_implementation_tpu.obs.slo import current_class
 from distributed_ghs_implementation_tpu.serve.store import ResultStore, solve_cache_key
+
+
+def _cls_args() -> dict:
+    """The SLO class tag of the current request context, as span args —
+    stamping it on ``serve.solve`` lets ``obs.slo`` decompose each class's
+    end-to-end latency into solve time vs everything else."""
+    cls = current_class()
+    return {"cls": cls} if cls is not None else {}
 
 
 class _Flight:
@@ -225,13 +234,13 @@ class SolveScheduler:
         ):
             with BUS.span(
                 "serve.solve", cat="serve", backend="batch",
-                nodes=graph.num_nodes, edges=graph.num_edges,
+                nodes=graph.num_nodes, edges=graph.num_edges, **_cls_args(),
             ):
                 return self.batch_engine.submit(graph).wait()
         with self._sem:
             with BUS.span(
                 "serve.solve", cat="serve", backend=backend,
-                nodes=graph.num_nodes, edges=graph.num_edges,
+                nodes=graph.num_nodes, edges=graph.num_edges, **_cls_args(),
             ):
                 return minimum_spanning_forest(
                     graph, backend=backend, supervised=True,
@@ -244,7 +253,8 @@ class SolveScheduler:
         """The distinct misses of one batch, as a group."""
         if self.batch_engine is not None and backend == "device":
             with BUS.span(
-                "serve.solve", cat="serve", backend="batch", misses=len(graphs)
+                "serve.solve", cat="serve", backend="batch",
+                misses=len(graphs), **_cls_args(),
             ):
                 return self.batch_engine.solve_many(graphs)
         return [self._solve_miss(g, backend) for g in graphs]

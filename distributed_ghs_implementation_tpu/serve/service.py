@@ -35,6 +35,7 @@ from distributed_ghs_implementation_tpu.batch.warmup import (
 )
 from distributed_ghs_implementation_tpu.graphs.edgelist import Graph
 from distributed_ghs_implementation_tpu.obs.events import BUS
+from distributed_ghs_implementation_tpu.obs.slo import tagged_class
 from distributed_ghs_implementation_tpu.serve.dynamic import DynamicMST
 from distributed_ghs_implementation_tpu.serve.scheduler import SolveScheduler
 from distributed_ghs_implementation_tpu.serve.store import (
@@ -60,6 +61,7 @@ class MSTService:
         resolve_threshold: Optional[int] = None,
         max_sessions: int = _MAX_SESSIONS,
         batch_lanes: int = 0,
+        batch_wait_s: Optional[float] = None,
         warmup=None,
     ):
         self.store = store if store is not None else ResultStore(
@@ -72,7 +74,13 @@ class MSTService:
             from distributed_ghs_implementation_tpu.batch.engine import BatchEngine
             from distributed_ghs_implementation_tpu.batch.policy import BatchPolicy
 
-            engine = BatchEngine(policy=BatchPolicy(max_lanes=batch_lanes))
+            # batch_wait_s widens the forming window for lane-mates (the
+            # load drill uses a wider window than the 2 ms production
+            # default so open-loop burst arrivals actually share lanes).
+            policy_kwargs = {"max_lanes": batch_lanes}
+            if batch_wait_s is not None:
+                policy_kwargs["max_wait_s"] = batch_wait_s
+            engine = BatchEngine(policy=BatchPolicy(**policy_kwargs))
         self.scheduler = SolveScheduler(
             self.store, backend=backend, max_concurrent=max_concurrent,
             batch_engine=engine,
@@ -125,23 +133,51 @@ class MSTService:
     # ------------------------------------------------------------------
     def handle(self, request: dict) -> dict:
         op = request.get("op")
-        with BUS.span("serve.request", cat="serve", op=str(op)):
+        # SLO class tag: clients label each query ("hit"/"miss"/"update"/
+        # ...); the label rides the serve.request span args (what
+        # obs.slo joins per-class reports from) AND the thread-scoped
+        # tagged_class context, so nested layers (scheduler serve.solve
+        # spans, the batch engine's queue-wait histograms) attribute their
+        # telemetry to the same class without any API threading.
+        cls = request.get("slo_class")
+        span_args = {"op": str(op)}
+        if cls is not None:
+            # Sanitize: the label comes from untrusted request JSON and is
+            # interpolated into bus histogram names downstream — keep it a
+            # short, dotted-identifier-safe token.
+            cls = "".join(
+                ch if ch.isalnum() or ch in "_-" else "_" for ch in str(cls)
+            )[:32] or "untagged"
+            span_args["cls"] = cls
+        with tagged_class(cls), BUS.span(
+            "serve.request", cat="serve", **span_args
+        ) as span:
             BUS.count("serve.requests")
             try:
                 if op == "solve":
-                    return self._handle_solve(request)
-                if op == "update":
-                    return self._handle_update(request)
-                if op == "stats":
-                    return self._handle_stats()
-                if op == "shutdown":
-                    return {"ok": True, "op": "shutdown"}
-                raise ValueError(
-                    f"unknown op {op!r}; expected solve|update|stats|shutdown"
-                )
+                    response = self._handle_solve(request)
+                elif op == "update":
+                    response = self._handle_update(request)
+                elif op == "stats":
+                    response = self._handle_stats()
+                elif op == "shutdown":
+                    response = {"ok": True, "op": "shutdown"}
+                else:
+                    raise ValueError(
+                        f"unknown op {op!r}; expected solve|update|stats|shutdown"
+                    )
             except Exception as e:  # noqa: BLE001 — the loop must survive
                 BUS.count("serve.errors")
-                return {"ok": False, "op": op, "error": f"{type(e).__name__}: {e}"}
+                response = {
+                    "ok": False, "op": op, "error": f"{type(e).__name__}: {e}",
+                }
+            span.set(ok=bool(response.get("ok")))
+            source = response.get("source") or response.get("mode")
+            if source:
+                span.set(source=source)
+            if cls is not None:
+                response.setdefault("slo_class", cls)
+            return response
 
     # ------------------------------------------------------------------
     def _handle_solve(self, request: dict) -> dict:
@@ -231,6 +267,9 @@ class MSTService:
             "counters": counters,
             "store": self.store.stats(),
             "sessions": len(self._sessions),
+            # Ring-overflow visibility: a drill reading stats over the
+            # pipes must know when span-derived numbers under-count.
+            "events_dropped": BUS.dropped,
         }
         if self.warmup_report is not None:
             out["warmup"] = self.warmup_report

@@ -138,6 +138,52 @@ def test_bad_capacity_rejected():
         EventBus(capacity=0)
 
 
+def test_histogram_reservoir_quantiles_unbiased_over_long_runs():
+    """The bounded sample set must stay a UNIFORM sample of the whole run,
+    not a sliding window of recent values (the bug this guards: a long
+    drill's p99 forgetting everything but its final seconds)."""
+    from distributed_ghs_implementation_tpu.obs.events import _Hist
+
+    n = 50_000
+    h = _Hist()
+    for i in range(n):  # monotone ramp: recency bias is maximally visible
+        h.add(float(i))
+    s = h.summary()
+    assert s["count"] == n and s["min"] == 0.0 and s["max"] == float(n - 1)
+    # A recent-window implementation would report p50 ~= 49750 here.
+    assert abs(s["p50"] - 0.50 * n) < 0.10 * n
+    assert abs(s["p95"] - 0.95 * n) < 0.03 * n
+    assert abs(s["p99"] - 0.99 * n) < 0.03 * n
+    # ... and would have discarded every early observation.
+    assert min(h.samples) < 0.10 * n
+
+
+def test_histogram_reservoir_is_deterministic():
+    """Seeded reservoir: identical observation sequences summarize
+    identically (drill reports are reproducible run-to-run)."""
+    from distributed_ghs_implementation_tpu.obs.events import _Hist
+
+    h1, h2 = _Hist(), _Hist()
+    for i in range(10_000):
+        h1.add(float(i % 997))
+        h2.add(float(i % 997))
+    assert h1.summary() == h2.summary()
+    assert h1.samples == h2.samples
+
+
+def test_quantile_nearest_rank():
+    from distributed_ghs_implementation_tpu.obs.events import quantile
+
+    assert quantile([], 0.99) == 0.0
+    assert quantile([7.0], 0.5) == 7.0
+    xs = list(range(101))
+    assert quantile(xs, 0.0) == 0
+    assert quantile(xs, 0.50) == 50
+    assert quantile(xs, 0.99) == 99
+    assert quantile(xs, 1.0) == 100
+    assert quantile([3.0, 1.0, 2.0], 1.0) == 3.0  # unsorted input
+
+
 # ----------------------------------------------------------------------
 # Exporters
 # ----------------------------------------------------------------------
@@ -199,6 +245,96 @@ def test_jsonl_round_trip_and_stats(tmp_path):
     # The live-bus snapshot renders the same names.
     live = render_stats(bus.snapshot())
     assert "solve" in live and "degrade" in live
+
+
+def test_jsonl_header_carries_capacity_and_dropped(tmp_path):
+    """The LEADING metadata line: a log truncated before its trailing
+    totals line must still tell the reader whether the ring overflowed."""
+    bus = EventBus(capacity=8)
+    for i in range(20):
+        bus.instant(f"e{i}")
+    path = str(tmp_path / "events.jsonl")
+    write_events_jsonl(bus, path)
+    with open(path) as f:
+        first = json.loads(f.readline())
+    assert first["ph"] == "M" and first["kind"] == "header"
+    assert first["capacity"] == 8 and first["events_dropped"] == 12
+
+    # Drop the trailing totals line (simulates a crash mid-export):
+    # the header still reports the overflow.
+    lines = open(path).read().splitlines()
+    with open(path, "w") as f:
+        f.write("\n".join(lines[:-1]) + "\n")
+    snap = snapshot_from_jsonl(path)
+    assert snap["events_dropped"] == 12
+
+
+def test_jsonl_reader_skips_torn_final_line(tmp_path):
+    """A concurrently-written log's torn last line is skipped and counted,
+    never a crash — the load drill reads logs other threads still write."""
+    bus = EventBus(capacity=64)
+    _populate(bus)
+    path = str(tmp_path / "events.jsonl")
+    write_events_jsonl(bus, path)
+    full_events, full_meta = read_events_jsonl(path)
+
+    # Torn mid-record write: truncate the file inside the LAST event line.
+    raw = open(path).read()
+    cut = raw.rindex('{"ph"')
+    torn = str(tmp_path / "torn.jsonl")
+    with open(torn, "w") as f:
+        f.write(raw[: cut + 25])  # half a JSON object
+    events, meta = read_events_jsonl(torn)
+    assert meta["lines_skipped"] == 1
+    assert len(events) == len(full_events)  # only the torn META line lost
+    snap = snapshot_from_jsonl(torn)
+    assert snap["lines_skipped"] == 1
+    assert snap["spans"] == snapshot_from_jsonl(path)["spans"]
+    text = render_stats(snap)
+    assert "WARNING" in text and "skipped" in text
+
+    # Mid-file corruption (a partially flushed then continued write) is
+    # skipped too; intact lines before AND after still parse.
+    lines = raw.splitlines()
+    garbled = str(tmp_path / "garbled.jsonl")
+    with open(garbled, "w") as f:
+        f.write("\n".join(lines[:2] + ['{"ph": "X", "na'] + lines[2:]) + "\n")
+    events_g, meta_g = read_events_jsonl(garbled)
+    assert meta_g["lines_skipped"] == 1
+    assert len(events_g) == len(full_events)
+    assert meta_g["counters"] == full_meta["counters"]
+
+
+def test_jsonl_reader_tolerates_concurrent_writer(tmp_path):
+    """Reading WHILE a writer appends: every fully-written line parses,
+    the in-flight line is skipped, nothing raises."""
+    import threading
+
+    bus = EventBus(capacity=256)
+    for i in range(50):
+        bus.instant(f"e{i}")
+    path = str(tmp_path / "live.jsonl")
+    write_events_jsonl(bus, path)  # the file exists before the reader starts
+    stop = threading.Event()
+
+    def writer():
+        # Rewrite the log repeatedly with an unterminated tail record, the
+        # steady state a tailing reader actually observes.
+        while not stop.is_set():
+            write_events_jsonl(bus, path)
+            with open(path, "a") as f:
+                f.write('{"ph": "I", "name": "partial')
+
+    t = threading.Thread(target=writer)
+    t.start()
+    try:
+        for _ in range(25):
+            events, meta = read_events_jsonl(path)  # must never raise
+            for rec in events:
+                assert isinstance(rec, dict)
+    finally:
+        stop.set()
+        t.join()
 
 
 # ----------------------------------------------------------------------

@@ -1,0 +1,333 @@
+"""Per-class SLO accounting (obs/slo.py) + the open-loop load drill.
+
+Coverage contract from the issue: the class tag must travel request ->
+``serve.request``/``serve.solve`` span args -> per-class summary (from a
+live bus AND from a JSONL export), the summary schema must flatten into
+bench-gate metrics that actually gate p99/goodput regressions, and a
+miniature load-drill deck must run open-loop against a real service with
+zero lost accepted queries.
+"""
+
+import json
+import os
+import sys
+
+import pytest
+
+from distributed_ghs_implementation_tpu.obs import slo
+from distributed_ghs_implementation_tpu.obs.events import BUS
+from distributed_ghs_implementation_tpu.obs.export import write_events_jsonl
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "tools"))
+
+
+@pytest.fixture(autouse=True)
+def _clean_global_bus():
+    BUS.enable()
+    BUS.clear()
+    yield
+    BUS.enable()
+    BUS.clear()
+
+
+# ----------------------------------------------------------------------
+# ClassStats + assembly
+# ----------------------------------------------------------------------
+def test_class_stats_counts_and_goodput():
+    stats = slo.ClassStats()
+    for i in range(10):
+        stats.observe("hit", 0.001 * (i + 1))
+    stats.observe("miss", 0.5)
+    stats.observe("miss", 0.7, ok=False)
+    stats.observe("miss", shed=True)
+    summary = slo.assemble(stats, wall_s=2.0)
+    assert summary["schema"] == "ghs-slo-summary-v1"
+    hit = summary["classes"]["hit"]
+    assert hit["sent"] == 10 and hit["ok"] == 10
+    assert hit["goodput_per_sec"] == pytest.approx(5.0)
+    assert hit["latency_s"]["p50"] == pytest.approx(0.005, abs=1e-3)
+    miss = summary["classes"]["miss"]
+    assert (miss["sent"], miss["ok"], miss["errors"], miss["shed"]) == (3, 1, 1, 1)
+    totals = summary["totals"]
+    assert totals["sent"] == 13 and totals["errors"] == 1 and totals["shed"] == 1
+    assert not summary["dropped_warning"]
+
+
+def test_dropped_events_flag_the_summary():
+    stats = slo.ClassStats()
+    stats.observe("hit", 0.01)
+    summary = slo.assemble(stats, wall_s=1.0, events_dropped=7)
+    assert summary["events_dropped"] == 7
+    assert summary["dropped_warning"] is True
+
+
+def test_tagged_class_is_scoped():
+    assert slo.current_class() is None
+    with slo.tagged_class("miss"):
+        assert slo.current_class() == "miss"
+        with slo.tagged_class("hit"):
+            assert slo.current_class() == "hit"
+        assert slo.current_class() == "miss"
+    assert slo.current_class() is None
+    with slo.tagged_class(None):  # no-op, never raises
+        assert slo.current_class() is None
+
+
+# ----------------------------------------------------------------------
+# The event-stream join (live bus and JSONL round trip)
+# ----------------------------------------------------------------------
+def _drive_tagged_service():
+    from distributed_ghs_implementation_tpu.graphs.generators import (
+        gnm_random_graph,
+    )
+    from distributed_ghs_implementation_tpu.serve.service import MSTService
+
+    service = MSTService()
+    g = gnm_random_graph(48, 120, seed=3)
+    edges = [[int(a), int(b), int(c)] for a, b, c in zip(g.u, g.v, g.w)]
+    r1 = service.handle(
+        {"op": "solve", "num_nodes": 48, "edges": edges, "slo_class": "miss"}
+    )
+    r2 = service.handle(
+        {"op": "solve", "num_nodes": 48, "edges": edges, "slo_class": "hit"}
+    )
+    r3 = service.handle(
+        {"op": "update", "digest": r1["digest"], "slo_class": "update",
+         "updates": [{"kind": "insert", "u": 0, "v": 47, "w": 1}]}
+    )
+    bad = service.handle(
+        {"op": "update", "digest": "nope", "slo_class": "update",
+         "updates": []}
+    )
+    assert r1["ok"] and r2["ok"] and r3["ok"] and not bad["ok"]
+    assert r1["source"] == "solved" and r2["source"] == "cache"
+    assert r2["slo_class"] == "hit"  # the tag echoes on the response
+
+
+def test_bus_join_builds_per_class_report():
+    _drive_tagged_service()
+    summary = slo.summarize_bus(BUS, wall_s=1.0)
+    classes = summary["classes"]
+    assert set(classes) == {"hit", "miss", "update"}
+    assert classes["miss"]["sent"] == 1 and classes["miss"]["ok"] == 1
+    # The miss decomposes: its serve.solve span landed under the same class.
+    assert classes["miss"]["solve_s"]["count"] == 1
+    assert classes["miss"]["solve_s"]["p99"] <= classes["miss"]["latency_s"]["p99"]
+    # Cache hits never touch the solver: no solve_s section at all.
+    assert "solve_s" not in classes["hit"]
+    # The failed update is an error, not a silent omission.
+    assert classes["update"]["sent"] == 2
+    assert classes["update"]["errors"] == 1
+    assert summary["totals"]["sent"] == 4
+
+
+def test_jsonl_join_matches_live_bus(tmp_path):
+    _drive_tagged_service()
+    live = slo.summarize_bus(BUS, wall_s=1.0)
+    path = str(tmp_path / "events.jsonl")
+    write_events_jsonl(BUS, path)
+    offline = slo.summarize_jsonl(path, wall_s=1.0)
+    for cls in live["classes"]:
+        for key in ("sent", "ok", "errors", "shed"):
+            assert offline["classes"][cls][key] == live["classes"][cls][key]
+        assert offline["classes"][cls]["latency_s"]["p99"] == pytest.approx(
+            live["classes"][cls]["latency_s"]["p99"], rel=1e-6
+        )
+
+
+def test_hostile_class_labels_are_sanitized():
+    """slo_class comes from untrusted request JSON and ends up in bus
+    histogram names — it must be reduced to a short identifier token."""
+    from distributed_ghs_implementation_tpu.graphs.generators import (
+        gnm_random_graph,
+    )
+    from distributed_ghs_implementation_tpu.serve.service import MSTService
+
+    service = MSTService()
+    g = gnm_random_graph(48, 120, seed=5)
+    edges = [[int(a), int(b), int(c)] for a, b, c in zip(g.u, g.v, g.w)]
+    response = service.handle(
+        {"op": "solve", "num_nodes": 48, "edges": edges,
+         "slo_class": "a/b.c " + "x" * 100}
+    )
+    assert response["ok"]
+    (cls,) = slo.summarize_bus(BUS)["classes"]
+    assert len(cls) <= 32
+    assert all(ch.isalnum() or ch in "_-" for ch in cls)
+    assert cls.startswith("a_b_c_x")
+
+
+def test_untagged_traffic_stays_out_of_class_reports():
+    from distributed_ghs_implementation_tpu.graphs.generators import (
+        gnm_random_graph,
+    )
+    from distributed_ghs_implementation_tpu.serve.service import MSTService
+
+    service = MSTService()
+    g = gnm_random_graph(48, 120, seed=4)
+    edges = [[int(a), int(b), int(c)] for a, b, c in zip(g.u, g.v, g.w)]
+    response = service.handle(
+        {"op": "solve", "num_nodes": 48, "edges": edges}
+    )
+    assert response["ok"] and "slo_class" not in response
+    assert slo.summarize_bus(BUS)["classes"] == {}
+
+
+# ----------------------------------------------------------------------
+# Gate metrics + bench_gate integration
+# ----------------------------------------------------------------------
+def _toy_summary():
+    stats = slo.ClassStats()
+    for _ in range(20):
+        stats.observe("hit", 0.002)
+    for _ in range(5):
+        stats.observe("miss", 0.08)
+    return slo.assemble(stats, wall_s=2.0)
+
+
+def test_gate_metrics_flatten_and_classify():
+    import bench_gate
+
+    doc = slo.gate_metrics(
+        _toy_summary(),
+        workload="gate-load-v1",
+        extra_metrics={"lost_accepted": 0},
+    )
+    assert doc["schema"] == "ghs-bench-metrics-v1"
+    metrics = doc["metrics"]
+    assert metrics["hit_p99_s"] == pytest.approx(0.002)
+    assert metrics["hit_goodput_per_sec"] == pytest.approx(10.0)
+    assert metrics["queries_sent"] == 25
+    # Suffix classification routes each key to the right regression rule.
+    assert bench_gate.metric_kind("hit_p99_s") == "time"
+    assert bench_gate.metric_kind("hit_goodput_per_sec") == "throughput"
+    assert bench_gate.metric_kind("hit_errors") == "count"
+    assert bench_gate.metric_kind("lost_accepted") == "exact"
+
+
+def test_gate_fails_p99_goodput_loss_and_lost_query():
+    import bench_gate
+
+    base = slo.gate_metrics(
+        _toy_summary(), workload="gate-load-v1",
+        extra_metrics={"lost_accepted": 0},
+    )
+    same = json.loads(json.dumps(base))
+    ok, _ = bench_gate.compare(base, same)
+    assert ok
+
+    slow = json.loads(json.dumps(base))
+    slow["metrics"]["miss_p99_s"] *= 10
+    ok, lines = bench_gate.compare(base, slow)
+    assert not ok and any("miss_p99_s" in ln for ln in lines if "FAIL" in ln)
+
+    slower = json.loads(json.dumps(base))
+    slower["metrics"]["hit_goodput_per_sec"] /= 10
+    ok, _ = bench_gate.compare(base, slower)
+    assert not ok
+
+    errs = json.loads(json.dumps(base))
+    errs["metrics"]["hit_errors"] = 2  # ANY error against a zero baseline
+    ok, _ = bench_gate.compare(base, errs)
+    assert not ok
+
+    lost = json.loads(json.dumps(base))
+    lost["metrics"]["lost_accepted"] = 1  # exact: one lost query fails
+    ok, lines = bench_gate.compare(base, lost)
+    assert not ok and any(
+        "lost_accepted" in ln and "exact" in ln for ln in lines if "FAIL" in ln
+    )
+
+
+def test_bench_gate_cli_accepts_load_report(tmp_path):
+    """--metrics with a ghs-load-report-v1 file gates its embedded
+    gate_metrics (the CI wiring for gate-load-v1)."""
+    import bench_gate
+
+    gate = slo.gate_metrics(
+        _toy_summary(), workload="gate-load-v1",
+        extra_metrics={"lost_accepted": 0},
+    )
+    report = {"schema": "ghs-load-report-v1", "gate_metrics": gate}
+    baseline = str(tmp_path / "baseline.json")
+    with open(baseline, "w") as f:
+        json.dump(gate, f)
+    fresh = str(tmp_path / "report.json")
+    with open(fresh, "w") as f:
+        json.dump(report, f)
+    assert bench_gate.main(["--baseline", baseline, "--metrics", fresh]) == 0
+
+    report["gate_metrics"] = json.loads(json.dumps(gate))
+    report["gate_metrics"]["metrics"]["lost_accepted"] = 3
+    with open(fresh, "w") as f:
+        json.dump(report, f)
+    assert bench_gate.main(["--baseline", baseline, "--metrics", fresh]) == 1
+
+
+def test_committed_load_baseline_is_gateable():
+    """The committed gate-load-v1 baseline has the SLO shape: per-class
+    p99 + goodput + errors/shed for the acceptance classes, exact-gated
+    lost_accepted, and passes against itself."""
+    import bench_gate
+
+    path = os.path.join(
+        os.path.dirname(__file__), "..", "docs", "BENCH_BASELINE_LOAD.json"
+    )
+    with open(path) as f:
+        baseline = json.load(f)
+    assert baseline["schema"] == "ghs-bench-metrics-v1"
+    assert baseline["config"]["workload"] == "gate-load-v1"
+    metrics = baseline["metrics"]
+    for cls in ("hit", "miss", "batch", "update", "oversize", "dup"):
+        assert f"{cls}_p99_s" in metrics
+        assert f"{cls}_goodput_per_sec" in metrics
+        assert metrics[f"{cls}_errors"] == 0
+    assert metrics["lost_accepted"] == 0
+    ok, lines = bench_gate.compare(baseline, json.loads(json.dumps(baseline)))
+    assert ok, lines
+
+
+# ----------------------------------------------------------------------
+# The load drill itself (miniature deck; the full smoke runs in CI)
+# ----------------------------------------------------------------------
+def test_load_drill_arrival_models_are_seeded_and_bounded():
+    import numpy as np
+
+    import load_drill
+
+    for model in ("poisson", "bursty", "ramp"):
+        a = load_drill.arrival_times(50, 4.0, model, np.random.default_rng(7))
+        b = load_drill.arrival_times(50, 4.0, model, np.random.default_rng(7))
+        assert np.array_equal(a, b), model  # seeded => identical schedules
+        assert len(a) == 50
+        assert float(a.min()) >= 0.0 and float(a.max()) <= 4.0 + 1e-9
+    assert len(load_drill.arrival_times(0, 4.0, "poisson",
+                                        np.random.default_rng(7))) == 0
+    with pytest.raises(ValueError, match="arrival"):
+        load_drill.arrival_times(5, 4.0, "square-wave",
+                                 np.random.default_rng(7))
+
+
+@pytest.mark.slow
+def test_load_drill_micro_deck_end_to_end(tmp_path):
+    """A tiny open-loop deck against a real service: every class reported
+    from bus events, zero lost accepted queries, chaos absorbed."""
+    import load_drill
+
+    out = str(tmp_path / "report.json")
+    rc = load_drill.main([
+        "--duration", "3", "--rate", "4", "--oversize", "0",
+        "--lanes", "2", "--seed", "5", "--output", out,
+    ])
+    with open(out) as f:
+        report = json.load(f)
+    assert rc == 0, [c for c in report["checks"] if not c["ok"]]
+    assert report["schema"] == "ghs-load-report-v1"
+    for cls in ("hit", "miss", "batch", "update", "dup"):
+        c = report["slo"]["classes"][cls]
+        assert c["sent"] >= 1
+        for p in ("p50", "p95", "p99"):
+            assert c["latency_s"][p] >= 0.0
+    assert report["chaos"]["lost_accepted"] == 0
+    assert report["gate_metrics"]["metrics"]["queries_sent"] == \
+        report["slo"]["totals"]["sent"]

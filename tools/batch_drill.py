@@ -26,10 +26,36 @@ import argparse
 import json
 import os
 import sys
+import time
 
 import numpy as np
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _slo_section(graphs, results, policy, wall_s: float) -> dict:
+    """Per-class accounting over a batch run — the shared
+    ``ghs-slo-summary-v1`` schema (obs/slo.py) all drills report.
+
+    A bulk solve has no per-request arrival clock, so ``latency_s`` here
+    is each result's own solve wall (``MSTResult.wall_time_s``: the device
+    dispatch its lane rode, or the single solve for a bypass); classes are
+    the admission split the engine actually made (``batch`` vs
+    ``oversize``). Queue-wait/overflow context attaches from the bus.
+    """
+    from distributed_ghs_implementation_tpu.obs import slo
+    from distributed_ghs_implementation_tpu.obs.events import BUS
+
+    stats = slo.ClassStats()
+    for g, r in zip(graphs, results):
+        cls = "batch" if policy.admits(g) else "oversize"
+        stats.observe(cls, r.wall_time_s)
+    return slo.assemble(
+        stats,
+        wall_s=wall_s,
+        histograms=BUS.histograms(),
+        events_dropped=BUS.dropped,
+    )
 
 
 def _mixed_graphs(seed: int, count: int):
@@ -78,7 +104,9 @@ def run_smoke(args) -> dict:
     buckets = {bucket_key(g) for g in batchable}
 
     checks = []
+    t_batch = time.perf_counter()
     results = minimum_spanning_forest_batch(graphs, policy=policy)
+    batch_wall_s = time.perf_counter() - t_batch
     parity = all(
         np.array_equal(
             r.edge_ids, minimum_spanning_forest(g).edge_ids
@@ -123,11 +151,15 @@ def run_smoke(args) -> dict:
     )
     checks.append(("scheduler: repeat weights stable", weights_match))
 
+    slo_summary = _slo_section(graphs, results, policy, batch_wall_s)
     return {
         "mode": "smoke",
         "graphs": len(graphs),
         "buckets": len(buckets),
         "compilations": compiles,
+        "slo": slo_summary,
+        "events_dropped": slo_summary["events_dropped"],
+        "dropped_warning": slo_summary["dropped_warning"],
         "checks": [{"name": n, "ok": bool(ok)} for n, ok in checks],
         "ok": all(ok for _, ok in checks),
     }
@@ -158,7 +190,9 @@ def run_chaos(args) -> dict:
     from distributed_ghs_implementation_tpu.batch.engine import BatchEngine
 
     engine = BatchEngine(policy=policy, supervisor_config=config)
+    t_batch = time.perf_counter()
     results = minimum_spanning_forest_batch(graphs, engine=engine)
+    batch_wall_s = time.perf_counter() - t_batch
     FAULTS.reset()
 
     checks = []
@@ -196,11 +230,15 @@ def run_chaos(args) -> dict:
         ("transient lane faults isolated to their lanes (3 armed)",
          device_retries == 3)
     )
+    slo_summary = _slo_section(graphs, results, policy, batch_wall_s)
     return {
         "mode": "chaos",
         "graphs": len(graphs),
         "lane_fallbacks": counters.get("batch.lane.fallback", 0),
         "batch_retries": counters.get("batch.retry", 0),
+        "slo": slo_summary,
+        "events_dropped": slo_summary["events_dropped"],
+        "dropped_warning": slo_summary["dropped_warning"],
         "checks": [{"name": n, "ok": bool(ok)} for n, ok in checks],
         "ok": all(ok for _, ok in checks),
     }

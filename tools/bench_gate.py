@@ -5,6 +5,11 @@
     python tools/bench_gate.py --update              # (re)write the baseline
     python tools/bench_gate.py --metrics fresh.json  # compare a saved run
 
+    # gate-load-v1: a load-drill report (tools/load_drill.py) gates its
+    # embedded per-class SLO metrics against the committed load baseline
+    python tools/bench_gate.py --metrics load_report.json \
+        --baseline docs/BENCH_BASELINE_LOAD.json
+
 Exit code 0 iff no metric regresses beyond its tolerance. Two metric
 classes, told apart by key suffix (plus the KINDS overrides):
 
@@ -45,6 +50,13 @@ DEFAULT_BASELINE = os.path.join(
 
 #: Metric-kind overrides; everything else is classified by suffix
 #: (``*_s`` time, ``*_per_sec`` throughput, default count).
+#: ``lost_accepted`` (the ``gate-load-v1`` workload, tools/load_drill.py)
+#: is exact: the serving stack losing an accepted query is a correctness
+#: failure exactly like a changed MST weight, never a tolerance question.
+#: The load workload's per-class ``<cls>_p99_s`` / ``<cls>_goodput_per_sec``
+#: keys need no override — the suffixes already gate them as wall-time
+#: ceilings and throughput floors; ``<cls>_errors`` / ``<cls>_shed`` gate
+#: as counts against a zero baseline, so ANY error or shed fails.
 #: ``batch_speedup`` / ``pipeline_speedup`` are wall-clock ratios, so they
 #: gate like throughputs (floor), never like deterministic counts. The
 #: round-10 latency keys (``cold_first_solve_s``, ``warm_solve_p50_s`` /
@@ -62,6 +74,7 @@ KINDS = {
     "batch_mst_weight": "exact",
     "batch_speedup": "throughput",
     "pipeline_speedup": "throughput",
+    "lost_accepted": "exact",
 }
 
 
@@ -265,6 +278,12 @@ def main(argv=None) -> int:
     if args.metrics:
         with open(args.metrics) as f:
             fresh = json.load(f)
+        if fresh.get("schema") == "ghs-load-report-v1":
+            # A load-drill report embeds its gate metrics (the
+            # ``gate-load-v1`` workload, obs.slo.gate_metrics): per-class
+            # p99 ceilings, goodput floors, error/shed counts,
+            # lost_accepted. Gate on those directly.
+            fresh = fresh.get("gate_metrics", {})
     else:
         fresh = run_gate_bench()
     if fresh.get("schema") != SCHEMA:

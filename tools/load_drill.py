@@ -1,0 +1,576 @@
+#!/usr/bin/env python
+"""Open-loop load drill: sustained concurrent traffic against the serve stack.
+
+Every other bench in the repo is *closed-loop* (the next request waits for
+the last answer), which can never see queueing collapse. This drill is
+**open-loop**: a seeded arrival schedule is generated up front (Poisson,
+bursty, or ramp — arrivals never wait on completions), then threaded
+clients fire a mixed scenario deck at :class:`serve.service.MSTService`:
+
+* ``hit`` — repeats over a pre-solved pool (pure cache path),
+* ``miss`` — distinct graphs across several shape buckets (solver path),
+* ``batch`` — same-bucket bursts that must share lanes in the batch engine,
+* ``dup`` — duplicate-digest storms (single-flight coalescing),
+* ``update`` — incremental edge-update streams through ``serve/dynamic.py``
+  (digest-chained, serialized per stream),
+* ``oversize`` — bucket-ceiling bypasses to the single-graph path,
+
+plus seeded **chaos faults armed mid-flight** (transient device failures,
+a failed batch attempt) that the supervisor ladder must absorb: an
+accepted query may degrade, it may never be *lost*.
+
+Each request carries an ``slo_class`` tag; per-class goodput and
+p50/p95/p99 latency are then **joined from the real ``serve.*`` /
+``batch.*`` / ``compile.*`` bus events** by ``obs.slo`` (client-side
+stopwatch accounting rides along as a cross-check). The report
+(``ghs-load-report-v1``) embeds ``ghs-bench-metrics-v1`` gate metrics;
+``tools/bench_gate.py`` compares them against the committed
+``docs/BENCH_BASELINE_LOAD.json`` (the ``gate-load-v1`` workload) so p99
+and goodput regressions fail CI the way weight parity does. See
+``docs/LOAD_TESTING.md``.
+
+    python tools/load_drill.py --smoke --output load_report.json \
+        --gate-baseline docs/BENCH_BASELINE_LOAD.json
+    python tools/load_drill.py --smoke --update-baseline   # rewrite baseline
+    python tools/load_drill.py --chaos --duration 20       # chaos scenario
+
+Exit code 0 iff every check passed (and the gate, when a baseline is given).
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import json
+import os
+import sys
+import threading
+import time
+from concurrent.futures import ThreadPoolExecutor
+from typing import List, Optional
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+REPORT_SCHEMA = "ghs-load-report-v1"
+WORKLOAD = "gate-load-v1"
+DEFAULT_BASELINE = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    "docs",
+    "BENCH_BASELINE_LOAD.json",
+)
+
+# Shape buckets the deck draws from (nodes, edges): hit/miss/batch classes
+# stay inside the lane-admission ceiling; oversize deliberately exceeds it.
+MISS_SHAPES = ((48, 120), (96, 280), (200, 620))
+BATCH_SHAPE = (128, 400)
+HIT_SHAPE = (64, 180)
+UPDATE_SHAPE = (80, 240)
+OVERSIZE_SHAPE = (70_000, 140_000)
+
+
+@dataclasses.dataclass
+class Arrival:
+    """One scheduled query: fire at ``at_s`` (relative to window start)."""
+
+    at_s: float
+    cls: str
+    request: Optional[dict] = None  # None for update-stream arrivals
+    stream: Optional[int] = None  # update-stream id (digest chained)
+    updates: Optional[list] = None  # the update ops for a stream arrival
+
+
+def _graph_request(g, cls: str) -> dict:
+    return {
+        "op": "solve",
+        "num_nodes": g.num_nodes,
+        "edges": [[int(a), int(b), int(c)] for a, b, c in zip(g.u, g.v, g.w)],
+        "slo_class": cls,
+    }
+
+
+# ----------------------------------------------------------------------
+# Arrival models (open-loop: schedules are fixed before the first dispatch)
+# ----------------------------------------------------------------------
+def arrival_times(
+    n: int, duration_s: float, model: str, rng: np.random.Generator
+) -> np.ndarray:
+    """``n`` seeded arrival offsets in ``[0, duration_s)``.
+
+    ``poisson`` — exponential inter-arrival gaps, rescaled to the window
+    (open-loop Poisson traffic at the target average rate).
+    ``bursty`` — four ON windows separated by silence; arrivals uniform
+    inside the ON windows (a thundering-herd shape).
+    ``ramp`` — arrival density grows linearly across the window (the
+    rate doubles by the end; models a traffic ramp-up).
+    """
+    if n <= 0:
+        return np.empty(0)
+    if model == "poisson":
+        gaps = rng.exponential(1.0, size=n)
+        t = np.cumsum(gaps)
+        return t * (duration_s / t[-1])
+    if model == "bursty":
+        bursts = 4
+        on = duration_s / (2 * bursts)
+        starts = np.arange(bursts) * (2 * on)
+        which = rng.integers(0, bursts, size=n)
+        return starts[which] + rng.uniform(0, on, size=n)
+    if model == "ramp":
+        # Inverse-CDF of a linearly growing rate: t = D * sqrt(u).
+        return duration_s * np.sqrt(rng.uniform(0, 1, size=n))
+    raise ValueError(f"unknown arrival model {model!r}")
+
+
+# ----------------------------------------------------------------------
+# The scenario deck
+# ----------------------------------------------------------------------
+def build_deck(args, rng: np.random.Generator):
+    """Returns ``(schedule, warm_graphs, stream_seeds, counts)``.
+
+    ``warm_graphs`` are solved before the measured window (cache/bucket
+    priming); ``stream_seeds`` seed the update sessions. Every graph is
+    seeded from ``args.seed``, so the deck is bit-reproducible.
+    """
+    from distributed_ghs_implementation_tpu.graphs.generators import (
+        gnm_random_graph,
+    )
+
+    D = args.duration
+    scale = args.rate / 10.0  # --rate 10 is the smoke deck's reference size
+    counts = {
+        "hit": max(4, int(30 * scale)),
+        "miss": max(3, int(24 * scale)),
+        "batch": max(4, int(24 * scale)),
+        "dup": max(4, int(12 * scale)),
+        "update": max(3, int(15 * scale)),
+        "oversize": args.oversize,
+    }
+    schedule: List[Arrival] = []
+
+    # hit: repeats over a small pre-solved pool.
+    hit_pool = [
+        gnm_random_graph(*HIT_SHAPE, seed=args.seed + 100 + i) for i in range(4)
+    ]
+    for i, t in enumerate(
+        arrival_times(counts["hit"], D, args.arrival, rng)
+    ):
+        schedule.append(
+            Arrival(float(t), "hit", _graph_request(hit_pool[i % 4], "hit"))
+        )
+
+    # miss: every query a distinct graph, cycling the shape buckets.
+    for i, t in enumerate(
+        arrival_times(counts["miss"], D, args.arrival, rng)
+    ):
+        shape = MISS_SHAPES[i % len(MISS_SHAPES)]
+        g = gnm_random_graph(*shape, seed=args.seed + 1000 + i)
+        schedule.append(Arrival(float(t), "miss", _graph_request(g, "miss")))
+
+    # batch: same-bucket bursts — distinct digests arriving together so the
+    # engine's forming queue actually builds multi-graph lanes.
+    n_bursts = max(1, counts["batch"] // 8)
+    burst_at = np.linspace(0.15 * D, 0.85 * D, n_bursts)
+    for i in range(counts["batch"]):
+        g = gnm_random_graph(*BATCH_SHAPE, seed=args.seed + 2000 + i)
+        t = float(burst_at[i % n_bursts]) + float(rng.uniform(0, 0.01))
+        schedule.append(Arrival(t, "batch", _graph_request(g, "batch")))
+
+    # dup: duplicate-digest storms — each storm is ONE uncached digest
+    # fired ~simultaneously; single-flight must answer with one solve.
+    n_storms = max(1, counts["dup"] // 6)
+    counts["dup"] = n_storms * (counts["dup"] // n_storms)
+    storm_at = np.linspace(0.3 * D, 0.7 * D, n_storms)
+    for s in range(n_storms):
+        g = gnm_random_graph(
+            BATCH_SHAPE[0], BATCH_SHAPE[1], seed=args.seed + 3000 + s
+        )
+        req = _graph_request(g, "dup")
+        for k in range(counts["dup"] // n_storms):
+            t = float(storm_at[s]) + float(rng.uniform(0, 0.005))
+            schedule.append(Arrival(t, "dup", req))
+
+    # update: digest-chained incremental streams (built at dispatch time —
+    # each response re-keys the session content-addressed).
+    n_streams = 3
+    stream_seeds = [
+        gnm_random_graph(*UPDATE_SHAPE, seed=args.seed + 4000 + s)
+        for s in range(n_streams)
+    ]
+    for i, t in enumerate(
+        arrival_times(counts["update"], D, args.arrival, rng)
+    ):
+        s = i % n_streams
+        n = stream_seeds[s].num_nodes
+        a, b = 0, 0
+        while a == b:
+            a, b = (int(x) for x in rng.integers(0, n, 2))
+        kind = "insert" if i % 3 else "reweight"
+        upd = {"kind": kind, "u": min(a, b), "v": max(a, b),
+               "w": int(rng.integers(1, 100))}
+        if kind == "reweight":
+            # Reweight an edge that certainly exists: one from the seed.
+            j = int(rng.integers(0, stream_seeds[s].num_edges))
+            upd["u"] = int(stream_seeds[s].u[j])
+            upd["v"] = int(stream_seeds[s].v[j])
+        schedule.append(
+            Arrival(float(t), "update", stream=s, updates=[upd])
+        )
+
+    # oversize: beyond the lane-admission ceiling — must bypass to the
+    # single-graph path without stalling small-graph traffic.
+    for i, frac in enumerate(np.linspace(0.25, 0.65, counts["oversize"])):
+        g = gnm_random_graph(*OVERSIZE_SHAPE, seed=args.seed + 5000 + i)
+        schedule.append(
+            Arrival(float(frac) * D, "oversize", _graph_request(g, "oversize"))
+        )
+
+    schedule.sort(key=lambda a: a.at_s)
+    warm_graphs = (
+        hit_pool
+        + [gnm_random_graph(*s, seed=args.seed + 90) for s in MISS_SHAPES]
+        + [gnm_random_graph(*BATCH_SHAPE, seed=args.seed + 91)]
+    )
+    if counts["oversize"]:  # don't warm a bucket no query will touch
+        warm_graphs.append(gnm_random_graph(*OVERSIZE_SHAPE, seed=args.seed + 92))
+    return schedule, warm_graphs, stream_seeds, counts
+
+
+# ----------------------------------------------------------------------
+# Execution
+# ----------------------------------------------------------------------
+class _StreamState:
+    __slots__ = ("digest", "lock")
+
+    def __init__(self, digest: str):
+        self.digest = digest
+        self.lock = threading.Lock()
+
+
+def run_window(service, schedule, streams, args, chaos_plan):
+    """Dispatch the schedule open-loop; returns client-side records + wall.
+
+    Latency is measured from the SCHEDULED arrival instant (not dispatch),
+    so client-pool backlog counts against the service — the open-loop
+    convention that makes queueing delay visible.
+    """
+    from distributed_ghs_implementation_tpu.utils.resilience import FAULTS
+
+    records: List[dict] = []
+    records_lock = threading.Lock()
+
+    t0 = time.perf_counter()
+
+    def fire(arrival: Arrival) -> None:
+        scheduled = t0 + arrival.at_s
+        try:
+            if arrival.stream is not None:
+                state = streams[arrival.stream]
+                with state.lock:
+                    response = service.handle(
+                        {
+                            "op": "update",
+                            "digest": state.digest,
+                            "updates": arrival.updates,
+                            "slo_class": arrival.cls,
+                        }
+                    )
+                    if response.get("ok"):
+                        state.digest = response["digest"]
+            else:
+                response = service.handle(arrival.request)
+            ok = bool(response.get("ok"))
+        except Exception as e:  # noqa: BLE001 — a lost query, recorded
+            with records_lock:
+                records.append(
+                    {"cls": arrival.cls, "ok": False, "lost": True,
+                     "error": f"{type(e).__name__}: {e}",
+                     "latency_s": time.perf_counter() - scheduled}
+                )
+            return
+        with records_lock:
+            records.append(
+                {"cls": arrival.cls, "ok": ok, "lost": False,
+                 "error": response.get("error"),
+                 "latency_s": time.perf_counter() - scheduled}
+            )
+
+    chaos_armed: List[dict] = []
+    next_chaos = 0
+    with ThreadPoolExecutor(max_workers=args.workers) as pool:
+        futures = []
+        for arrival in schedule:
+            while (
+                next_chaos < len(chaos_plan)
+                and arrival.at_s >= chaos_plan[next_chaos]["at_s"]
+            ):
+                # Chaos lands MID-FLIGHT, between dispatches: earlier
+                # queries are still in the pool when the faults arm.
+                plan = chaos_plan[next_chaos]
+                for site, times in plan["sites"].items():
+                    FAULTS.arm(site, times=times)
+                chaos_armed.append(plan)
+                next_chaos += 1
+            delay = (t0 + arrival.at_s) - time.perf_counter()
+            if delay > 0:
+                time.sleep(delay)
+            futures.append(pool.submit(fire, arrival))
+        for f in futures:
+            f.result()  # fire() never raises; this rejoins the pool
+    wall_s = time.perf_counter() - t0
+    return records, wall_s, chaos_armed
+
+
+def client_summary(records, wall_s) -> dict:
+    """The stopwatch cross-check: same schema, client-side measurements."""
+    from distributed_ghs_implementation_tpu.obs import slo
+
+    stats = slo.ClassStats()
+    for rec in records:
+        stats.observe(rec["cls"], rec["latency_s"], ok=rec["ok"])
+    return slo.assemble(stats, wall_s=wall_s)
+
+
+# ----------------------------------------------------------------------
+# The drill
+# ----------------------------------------------------------------------
+def run_drill(args) -> dict:
+    from distributed_ghs_implementation_tpu.obs import slo
+    from distributed_ghs_implementation_tpu.obs.events import BUS
+    from distributed_ghs_implementation_tpu.obs.export import write_events_jsonl
+    from distributed_ghs_implementation_tpu.serve.service import MSTService
+    from distributed_ghs_implementation_tpu.utils.resilience import FAULTS
+
+    BUS.enable()
+    rng = np.random.default_rng(args.seed)
+    schedule, warm_graphs, stream_seeds, counts = build_deck(args, rng)
+
+    service = MSTService(
+        batch_lanes=args.lanes,
+        batch_wait_s=args.batch_wait,
+        max_sessions=256,  # solve seeds must not LRU-evict update sessions
+        store_capacity=max(256, len(schedule)),
+    )
+
+    # Warm phase: prime every bucket the deck touches (compiles, rank
+    # caches, the hit pool, update sessions) OUTSIDE the measured window —
+    # sustained-load numbers should show steady-state serving, and the
+    # compile.* counters inside the window then expose any request-time
+    # compile as the anomaly it is.
+    t_warm = time.perf_counter()
+    for g in warm_graphs:
+        service.handle(_graph_request(g, "warm"))
+    stream_digests = []
+    for g in stream_seeds:
+        response = service.handle(_graph_request(g, "warm"))
+        if not response.get("ok"):
+            raise RuntimeError(f"warm solve failed: {response.get('error')}")
+        stream_digests.append(response["digest"])
+    warm_s = time.perf_counter() - t_warm
+    streams = [_StreamState(d) for d in stream_digests]
+
+    # Chaos plan: transient faults armed mid-flight (seeded offsets). The
+    # supervisor ladder + batch retry must absorb them — degraded latency
+    # is expected, lost accepted queries are not.
+    chaos_plan = []
+    if not args.no_chaos:
+        chaos_plan.append(
+            {
+                "at_s": 0.5 * args.duration,
+                "sites": {"resilience.attempt.device": 2, "batch.attempt": 1},
+            }
+        )
+        if args.chaos:
+            chaos_plan.append(
+                {
+                    "at_s": 0.7 * args.duration,
+                    "sites": {"resilience.attempt.device": 4, "batch.attempt": 2},
+                }
+            )
+
+    BUS.clear()  # the measured window starts here
+    try:
+        records, wall_s, chaos_armed = run_window(
+            service, schedule, streams, args, chaos_plan
+        )
+    finally:
+        FAULTS.reset()
+
+    # Server-side accounting: the per-class join over real bus events.
+    summary = slo.summarize_bus(BUS, wall_s=wall_s)
+    client = client_summary(records, wall_s)
+    compile_counters = {
+        k: v for k, v in BUS.counters().items() if k.startswith("compile.")
+    }
+    serve_counters = {
+        k: v
+        for k, v in BUS.counters().items()
+        if k.startswith(("serve.", "batch."))
+    }
+    if args.jsonl:
+        write_events_jsonl(BUS, args.jsonl)
+
+    lost = sum(1 for rec in records if rec["lost"])
+    answered = len(records)
+    errors = sum(1 for rec in records if not rec["ok"] and not rec["lost"])
+    expected_classes = [c for c, n in counts.items() if n > 0]
+    bus_classes = summary["classes"]
+
+    checks = [
+        ("every accepted query answered",
+         answered == len(schedule) and lost == 0),
+        ("zero errors (chaos absorbed by the supervisor)", errors == 0),
+        ("all classes present in the bus-joined report",
+         all(c in bus_classes for c in expected_classes)),
+        ("bus join saw every request span",
+         summary["totals"]["sent"] == len(schedule)),
+        ("no events dropped during the window (report trustworthy)",
+         not summary["dropped_warning"]),
+        ("p99 bounded under chaos",
+         client["totals"]["latency_s"].get("p99", float("inf"))
+         <= args.p99_bound),
+        ("duplicate storms coalesced (single-flight)",
+         serve_counters.get("serve.scheduler.coalesced", 0) >= 1),
+        ("chaos armed mid-flight", len(chaos_armed) == len(chaos_plan)),
+        ("cache absorbed the hit class",
+         serve_counters.get("serve.store.hit", 0) >= counts["hit"]),
+        ("zero request-time compiles in the measured window",
+         compile_counters.get("compile.miss", 0) == 0),
+    ]
+    ok = all(passed for _, passed in checks)
+
+    config = {
+        "workload": WORKLOAD,
+        "deck": "smoke" if args.smoke else "custom",
+        "seed": args.seed,
+        "arrival": args.arrival,
+        "duration_s": args.duration,
+        "rate": args.rate,
+        "lanes": args.lanes,
+        "counts": counts,
+        "chaos": "off" if args.no_chaos else ("heavy" if args.chaos else "mid"),
+    }
+    gate = slo.gate_metrics(
+        summary,
+        workload=WORKLOAD,
+        config=config,
+        extra_metrics={"lost_accepted": lost, "answered": answered},
+    )
+    return {
+        "schema": REPORT_SCHEMA,
+        "config": config,
+        "wall_s": round(wall_s, 3),
+        "warm_s": round(warm_s, 3),
+        "slo": summary,
+        "client": client,
+        "compile_counters": compile_counters,
+        "serve_counters": serve_counters,
+        "chaos": {
+            "armed": chaos_armed,
+            "lost_accepted": lost,
+            "errors": errors,
+        },
+        "events_dropped": summary["events_dropped"],
+        "dropped_warning": summary["dropped_warning"],
+        "checks": [{"name": n, "ok": bool(p)} for n, p in checks],
+        "ok": ok,
+        "gate_metrics": gate,
+    }
+
+
+def run_gate(report: dict, baseline_path: str, time_tolerance: float):
+    """Compare the report's gate metrics against the committed baseline
+    (reusing bench_gate's classification); returns ``(ok, lines)``."""
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    import bench_gate
+
+    with open(baseline_path) as f:
+        baseline = json.load(f)
+    return bench_gate.compare(
+        baseline, report["gate_metrics"], time_tolerance=time_tolerance
+    )
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(prog="load_drill", description=__doc__)
+    p.add_argument("--smoke", action="store_true",
+                   help="the CI deck: ~10s window, mid-flight chaos, gate-ready")
+    p.add_argument("--chaos", action="store_true",
+                   help="heavier chaos scenario (second mid-flight arm point)")
+    p.add_argument("--no-chaos", action="store_true",
+                   help="disable the deck's mid-flight fault arming")
+    p.add_argument("--arrival", choices=("poisson", "bursty", "ramp"),
+                   default="poisson")
+    p.add_argument("--duration", type=float, default=10.0,
+                   help="arrival window in seconds (open-loop)")
+    p.add_argument("--rate", type=float, default=10.0,
+                   help="average arrivals/sec scale (10 = reference deck)")
+    p.add_argument("--seed", type=int, default=23)
+    p.add_argument("--lanes", type=int, default=4,
+                   help="batch lanes for the service under test")
+    p.add_argument("--batch-wait", type=float, default=0.02,
+                   help="lane-forming window (s); wider than prod default "
+                   "so open-loop bursts actually share lanes")
+    p.add_argument("--oversize", type=int, default=2,
+                   help="oversize-bypass queries in the deck")
+    p.add_argument("--workers", type=int, default=16,
+                   help="client threads (the open-loop dispatch pool)")
+    p.add_argument("--p99-bound", type=float, default=30.0,
+                   help="degraded-but-BOUNDED: fail if total p99 exceeds this")
+    p.add_argument("--jsonl", help="also export the window's bus events")
+    p.add_argument("--output", help="write the JSON report here")
+    p.add_argument("--gate-baseline", nargs="?", const=DEFAULT_BASELINE,
+                   help="gate the report against this baseline "
+                   f"(default {DEFAULT_BASELINE})")
+    p.add_argument("--time-tolerance", type=float, default=0.5,
+                   help="gate wall-time tolerance (CI uses 5.0)")
+    p.add_argument("--update-baseline", nargs="?", const=DEFAULT_BASELINE,
+                   help="write the gate baseline from this run and exit")
+    args = p.parse_args(argv)
+
+    report = run_drill(args)
+    if args.output:
+        with open(args.output, "w") as f:
+            json.dump(report, f, indent=2)
+            f.write("\n")
+
+    brief = {
+        k: report[k]
+        for k in ("schema", "config", "wall_s", "checks", "ok",
+                  "events_dropped", "chaos")
+    }
+    brief["classes"] = {
+        cls: {
+            "sent": c["sent"],
+            "goodput_per_sec": round(c["goodput_per_sec"] or 0, 2),
+            "p50_s": round(c["latency_s"].get("p50", 0), 4),
+            "p95_s": round(c["latency_s"].get("p95", 0), 4),
+            "p99_s": round(c["latency_s"].get("p99", 0), 4),
+        }
+        for cls, c in report["slo"]["classes"].items()
+    }
+    print(json.dumps(brief, indent=2))
+
+    if args.update_baseline:
+        with open(args.update_baseline, "w") as f:
+            json.dump(report["gate_metrics"], f, indent=2)
+            f.write("\n")
+        print(f"load baseline written: {args.update_baseline}")
+        return 0 if report["ok"] else 1
+
+    gate_ok = True
+    if args.gate_baseline:
+        gate_ok, lines = run_gate(
+            report, args.gate_baseline, args.time_tolerance
+        )
+        for line in lines:
+            print(line)
+        print(f"load gate ({WORKLOAD}): {'PASS' if gate_ok else 'FAIL'}")
+
+    print(f"load drill: {'PASS' if report['ok'] and gate_ok else 'FAIL'}")
+    return 0 if report["ok"] and gate_ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -50,18 +50,34 @@ def _seed_graph(nodes: int, edges: int, seed: int):
     return gnm_random_graph(nodes, edges, seed=seed)
 
 
+def _slo_section(stats, wall_s: float, stats_response: dict = None) -> dict:
+    """The drill's per-class summary — the SAME ``ghs-slo-summary-v1``
+    schema the load drill reports, so all drills compare field-for-field.
+    Subprocess modes measure client-side (the server's bus lives across
+    the pipes); ``events_dropped`` rides in from the ``stats`` op."""
+    from distributed_ghs_implementation_tpu.obs import slo
+
+    dropped = int((stats_response or {}).get("events_dropped", 0))
+    return slo.assemble(stats, wall_s=wall_s, events_dropped=dropped)
+
+
 def _graph_edges(g):
     return [[int(a), int(b), int(c)] for a, b, c in zip(g.u, g.v, g.w)]
 
 
 def run_smoke(args) -> dict:
     """solve -> update -> repeat-solve over the real CLI pipes."""
+    from distributed_ghs_implementation_tpu.obs import slo
+
     g = _seed_graph(args.nodes, args.edges, args.seed)
     edges = _graph_edges(g)
     requests = [
-        {"op": "solve", "num_nodes": g.num_nodes, "edges": edges},
-        {"op": "update", "digest": None, "updates": []},  # digest patched below
-        {"op": "solve", "num_nodes": g.num_nodes, "edges": edges},
+        {"op": "solve", "num_nodes": g.num_nodes, "edges": edges,
+         "slo_class": "miss"},
+        {"op": "update", "digest": None, "updates": [],
+         "slo_class": "update"},  # digest patched below
+        {"op": "solve", "num_nodes": g.num_nodes, "edges": edges,
+         "slo_class": "hit"},
         {"op": "stats"},
         {"op": "shutdown"},
     ]
@@ -73,15 +89,27 @@ def run_smoke(args) -> dict:
         env={**os.environ, "JAX_PLATFORMS": os.environ.get("JAX_PLATFORMS", "cpu")},
     )
 
+    acct = slo.ClassStats()
+
     def roundtrip(request):
+        t0 = time.perf_counter()
         proc.stdin.write(json.dumps(request) + "\n")
         proc.stdin.flush()
         line = proc.stdout.readline()
         if not line:
             raise RuntimeError("serve process closed its pipe early")
-        return json.loads(line)
+        response = json.loads(line)
+        if request.get("slo_class"):
+            acct.observe(
+                request["slo_class"],
+                time.perf_counter() - t0,
+                ok=bool(response.get("ok")),
+            )
+        return response
 
     checks = []
+    stats = {}
+    t_run = time.perf_counter()
     try:
         first = roundtrip(requests[0])
         checks.append(("first solve ok", bool(first.get("ok"))))
@@ -107,9 +135,13 @@ def run_smoke(args) -> dict:
     finally:
         proc.stdin.close()
         proc.wait(timeout=60)
+    slo_summary = _slo_section(acct, time.perf_counter() - t_run, stats)
     return {
         "mode": "smoke",
         "checks": [{"name": n, "ok": bool(ok)} for n, ok in checks],
+        "slo": slo_summary,
+        "events_dropped": slo_summary["events_dropped"],
+        "dropped_warning": slo_summary["dropped_warning"],
         "ok": all(ok for _, ok in checks),
     }
 
@@ -117,6 +149,8 @@ def run_smoke(args) -> dict:
 def run_warmup_smoke(args) -> dict:
     """Warmup serve, query the pre-declared bucket, assert zero
     request-time compiles (``compile.miss``) via the stats op."""
+    from distributed_ghs_implementation_tpu.obs import slo
+
     g1 = _seed_graph(args.nodes, args.edges, args.seed)
     g2 = _seed_graph(args.nodes, args.edges, args.seed + 1)
     cache_dir = args.compile_cache_dir or "serve_compile_cache"
@@ -145,19 +179,25 @@ def run_warmup_smoke(args) -> dict:
     checks = []
     counters = {}
     warmup_report = None
-    latencies = []
+    stats = {}
+    acct = slo.ClassStats()
+    t_run = time.perf_counter()
     try:
         # A throwaway stats roundtrip absorbs subprocess boot + the warmup
         # phase, so the timed solves below measure warm QUERY latency, not
         # interpreter startup.
         boot = roundtrip({"op": "stats"})
         checks.append(("serve booted", bool(boot.get("ok"))))
+        t_run = time.perf_counter()
         for i, g in enumerate((g1, g2), 1):
             t0 = time.perf_counter()
             response = roundtrip(
-                {"op": "solve", "num_nodes": g.num_nodes, "edges": _graph_edges(g)}
+                {"op": "solve", "num_nodes": g.num_nodes,
+                 "edges": _graph_edges(g), "slo_class": "miss"}
             )
-            latencies.append(round(time.perf_counter() - t0, 4))
+            acct.observe(
+                "miss", time.perf_counter() - t0, ok=bool(response.get("ok"))
+            )
             checks.append((f"solve {i} ok", bool(response.get("ok"))))
             checks.append((f"solve {i} is a miss", response.get("source") == "solved"))
             checks.append(
@@ -167,6 +207,7 @@ def run_warmup_smoke(args) -> dict:
         stats = roundtrip({"op": "stats"})
         counters = stats.get("counters", {})
         warmup_report = stats.get("warmup")
+        wall_s = time.perf_counter() - t_run
         checks.append(("warmup ran", bool(warmup_report)))
         checks.append(
             ("warmup compiled the bucket",
@@ -184,10 +225,13 @@ def run_warmup_smoke(args) -> dict:
     finally:
         proc.stdin.close()
         proc.wait(timeout=120)
+    slo_summary = _slo_section(acct, wall_s, stats)
     return {
         "mode": "warmup-smoke",
         "checks": [{"name": n, "ok": bool(ok)} for n, ok in checks],
-        "query_latency_s": latencies,
+        "slo": slo_summary,
+        "events_dropped": slo_summary["events_dropped"],
+        "dropped_warning": slo_summary["dropped_warning"],
         "warmup": warmup_report,
         "compile_counters": {
             k: v for k, v in counters.items() if k.startswith("compile.")
@@ -200,10 +244,14 @@ def run_warmup_smoke(args) -> dict:
 def run_replay(args) -> dict:
     """In-process update-stream replay, every step checked vs the oracle."""
     from distributed_ghs_implementation_tpu.graphs.edgelist import Graph
+    from distributed_ghs_implementation_tpu.obs import slo
+    from distributed_ghs_implementation_tpu.obs.events import BUS
     from distributed_ghs_implementation_tpu.serve.service import MSTService
     from distributed_ghs_implementation_tpu.utils.resilience import FAULTS
     from distributed_ghs_implementation_tpu.utils.verify import scipy_mst_weight
 
+    BUS.enable()
+    BUS.clear()
     if args.chaos:
         # The miss path must survive transient device failures (supervisor
         # retry), and the persistent cache a torn write mid-save.
@@ -216,8 +264,10 @@ def run_replay(args) -> dict:
     mirror = {
         (int(a), int(b)): int(c) for a, b, c in zip(g.u, g.v, g.w)
     }
+    t_run = time.perf_counter()
     response = service.handle(
-        {"op": "solve", "num_nodes": g.num_nodes, "edges": _graph_edges(g)}
+        {"op": "solve", "num_nodes": g.num_nodes, "edges": _graph_edges(g),
+         "slo_class": "miss"}
     )
     if not response.get("ok"):
         return {"mode": "replay", "ok": False, "error": response.get("error")}
@@ -245,7 +295,8 @@ def run_replay(args) -> dict:
             upd = {"kind": "insert", "u": a, "v": b, "w": w}
             mirror[(a, b)] = w  # insert of an existing edge is a reweight
         response = service.handle(
-            {"op": "update", "digest": digest, "updates": [upd]}
+            {"op": "update", "digest": digest, "updates": [upd],
+             "slo_class": "update"}
         )
         if not response.get("ok"):
             steps.append({"step": step, "update": upd,
@@ -266,11 +317,17 @@ def run_replay(args) -> dict:
              "weight": response["total_weight"], "oracle": expect, "ok": good}
         )
     stats = service.handle({"op": "stats"})
+    # In-process: per-class accounting joins the REAL bus events (the same
+    # obs.slo join the load drill gates on), not client stopwatches.
+    slo_summary = slo.summarize_bus(BUS, wall_s=time.perf_counter() - t_run)
     return {
         "mode": "replay",
         "chaos": bool(args.chaos),
         "ok": ok,
         "steps_run": len(steps),
+        "slo": slo_summary,
+        "events_dropped": slo_summary["events_dropped"],
+        "dropped_warning": slo_summary["dropped_warning"],
         "counters": stats.get("counters", {}),
         "failures": [s for s in steps if not s.get("ok", True)],
     }
