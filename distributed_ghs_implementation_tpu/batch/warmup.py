@@ -36,7 +36,7 @@ from __future__ import annotations
 import dataclasses
 import json
 import time
-from typing import List, Sequence, Tuple
+from typing import List, Optional, Sequence, Tuple
 
 import jax.numpy as jnp
 import numpy as np
@@ -202,6 +202,30 @@ def load_bucket_record(path: str) -> WarmupPlan:
         for n, m, lanes, mode in record.get("buckets", [])
     )
     return WarmupPlan(keys=keys)
+
+
+def plan_from_flags(
+    buckets: Optional[str] = None,
+    replay: Optional[str] = None,
+    lanes: int = 0,
+) -> Optional[WarmupPlan]:
+    """A :class:`WarmupPlan` from the serve-CLI flag surface, or ``None``.
+
+    The ONE mapping from ``--warmup-buckets`` / ``--warmup-replay`` strings
+    to a plan — shared by ``ghs serve`` and every fleet worker
+    (``fleet/worker.py``), so a bucket ladder declared on the router warms
+    identically in all N worker processes.
+    """
+    plans: List[WarmupPlan] = []
+    if buckets:
+        plans.append(
+            WarmupPlan(buckets=tuple(parse_bucket_list(buckets)), lanes=lanes)
+        )
+    if replay:
+        plans.append(load_bucket_record(replay))
+    if not plans:
+        return None
+    return merge_plans(*plans)
 
 
 def merge_plans(*plans: WarmupPlan) -> WarmupPlan:

@@ -340,11 +340,57 @@ def _cmd_stats(args) -> int:
 
 def _cmd_serve(args) -> int:
     """The MST query service: JSONL requests on stdin (or --input), JSON
-    responses on stdout (serve/service.py has the protocol)."""
+    responses on stdout (serve/service.py has the protocol). ``--fleet N``
+    serves the same protocol through N digest-routed worker processes with
+    health-checked failover (fleet/router.py, docs/FLEET.md)."""
     from distributed_ghs_implementation_tpu.serve.service import (
         MSTService,
         serve_loop,
     )
+
+    if args.fleet:
+        from distributed_ghs_implementation_tpu.fleet.router import (
+            FleetConfig,
+            FleetRouter,
+        )
+
+        if args.warmup_record:
+            raise SystemExit(
+                "--warmup-record is per-worker state the router cannot "
+                "see; record from a single-process serve, then replay "
+                "with --fleet --warmup-replay"
+            )
+        config = FleetConfig(
+            workers=args.fleet,
+            backend=args.backend,
+            batch_lanes=args.batch_lanes,
+            store_capacity=args.cache_entries,
+            disk_dir=args.disk_cache,
+            max_concurrent=args.max_concurrent,
+            resolve_threshold=args.resolve_threshold,
+            queue_depth=args.fleet_queue_depth,
+            shed_classes=tuple(
+                c for c in (args.fleet_shed or "").split(",") if c
+            ),
+            warmup_buckets=args.warmup_buckets,
+            warmup_replay=args.warmup_replay,
+            compile_cache_dir=args.compile_cache_dir,
+            no_compile_cache=args.no_compile_cache,
+            obs_dir=args.fleet_obs_dir,
+        )
+        # Workers enable the (shared, machine-fingerprinted) persistent
+        # compile cache and run warmup themselves; the router never
+        # compiles, so none of that happens in this process.
+        with FleetRouter(config) as router:
+            print(
+                f"fleet: {args.fleet} workers ready "
+                f"(queue_depth={config.queue_depth})",
+                file=sys.stderr,
+            )
+            if args.input:
+                with open(args.input) as f:
+                    return serve_loop(f, sys.stdout, router)
+            return serve_loop(sys.stdin, sys.stdout, router)
 
     # Persistent compile cache first (default ON for serve): config must
     # land before the first compile — warmup's included.
@@ -357,23 +403,13 @@ def _cmd_serve(args) -> int:
         if cache_dir:
             print(f"compile cache: {cache_dir}", file=sys.stderr)
 
-    warmup_plan = None
-    if args.warmup_buckets or args.warmup_replay:
-        from distributed_ghs_implementation_tpu.batch import warmup as warmup_mod
+    from distributed_ghs_implementation_tpu.batch.warmup import plan_from_flags
 
-        plans = []
-        if args.warmup_buckets:
-            plans.append(
-                warmup_mod.WarmupPlan(
-                    buckets=tuple(
-                        warmup_mod.parse_bucket_list(args.warmup_buckets)
-                    ),
-                    lanes=args.batch_lanes,
-                )
-            )
-        if args.warmup_replay:
-            plans.append(warmup_mod.load_bucket_record(args.warmup_replay))
-        warmup_plan = warmup_mod.merge_plans(*plans)
+    warmup_plan = plan_from_flags(
+        buckets=args.warmup_buckets,
+        replay=args.warmup_replay,
+        lanes=args.batch_lanes,
+    )
 
     service = MSTService(
         backend=args.backend,
@@ -609,6 +645,27 @@ def build_parser() -> argparse.ArgumentParser:
     )
     srv.add_argument("--input",
                      help="read JSONL requests from this file instead of stdin")
+    srv.add_argument(
+        "--fleet", type=int, default=0, metavar="N",
+        help="serve through N digest-routed worker processes with "
+        "health-checked failover and graceful drain (0 = single-process; "
+        "docs/FLEET.md)",
+    )
+    srv.add_argument(
+        "--fleet-queue-depth", type=int, default=64,
+        help="with --fleet: per-worker in-flight bound (full queues shed "
+        "--fleet-shed classes, backpressure everything else)",
+    )
+    srv.add_argument(
+        "--fleet-shed",
+        help="with --fleet: comma-separated slo_class labels that may be "
+        "shed when a worker queue is full (default: none — block instead)",
+    )
+    srv.add_argument(
+        "--fleet-obs-dir",
+        help="with --fleet: each worker exports its obs event JSONL here "
+        "on drain (worker<K>.<incarnation>.jsonl)",
+    )
     srv.set_defaults(fn=_cmd_serve)
 
     b = sub.add_parser("bench", help="run the benchmark (see bench.py)")

@@ -1,0 +1,18 @@
+"""Horizontal serving fleet: digest-routed worker processes with failover.
+
+The single-process serving stack (``serve/`` + ``batch/``) caps out at one
+Python process and loses every in-flight query when it crashes. ``fleet/``
+lifts it horizontal: N worker subprocesses (``fleet/worker.py``), each a
+full :class:`serve.service.MSTService`, behind a consistent-hash router
+(``fleet/router.py``) with health-checked failover, re-queue of accepted
+requests, restart-with-backoff, admission control, and graceful drain.
+``docs/FLEET.md`` covers topology, failure modes, and drill recipes.
+"""
+
+from distributed_ghs_implementation_tpu.fleet.hashing import HashRing
+from distributed_ghs_implementation_tpu.fleet.router import (
+    FleetConfig,
+    FleetRouter,
+)
+
+__all__ = ["FleetConfig", "FleetRouter", "HashRing"]

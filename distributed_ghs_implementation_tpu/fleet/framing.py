@@ -1,0 +1,58 @@
+"""Length-prefixed JSON framing for the router <-> worker pipes.
+
+The single-process service speaks newline-delimited JSON (one request per
+line, ``serve/service.py``); the fleet cannot: a worker's stdout carries
+*interleaved* responses written by concurrent request threads, and a torn
+line would silently merge two frames. Each frame is therefore::
+
+    <payload-byte-length>\\n<payload>\\n
+
+— the reader knows exactly how many bytes belong to the frame before it
+parses a single one, a short read is detected (not mis-parsed), and the
+trailing newline keeps frames greppable in a captured pipe dump.
+
+Framing errors are indistinguishable from a dead peer by design:
+:func:`read_frame` returns ``None`` on EOF *and* on a torn frame, because
+both mean the same thing to the router — this worker's pipe can no longer
+be trusted, fail over. Writes must be serialized by the caller (the router
+holds a per-worker lock; the worker holds one stdout lock across its
+request threads).
+"""
+
+from __future__ import annotations
+
+import json
+from typing import IO, Optional
+
+#: A frame larger than this is a protocol violation (a runaway edges_out
+#: response, or garbage on the pipe) — refuse to buffer it.
+MAX_FRAME_BYTES = 256 * 1024 * 1024
+
+
+def write_frame(stream: IO[bytes], obj: dict) -> None:
+    """Serialize ``obj`` as one length-prefixed frame and flush."""
+    payload = json.dumps(obj, separators=(",", ":")).encode("utf-8")
+    stream.write(b"%d\n" % len(payload) + payload + b"\n")
+    stream.flush()
+
+
+def read_frame(stream: IO[bytes]) -> Optional[dict]:
+    """Read one frame; ``None`` on EOF or any torn/garbled frame."""
+    header = stream.readline()
+    if not header:
+        return None
+    try:
+        n = int(header)
+    except ValueError:
+        return None
+    if n < 0 or n > MAX_FRAME_BYTES:
+        return None
+    payload = stream.read(n)
+    if payload is None or len(payload) != n:
+        return None
+    stream.read(1)  # the trailing newline (EOF here still parsed a frame)
+    try:
+        obj = json.loads(payload)
+    except ValueError:
+        return None
+    return obj if isinstance(obj, dict) else None

@@ -1,0 +1,690 @@
+"""Fleet front-end: digest-routed dispatch over N worker processes.
+
+The router is the serving tier's availability layer. It owns no solver —
+every query is forwarded over a framed pipe to one of N worker processes
+(``fleet/worker.py``), each a full single-process serving stack. What the
+router adds is exactly what one process cannot have:
+
+* **Cache-affine routing** — ``Graph.digest()`` consistent-hashes onto the
+  ring (``fleet/hashing.py``), so repeats of a graph land on the worker
+  whose result cache, update sessions, and compiled buckets are already
+  warm, and worker death moves only the dead worker's keyspace share.
+  Updates re-key content-addressed, so the router pins each *session
+  digest* to the worker holding the materialized session and follows the
+  chain as responses rename it.
+* **Admission control** — per-worker bounded in-flight queues
+  (``queue_depth``). A full queue sheds requests whose ``slo_class`` is in
+  ``shed_classes`` (``{"ok": false, "shed": true}``, counted
+  ``fleet.shed``); every other class blocks — backpressure, not loss.
+* **Health-checked failover** — a heartbeat thread pings every worker; a
+  worker that misses ``heartbeat_miss_threshold`` intervals, or whose pipe
+  reaches EOF, is declared dead. Its accepted-but-unanswered requests are
+  **re-queued** onto surviving workers by the same digest key
+  (``fleet.requeue``) — idempotent, because results are content-addressed
+  and every worker computes the identical forest. The dead worker restarts
+  with capped exponential backoff and rejoins the ring when it reports
+  ready.
+* **Graceful drain** — :meth:`FleetRouter.shutdown` stops admitting, sends
+  every worker a drain frame, and waits for in-flight responses to flush
+  before the processes exit 0.
+
+Telemetry (router-process bus): ``fleet.request`` spans carry ``cls`` /
+``worker`` / ``ok`` — ``obs.slo`` joins them into per-class AND per-worker
+SLO breakdowns — plus ``fleet.dispatch`` / ``fleet.requeue`` /
+``fleet.shed`` / ``fleet.worker.dead`` / ``fleet.worker.restart`` /
+``fleet.heartbeat.miss`` counters. See ``docs/FLEET.md``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import subprocess
+import sys
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+from distributed_ghs_implementation_tpu.fleet.framing import (
+    read_frame,
+    write_frame,
+)
+from distributed_ghs_implementation_tpu.fleet.hashing import HashRing
+from distributed_ghs_implementation_tpu.obs.events import BUS
+from distributed_ghs_implementation_tpu.obs.slo import sanitize_class
+
+_SESSION_MAP_CAP = 4096  # digest -> worker pins retained (LRU)
+
+
+@dataclasses.dataclass(frozen=True)
+class FleetConfig:
+    """Fleet topology + failover policy (defaults suit tests/drills; the
+    ``serve --fleet`` CLI maps its flags onto this)."""
+
+    workers: int = 2
+    backend: str = "device"
+    batch_lanes: int = 0
+    batch_wait_s: Optional[float] = None
+    store_capacity: int = 128
+    disk_dir: Optional[str] = None  # SHARED persistent store (flock'd writes)
+    max_concurrent: int = 2
+    max_sessions: int = 32
+    resolve_threshold: Optional[int] = None
+    worker_threads: int = 4
+    warmup_buckets: Optional[str] = None
+    warmup_replay: Optional[str] = None
+    compile_cache_dir: Optional[str] = None
+    no_compile_cache: bool = False
+    queue_depth: int = 64
+    shed_classes: Tuple[str, ...] = ()
+    # A dead process is caught instantly by pipe EOF; heartbeats exist for
+    # WEDGED processes, so the threshold errs generous — a false-positive
+    # kill under load-spike GIL starvation costs more than slow detection.
+    heartbeat_interval_s: float = 0.25
+    heartbeat_miss_threshold: int = 20
+    restart_backoff_base_s: float = 0.05
+    restart_backoff_cap_s: float = 2.0
+    max_restarts: int = 8  # per worker slot, cumulative
+    request_timeout_s: float = 300.0
+    ready_timeout_s: float = 120.0
+    ring_replicas: int = 64
+    obs_dir: Optional[str] = None  # per-worker JSONL exports on drain
+    test_echo: bool = False  # spawn jax-free echo workers (tests)
+    worker_env: Optional[Dict[int, Dict[str, str]]] = None  # incarnation 0 only
+
+
+class _Pending:
+    """One accepted request: survives its worker by being re-dispatched."""
+
+    __slots__ = ("request", "key", "cls", "event", "response", "worker_id",
+                 "requeues")
+
+    def __init__(self, request: dict, key: Optional[str], cls: Optional[str]):
+        self.request = request
+        self.key = key
+        self.cls = cls
+        self.event = threading.Event()
+        self.response: Optional[dict] = None
+        self.worker_id: Optional[int] = None
+        self.requeues = 0
+
+
+class _Worker:
+    """One worker slot: a stable ring identity across process incarnations."""
+
+    def __init__(self, worker_id: int, queue_depth: int):
+        self.id = worker_id
+        self.lock = threading.Lock()  # pipe writes + pending map
+        self.proc: Optional[subprocess.Popen] = None
+        self.alive = False
+        self.ready = threading.Event()
+        self.incarnation = -1
+        self.pending: Dict[int, _Pending] = {}
+        self.slots = threading.BoundedSemaphore(queue_depth)
+        self.last_pong = 0.0
+        self.restarts = 0
+
+
+class FleetRouter:
+    """Digest-routed, health-checked front end over worker subprocesses.
+
+    :meth:`handle` is request/response-compatible with
+    :class:`serve.service.MSTService.handle`, so ``serve_loop``, the load
+    drill, and tests drive either interchangeably.
+    """
+
+    def __init__(self, config: Optional[FleetConfig] = None):
+        self.config = config or FleetConfig()
+        if self.config.workers < 1:
+            raise ValueError(
+                f"workers must be >= 1, got {self.config.workers}"
+            )
+        self._workers = [
+            _Worker(i, self.config.queue_depth)
+            for i in range(self.config.workers)
+        ]
+        self._ring = HashRing(replicas=self.config.ring_replicas)
+        self._ring_lock = threading.Lock()
+        self._sessions: Dict[str, int] = {}  # update-session digest -> worker
+        self._next_id = 0
+        self._id_lock = threading.Lock()
+        self._rr = 0  # round-robin cursor for keyless ops
+        self._closed = False
+        self._started = False
+        self._heartbeat: Optional[threading.Thread] = None
+
+    # -- lifecycle -----------------------------------------------------
+    def start(self) -> "FleetRouter":
+        if self._started:
+            return self
+        self._started = True
+        for w in self._workers:
+            self._spawn(w)
+        deadline = time.monotonic() + self.config.ready_timeout_s
+        for w in self._workers:
+            if not w.ready.wait(max(0.0, deadline - time.monotonic())):
+                self.shutdown(drain=False)
+                raise TimeoutError(
+                    f"worker {w.id} not ready within "
+                    f"{self.config.ready_timeout_s}s"
+                )
+        now = time.monotonic()
+        with self._ring_lock:
+            for w in self._workers:
+                w.alive = True
+                w.last_pong = now
+                self._ring.add(w.id)
+        self._heartbeat = threading.Thread(
+            target=self._heartbeat_loop, name="fleet-heartbeat", daemon=True
+        )
+        self._heartbeat.start()
+        return self
+
+    def __enter__(self) -> "FleetRouter":
+        return self.start()
+
+    def __exit__(self, *exc) -> bool:
+        self.shutdown()
+        return False
+
+    def shutdown(self, *, drain: bool = True, timeout_s: float = 30.0) -> None:
+        """Stop admitting, drain every worker, reap the processes.
+
+        ``drain=True`` sends the drain frame and waits: in-flight requests
+        finish and flush before the workers exit 0. ``drain=False`` kills.
+        """
+        self._closed = True
+        if self._heartbeat is not None:
+            self._heartbeat.join(timeout=2.0)
+        for w in self._workers:
+            with w.lock:
+                proc = w.proc
+                if proc is None or proc.poll() is not None:
+                    continue
+                if drain:
+                    try:
+                        write_frame(proc.stdin, {"drain": True})
+                        proc.stdin.close()
+                    except OSError:
+                        pass
+                else:
+                    proc.kill()
+        deadline = time.monotonic() + timeout_s
+        for w in self._workers:
+            proc = w.proc
+            if proc is None:
+                continue
+            try:
+                proc.wait(timeout=max(0.1, deadline - time.monotonic()))
+            except subprocess.TimeoutExpired:
+                proc.kill()
+                proc.wait(timeout=5.0)
+
+    # -- spawning ------------------------------------------------------
+    def _worker_argv(self, w: _Worker) -> List[str]:
+        cfg = self.config
+        argv = [
+            sys.executable, "-m",
+            "distributed_ghs_implementation_tpu.fleet.worker",
+            "--worker-id", str(w.id),
+            "--backend", cfg.backend,
+            "--batch-lanes", str(cfg.batch_lanes),
+            "--store-capacity", str(cfg.store_capacity),
+            "--max-concurrent", str(cfg.max_concurrent),
+            "--max-sessions", str(cfg.max_sessions),
+            "--threads", str(cfg.worker_threads),
+        ]
+        if cfg.batch_wait_s is not None:
+            argv += ["--batch-wait", str(cfg.batch_wait_s)]
+        if cfg.disk_dir:
+            argv += ["--disk-cache", cfg.disk_dir]
+        if cfg.resolve_threshold is not None:
+            argv += ["--resolve-threshold", str(cfg.resolve_threshold)]
+        if cfg.warmup_buckets:
+            argv += ["--warmup-buckets", cfg.warmup_buckets]
+        if cfg.warmup_replay:
+            argv += ["--warmup-replay", cfg.warmup_replay]
+        if cfg.compile_cache_dir:
+            argv += ["--compile-cache-dir", cfg.compile_cache_dir]
+        if cfg.no_compile_cache:
+            argv += ["--no-compile-cache"]
+        if cfg.obs_dir:
+            os.makedirs(cfg.obs_dir, exist_ok=True)
+            argv += ["--obs-jsonl", os.path.join(
+                cfg.obs_dir, f"worker{w.id}.{w.incarnation + 1}.jsonl"
+            )]
+        if cfg.test_echo:
+            argv += ["--test-echo"]
+        return argv
+
+    def _spawn(self, w: _Worker) -> None:
+        env = dict(os.environ)
+        # The worker runs `-m distributed_ghs_implementation_tpu.fleet.worker`;
+        # make the package importable no matter the caller's cwd.
+        pkg_parent = os.path.dirname(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__)
+        )))
+        env["PYTHONPATH"] = pkg_parent + os.pathsep + env.get("PYTHONPATH", "")
+        extra = (self.config.worker_env or {}).get(w.id)
+        if extra and w.incarnation < 0:
+            # Incarnation 0 only: a crash-fault env inherited by restarts
+            # would kill every incarnation and the fleet could never heal.
+            env.update(extra)
+        argv = self._worker_argv(w)
+        with w.lock:
+            w.incarnation += 1
+            incarnation = w.incarnation
+            w.ready.clear()
+            w.slots = threading.BoundedSemaphore(self.config.queue_depth)
+            w.proc = subprocess.Popen(
+                argv, stdin=subprocess.PIPE, stdout=subprocess.PIPE, env=env
+            )
+        threading.Thread(
+            target=self._reader,
+            args=(w, incarnation, w.proc.stdout),
+            name=f"fleet-reader-{w.id}.{incarnation}",
+            daemon=True,
+        ).start()
+
+    # -- the pipe reader (one per incarnation) -------------------------
+    def _reader(self, w: _Worker, incarnation: int, stdout) -> None:
+        while True:
+            try:
+                frame = read_frame(stdout)
+            except (OSError, ValueError):
+                frame = None
+            if frame is None:
+                break
+            if "ready" in frame:
+                w.last_pong = time.monotonic()
+                w.ready.set()
+                continue
+            if "pong" in frame:
+                w.last_pong = time.monotonic()
+                continue
+            if "bye" in frame:
+                continue
+            rid = frame.get("id")
+            resp = frame.get("resp")
+            if rid is None or not isinstance(resp, dict):
+                continue
+            with w.lock:
+                pending = w.pending.pop(rid, None)
+            if pending is None:
+                # A response for a request we already re-queued elsewhere
+                # (the worker was declared dead but limped on). Results are
+                # content-addressed, so the duplicate is discardable.
+                BUS.count("fleet.duplicate.response")
+                continue
+            self._release_slot(w)
+            if resp.get("ok") and resp.get("op") == "update":
+                self._note_session(
+                    resp.get("digest"), w.id, prev=resp.get("prev_digest")
+                )
+            pending.response = resp
+            pending.worker_id = w.id
+            pending.event.set()
+        self._on_death(w, incarnation)
+
+    @staticmethod
+    def _release_slot(w: _Worker) -> None:
+        try:
+            w.slots.release()
+        except ValueError:
+            pass  # slot already reclaimed by a respawn's fresh semaphore
+
+    def _note_session(
+        self, digest: Optional[str], worker_id: int, prev: Optional[str]
+    ) -> None:
+        if not digest:
+            return
+        with self._ring_lock:
+            if prev:
+                self._sessions.pop(prev, None)
+            self._sessions[digest] = worker_id
+            while len(self._sessions) > _SESSION_MAP_CAP:
+                self._sessions.pop(next(iter(self._sessions)))
+
+    # -- health --------------------------------------------------------
+    def _heartbeat_loop(self) -> None:
+        cfg = self.config
+        seq = 0
+        while not self._closed:
+            time.sleep(cfg.heartbeat_interval_s)
+            for w in self._workers:
+                if self._closed:
+                    return
+                if not (w.alive and w.ready.is_set()):
+                    continue
+                age = time.monotonic() - w.last_pong
+                if age > cfg.heartbeat_interval_s * cfg.heartbeat_miss_threshold:
+                    BUS.count("fleet.heartbeat.miss")
+                    self._on_death(w, w.incarnation)
+                    continue
+                seq += 1
+                try:
+                    with w.lock:
+                        if w.proc is not None and w.proc.stdin:
+                            write_frame(w.proc.stdin, {"ping": seq})
+                except OSError:
+                    self._on_death(w, w.incarnation)
+
+    def _on_death(self, w: _Worker, incarnation: int) -> None:
+        """Declare one incarnation dead exactly once: fail over its pending
+        requests, drop its ring share + session pins, schedule a restart."""
+        with self._ring_lock:
+            if w.incarnation != incarnation or not w.alive:
+                return
+            w.alive = False
+            w.ready.clear()
+            self._ring.remove(w.id)
+            for digest in [
+                d for d, wid in self._sessions.items() if wid == w.id
+            ]:
+                del self._sessions[digest]
+        with w.lock:
+            orphans = list(w.pending.values())
+            w.pending.clear()
+            proc = w.proc
+        if not self._closed:  # drained workers EOF on purpose: not a death
+            BUS.count("fleet.worker.dead")
+            BUS.instant("fleet.worker.death", cat="fleet", worker=w.id,
+                        incarnation=incarnation, orphans=len(orphans))
+        if proc is not None and proc.poll() is None:
+            try:
+                proc.kill()
+            except OSError:
+                pass
+        if orphans and not self._closed:
+            threading.Thread(
+                target=self._redispatch, args=(orphans,),
+                name=f"fleet-requeue-{w.id}", daemon=True,
+            ).start()
+        elif orphans:
+            for p in orphans:  # shutting down: answer rather than hang
+                p.response = {
+                    "ok": False, "error": "fleet shutting down",
+                    "op": p.request.get("op"),
+                }
+                p.event.set()
+        if not self._closed:
+            threading.Thread(
+                target=self._restart, args=(w,),
+                name=f"fleet-restart-{w.id}", daemon=True,
+            ).start()
+
+    def _redispatch(self, orphans: List[_Pending]) -> None:
+        for p in orphans:
+            p.requeues += 1
+            BUS.count("fleet.requeue")
+            err = self._dispatch(p, allow_shed=False)
+            if err is not None:
+                p.response = err
+                p.event.set()
+
+    def _restart(self, w: _Worker) -> None:
+        cfg = self.config
+        while not self._closed:
+            if w.restarts >= cfg.max_restarts:
+                BUS.count("fleet.worker.abandoned")
+                return
+            backoff = min(
+                cfg.restart_backoff_base_s * (2 ** w.restarts),
+                cfg.restart_backoff_cap_s,
+            )
+            w.restarts += 1
+            time.sleep(backoff)
+            if self._closed:
+                return
+            try:
+                self._spawn(w)
+            except OSError:
+                continue
+            if w.ready.wait(cfg.ready_timeout_s):
+                with self._ring_lock:
+                    w.alive = True
+                    w.last_pong = time.monotonic()
+                    self._ring.add(w.id)
+                BUS.count("fleet.worker.restart")
+                BUS.instant("fleet.worker.rejoin", cat="fleet", worker=w.id,
+                            incarnation=w.incarnation, backoff_s=backoff)
+                return
+            with w.lock:
+                if w.proc is not None and w.proc.poll() is None:
+                    w.proc.kill()
+
+    # -- routing + dispatch --------------------------------------------
+    def _routing_key(self, request: dict) -> Optional[str]:
+        op = request.get("op")
+        if op == "update":
+            return request.get("digest")
+        if op == "solve":
+            if "digest" in request:
+                return str(request["digest"])  # client-side hint
+            if "graph_path" in request:
+                return str(request["graph_path"])  # stable path identity
+            if "edges" in request:
+                from distributed_ghs_implementation_tpu.graphs.edgelist import (
+                    Graph,
+                )
+
+                return Graph.from_edges(
+                    int(request["num_nodes"]), request["edges"]
+                ).digest()
+        return None
+
+    def _route(self, key: Optional[str]) -> Optional[_Worker]:
+        with self._ring_lock:
+            if key is not None:
+                wid = self._sessions.get(key)
+                if wid is not None and self._workers[wid].alive:
+                    return self._workers[wid]
+                try:
+                    return self._workers[self._ring.assign(key)]
+                except LookupError:
+                    return None
+            live = [w for w in self._workers if w.alive and w.ready.is_set()]
+            if not live:
+                return None
+            self._rr += 1
+            return live[self._rr % len(live)]
+
+    def _dispatch(
+        self, p: _Pending, *, allow_shed: bool = True
+    ) -> Optional[dict]:
+        """Queue ``p`` on the worker owning its key. Returns ``None`` once
+        accepted (a response will land on ``p.event``) or a terminal
+        error/shed response dict."""
+        cfg = self.config
+        deadline = time.monotonic() + cfg.request_timeout_s
+        while True:
+            if self._closed:
+                return {"ok": False, "op": p.request.get("op"),
+                        "error": "fleet shutting down"}
+            w = self._route(p.key)
+            if w is None:
+                if time.monotonic() >= deadline:
+                    BUS.count("fleet.unroutable")
+                    return {"ok": False, "op": p.request.get("op"),
+                            "error": "no live workers"}
+                time.sleep(0.02)  # workers restarting; the ring will refill
+                continue
+            incarnation = w.incarnation
+            if not w.slots.acquire(blocking=False):
+                if allow_shed and p.cls in cfg.shed_classes:
+                    BUS.count("fleet.shed")
+                    return {"ok": False, "op": p.request.get("op"),
+                            "shed": True, "worker": w.id,
+                            "error": f"shed: worker {w.id} queue full"}
+                # Backpressure: wait briefly, then re-check liveness (a
+                # worker dying with a full queue must not wedge us here).
+                if not w.slots.acquire(timeout=0.05):
+                    if time.monotonic() >= deadline:
+                        return {"ok": False, "op": p.request.get("op"),
+                                "error": f"admission timeout on worker {w.id}"}
+                    continue
+            rid = None
+            try:
+                with w.lock:
+                    if not w.alive or w.incarnation != incarnation:
+                        raise OSError("worker died during dispatch")
+                    with self._id_lock:
+                        self._next_id += 1
+                        rid = self._next_id
+                    w.pending[rid] = p
+                    write_frame(w.proc.stdin, {"id": rid, "req": p.request})
+            except OSError:
+                if rid is not None:
+                    with w.lock:
+                        w.pending.pop(rid, None)
+                self._release_slot(w)
+                self._on_death(w, incarnation)
+                continue
+            BUS.count("fleet.dispatch")
+            BUS.sample(f"fleet.queue.depth.{w.id}", len(w.pending))
+            return None
+
+    # -- the service surface -------------------------------------------
+    def handle(self, request: dict) -> dict:
+        """One request, same contract as ``MSTService.handle``."""
+        op = request.get("op")
+        if op == "stats":
+            return self._stats()
+        if op == "shutdown":
+            return {"ok": True, "op": "shutdown"}
+        cls = sanitize_class(request.get("slo_class"))
+        span_args = {"op": str(op)}
+        if cls is not None:
+            span_args["cls"] = cls
+        with BUS.span("fleet.request", cat="fleet", **span_args) as span:
+            BUS.count("fleet.requests")
+            try:
+                key = self._routing_key(request)
+            except Exception as e:  # noqa: BLE001 — bad request, not a crash
+                BUS.count("fleet.errors")
+                return {"ok": False, "op": op,
+                        "error": f"{type(e).__name__}: {e}"}
+            p = _Pending(request, key, cls)
+            err = self._dispatch(p)
+            if err is not None:
+                span.set(ok=False, shed=bool(err.get("shed")))
+                if not err.get("shed"):
+                    BUS.count("fleet.errors")
+                if cls is not None:
+                    err.setdefault("slo_class", cls)
+                return err
+            if not p.event.wait(self.config.request_timeout_s):
+                BUS.count("fleet.timeout")
+                span.set(ok=False)
+                self._forget(p)
+                return {"ok": False, "op": op,
+                        "error": "request timed out in the fleet"}
+            response = dict(p.response)
+            span.set(ok=bool(response.get("ok")), worker=p.worker_id,
+                     requeues=p.requeues)
+            response.setdefault("worker", p.worker_id)
+            if p.requeues:
+                response.setdefault("requeued", p.requeues)
+            if cls is not None:
+                response.setdefault("slo_class", cls)
+            return response
+
+    def _forget(self, p: _Pending) -> None:
+        """Drop a timed-out pending from whichever worker holds it."""
+        for w in self._workers:
+            with w.lock:
+                stale = [rid for rid, q in w.pending.items() if q is p]
+                for rid in stale:
+                    del w.pending[rid]
+            for _ in stale:
+                self._release_slot(w)
+            if stale:
+                return
+
+    def _request_worker(
+        self, w: _Worker, request: dict, timeout_s: float = 10.0
+    ) -> Optional[dict]:
+        """A control-plane request pinned to one worker (stats fan-out)."""
+        p = _Pending(request, None, None)
+        if not w.slots.acquire(timeout=timeout_s):
+            return None
+        try:
+            with w.lock:
+                if not w.alive:
+                    self._release_slot(w)
+                    return None
+                with self._id_lock:
+                    self._next_id += 1
+                    rid = self._next_id
+                w.pending[rid] = p
+                write_frame(w.proc.stdin, {"id": rid, "req": request})
+        except OSError:
+            self._release_slot(w)
+            return None
+        if not p.event.wait(timeout_s):
+            return None
+        return p.response
+
+    def _stats(self) -> dict:
+        counters: Dict[str, float] = {}
+        workers_out = {}
+        for w in self._workers:
+            info = {
+                "alive": w.alive,
+                "incarnation": w.incarnation,
+                "restarts": w.restarts,
+                "pending": len(w.pending),
+            }
+            if w.alive and w.ready.is_set():
+                resp = self._request_worker(w, {"op": "stats"})
+                if resp and resp.get("ok"):
+                    info["stats"] = {
+                        k: v for k, v in resp.items()
+                        if k not in ("ok", "op")
+                    }
+                    for name, value in (resp.get("counters") or {}).items():
+                        counters[name] = counters.get(name, 0) + value
+            workers_out[str(w.id)] = info
+        fleet_counters = {
+            name: value for name, value in BUS.counters().items()
+            if name.startswith("fleet.")
+        }
+        return {
+            "ok": True,
+            "op": "stats",
+            "counters": counters,  # summed across live workers
+            "fleet": fleet_counters,
+            "workers": workers_out,
+            "ring": sorted(self._ring.members()),
+            "sessions": len(self._sessions),
+        }
+
+    # -- chaos/drill surface -------------------------------------------
+    def kill_worker(self, worker_id: int) -> None:
+        """SIGKILL one worker mid-traffic (drills). Failover is automatic."""
+        w = self._workers[worker_id]
+        with w.lock:
+            proc = w.proc
+        if proc is not None and proc.poll() is None:
+            proc.kill()
+        # The reader sees EOF and runs the death path; nothing else to do.
+
+    def arm_worker_fault(
+        self, worker_id: int, *, site: str = "fleet.worker.crash",
+        times: int = 1, kind: str = "raise", value: float = 0.0,
+    ) -> bool:
+        """Arm the fault registry INSIDE one worker process (kill drills:
+        ``fleet.worker.crash`` makes it die in place of its ``times``-th
+        next request — deterministic, mid-traffic, no response flushed)."""
+        w = self._workers[worker_id]
+        try:
+            with w.lock:
+                if not w.alive or w.proc is None:
+                    return False
+                write_frame(w.proc.stdin, {
+                    "arm": {"site": site, "times": times, "kind": kind,
+                            "value": value},
+                })
+            return True
+        except OSError:
+            return False

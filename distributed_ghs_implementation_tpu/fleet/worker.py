@@ -1,0 +1,242 @@
+"""Fleet worker process: one MSTService behind a framed stdin/stdout pipe.
+
+Spawned by :class:`fleet.router.FleetRouter` as
+``python -m distributed_ghs_implementation_tpu.fleet.worker --worker-id K``.
+Each worker owns a full serving stack — its own lane engine, warm-bucket
+cache, obs bus, and solve scheduler — and shares only the *persistent*
+layers with its siblings: the on-disk result store (flock-serialized
+writes, ``serve/store.py``) and the machine-fingerprinted XLA compile
+cache. Inbound frames (``fleet/framing.py``):
+
+* ``{"id": N, "req": {...}}`` — one service request; the response frame
+  ``{"id": N, "resp": {...}}`` may be written out of order (requests run on
+  a small thread pool so the batch engine can coalesce lane-mates).
+* ``{"ping": S}`` — heartbeat; answered ``{"pong": S}`` inline from the
+  read loop, so a worker busy solving still proves its process is alive
+  (busy is not dead — only a wedged or exited process misses heartbeats).
+* ``{"arm": {"site": ..., "times": T, "kind": ...}}`` — arm the in-process
+  :data:`~distributed_ghs_implementation_tpu.utils.resilience.FAULTS`
+  registry (kill drills arm ``fleet.worker.crash`` mid-traffic this way).
+* ``{"drain": true}`` (or stdin EOF, or SIGTERM) — graceful drain: stop
+  reading, finish every in-flight request, flush the responses, export the
+  obs JSONL (``--obs-jsonl``), and exit 0.
+
+The ``fleet.worker.crash`` fault site is consulted once per request,
+*before* it is handled: when the armed shot count reaches zero the process
+dies via ``os._exit`` — no response, no flushing, no atexit — which is
+exactly the crash the router's zero-lost-query re-queue path must absorb.
+``GHS_FAULT_FLEET_WORKER_CRASH=K`` in a worker's environment therefore
+means "die in place of answering the K-th request".
+"""
+
+from __future__ import annotations
+
+import argparse
+import hashlib
+import json
+import os
+import signal
+import sys
+import threading
+from concurrent.futures import ThreadPoolExecutor
+
+from distributed_ghs_implementation_tpu.fleet.framing import (
+    read_frame,
+    write_frame,
+)
+
+CRASH_SITE = "fleet.worker.crash"
+CRASH_EXIT_CODE = 17  # distinguishable from drain (0) and tracebacks (1)
+
+
+class _DrainSignal(Exception):
+    """Raised in the read loop by the SIGTERM/SIGINT handlers."""
+
+
+class EchoService:
+    """A jax-free stand-in service for fleet plumbing tests.
+
+    Answers the same ops as :class:`serve.service.MSTService` with canned
+    content: solves echo a digest derived from the request payload, updates
+    re-key it digest-chained, ``sleep_s`` simulates a slow solve. This is
+    what lets ``tests/test_fleet.py`` exercise routing, re-queue, shedding,
+    heartbeats, and drain without compiling a single kernel.
+    """
+
+    def __init__(self, worker_id: int):
+        self.worker_id = worker_id
+        self.handled = 0
+
+    def handle(self, request: dict) -> dict:
+        import time
+
+        self.handled += 1
+        op = request.get("op")
+        if request.get("sleep_s"):
+            time.sleep(float(request["sleep_s"]))
+        if op == "solve":
+            digest = request.get("digest") or hashlib.sha256(
+                json.dumps(request.get("edges", []), sort_keys=True).encode()
+            ).hexdigest()[:32]
+            return {"ok": True, "op": "solve", "digest": digest,
+                    "source": "echo", "worker": self.worker_id}
+        if op == "update":
+            digest = request.get("digest")
+            if digest is None:
+                return {"ok": False, "op": "update", "error": "no digest"}
+            new = hashlib.sha256(
+                (digest + json.dumps(request.get("updates", []))).encode()
+            ).hexdigest()[:32]
+            return {"ok": True, "op": "update", "digest": new,
+                    "prev_digest": digest, "worker": self.worker_id}
+        if op == "stats":
+            return {"ok": True, "op": "stats",
+                    "counters": {"echo.handled": self.handled},
+                    "worker": self.worker_id}
+        if op == "shutdown":
+            return {"ok": True, "op": "shutdown"}
+        return {"ok": False, "op": op, "error": f"unknown op {op!r}"}
+
+
+def _build_service(args):
+    if args.test_echo:
+        return EchoService(args.worker_id)
+    # Deferred: the echo path must never pay the jax import.
+    from distributed_ghs_implementation_tpu.batch.warmup import plan_from_flags
+    from distributed_ghs_implementation_tpu.serve.service import MSTService
+    from distributed_ghs_implementation_tpu.utils.compile_cache import (
+        enable_persistent_cache,
+    )
+
+    if not args.no_compile_cache:
+        # Workers share the persistent XLA cache (machine-fingerprinted):
+        # the first worker to compile a bucket pays; its siblings and every
+        # restarted incarnation reload the executable.
+        enable_persistent_cache(args.compile_cache_dir)
+    return MSTService(
+        backend=args.backend,
+        store_capacity=args.store_capacity,
+        disk_dir=args.disk_cache,
+        max_concurrent=args.max_concurrent,
+        max_sessions=args.max_sessions,
+        resolve_threshold=args.resolve_threshold,
+        batch_lanes=args.batch_lanes,
+        batch_wait_s=args.batch_wait,
+        warmup=plan_from_flags(
+            buckets=args.warmup_buckets, replay=args.warmup_replay,
+            lanes=args.batch_lanes,
+        ),
+    )
+
+
+def run_worker(args) -> int:
+    from distributed_ghs_implementation_tpu.obs.events import BUS
+    from distributed_ghs_implementation_tpu.utils.resilience import FAULTS
+
+    BUS.enable()
+    service = _build_service(args)
+    stdin = sys.stdin.buffer
+    stdout = sys.stdout.buffer
+    out_lock = threading.Lock()
+    draining = threading.Event()
+
+    def _drain_handler(signum, frame):
+        draining.set()
+        # Requests run on the pool, so the main (read) thread is always
+        # safe to interrupt: stop admitting immediately, then flush.
+        raise _DrainSignal()
+
+    try:
+        signal.signal(signal.SIGTERM, _drain_handler)
+        signal.signal(signal.SIGINT, _drain_handler)
+    except ValueError:  # not the main thread (in-process tests)
+        pass
+
+    def _serve_one(rid: int, request: dict) -> None:
+        shot = FAULTS.pop(CRASH_SITE)
+        if shot is not None and shot.remaining == 0:
+            os._exit(CRASH_EXIT_CODE)  # a real crash: no response, no flush
+        try:
+            response = service.handle(request)
+        except Exception as e:  # noqa: BLE001 — the pipe must survive
+            response = {"ok": False, "error": f"{type(e).__name__}: {e}"}
+        with out_lock:
+            write_frame(stdout, {"id": rid, "resp": response})
+
+    pool = ThreadPoolExecutor(
+        max_workers=args.threads, thread_name_prefix=f"worker{args.worker_id}"
+    )
+    with out_lock:
+        write_frame(
+            stdout,
+            {"ready": True, "worker": args.worker_id, "pid": os.getpid()},
+        )
+    try:
+        while True:
+            frame = read_frame(stdin)
+            if frame is None or frame.get("drain"):
+                break
+            if "ping" in frame:
+                with out_lock:
+                    write_frame(stdout, {"pong": frame["ping"]})
+                continue
+            if "arm" in frame:
+                arm = frame["arm"]
+                FAULTS.arm(
+                    arm.get("site", CRASH_SITE),
+                    times=int(arm.get("times", 1)),
+                    kind=arm.get("kind", "raise"),
+                    value=float(arm.get("value", 0.0)),
+                )
+                continue
+            if "req" in frame:
+                pool.submit(_serve_one, frame["id"], frame["req"])
+    except _DrainSignal:
+        pass
+    # Drain: everything admitted gets its response flushed before exit 0.
+    pool.shutdown(wait=True)
+    with out_lock:
+        try:
+            write_frame(stdout, {"bye": True, "worker": args.worker_id})
+        except OSError:
+            pass  # router already gone; the drain still completed
+    if args.obs_jsonl:
+        from distributed_ghs_implementation_tpu.obs.export import (
+            write_events_jsonl,
+        )
+
+        write_events_jsonl(BUS, args.obs_jsonl)
+    return 0
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(prog="fleet.worker", description=__doc__)
+    p.add_argument("--worker-id", type=int, required=True)
+    p.add_argument("--backend", default="device")
+    p.add_argument("--batch-lanes", type=int, default=0)
+    p.add_argument("--batch-wait", type=float, default=None)
+    p.add_argument("--store-capacity", type=int, default=128)
+    p.add_argument("--disk-cache", default=None,
+                   help="shared persistent result store directory")
+    p.add_argument("--max-concurrent", type=int, default=2)
+    p.add_argument("--max-sessions", type=int, default=32)
+    p.add_argument("--resolve-threshold", type=int, default=None)
+    p.add_argument("--warmup-replay", default=None)
+    p.add_argument("--threads", type=int, default=4,
+                   help="request threads (lets the batch engine coalesce)")
+    p.add_argument("--warmup-buckets", default=None)
+    p.add_argument("--compile-cache-dir", default=None)
+    p.add_argument("--no-compile-cache", action="store_true")
+    p.add_argument("--obs-jsonl", default=None,
+                   help="export this worker's bus events here on drain")
+    p.add_argument("--test-echo", action="store_true",
+                   help="jax-free canned service (fleet plumbing tests)")
+    return p
+
+
+def main(argv=None) -> int:
+    return run_worker(build_parser().parse_args(argv))
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -64,6 +64,18 @@ def current_class() -> Optional[str]:
     return _current_class.get()
 
 
+def sanitize_class(cls) -> Optional[str]:
+    """Normalize an untrusted ``slo_class`` label to a short, dotted-name-
+    safe token (it is interpolated into bus histogram names downstream).
+    The ONE sanitizer both the single-process service and the fleet router
+    apply, so a class gated in one mode reports identically in the other."""
+    if cls is None:
+        return None
+    return "".join(
+        ch if ch.isalnum() or ch in "_-" else "_" for ch in str(cls)
+    )[:32] or "untagged"
+
+
 @contextlib.contextmanager
 def tagged_class(cls: Optional[str]):
     """Scope the current thread of work to query class ``cls``.
@@ -94,6 +106,9 @@ class ClassStats:
     def __init__(self):
         self._classes: Dict[str, dict] = {}
         self._total_latency = _Hist()
+        # worker id -> per-class accumulator (fleet mode: the router stamps
+        # ``worker`` on fleet.request spans; empty in single-process runs).
+        self._workers: Dict[str, "ClassStats"] = {}
 
     # -- recording -----------------------------------------------------
     def _entry(self, cls: str) -> dict:
@@ -139,6 +154,23 @@ class ClassStats:
     def observe_queue_wait(self, cls: str, dur_s: float) -> None:
         self._entry(cls)["queue_wait"].add(float(dur_s))
 
+    def observe_worker(
+        self,
+        worker: str,
+        cls: str,
+        latency_s: Optional[float] = None,
+        *,
+        ok: bool = True,
+        shed: bool = False,
+    ) -> None:
+        """The same observation, attributed to one fleet worker — the
+        per-worker SLO breakdown a kill drill reads to show the degraded
+        worker's latency apart from its healthy siblings'."""
+        sub = self._workers.get(worker)
+        if sub is None:
+            sub = self._workers[worker] = ClassStats()
+        sub.observe(cls, latency_s, ok=ok, shed=shed)
+
     # -- reading -------------------------------------------------------
     def classes(self):
         return sorted(self._classes)
@@ -159,6 +191,19 @@ class ClassStats:
             if entry[field].count:
                 out[key] = entry[field].summary()
         return out
+
+    def workers_summary(self, wall_s: Optional[float]) -> Dict[str, dict]:
+        """Per-worker per-class summaries (empty unless fleet spans fed in)."""
+        return {
+            worker: {
+                "classes": {
+                    cls: sub.class_summary(cls, wall_s)
+                    for cls in sub.classes()
+                },
+                "totals": sub.totals(wall_s),
+            }
+            for worker, sub in sorted(self._workers.items())
+        }
 
     def totals(self, wall_s: Optional[float]) -> dict:
         sent = sum(e["sent"] for e in self._classes.values())
@@ -182,19 +227,23 @@ def _ingest(
     """One event into the accumulator. The join key is the ``cls`` span
     argument the service stamps on ``serve.request`` (outcome + end-to-end
     latency) and the scheduler propagates onto ``serve.solve`` (the
-    miss-path solve/queue time nested inside that request)."""
+    miss-path solve/queue time nested inside that request). In fleet mode
+    the router's ``fleet.request`` span plays the serve.request role — its
+    latency additionally includes routing, queueing, pipe transport, and
+    any failover re-queue — and its ``worker`` argument feeds the
+    per-worker breakdown."""
     if ph != PH_COMPLETE or not args:
         return
     cls = args.get("cls")
     if cls is None:
         return
-    if name == "serve.request":
-        stats.observe(
-            str(cls),
-            dur_s,
-            ok=bool(args.get("ok", True)),
-            shed=bool(args.get("shed", False)),
-        )
+    if name in ("serve.request", "fleet.request"):
+        ok = bool(args.get("ok", True))
+        shed = bool(args.get("shed", False))
+        stats.observe(str(cls), dur_s, ok=ok, shed=shed)
+        worker = args.get("worker")
+        if name == "fleet.request" and worker is not None:
+            stats.observe_worker(str(worker), str(cls), dur_s, ok=ok, shed=shed)
     elif name == "serve.solve":
         stats.observe_solve(str(cls), dur_s)
 
@@ -244,6 +293,9 @@ def assemble(
         "classes": classes,
         "totals": stats.totals(wall_s),
     }
+    workers = stats.workers_summary(wall_s)
+    if workers:
+        out["workers"] = workers
     if lines_skipped:
         out["lines_skipped"] = lines_skipped
     return out

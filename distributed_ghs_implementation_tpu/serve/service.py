@@ -26,6 +26,7 @@ from __future__ import annotations
 import collections
 import dataclasses
 import json
+import threading
 from typing import IO, Optional
 
 from distributed_ghs_implementation_tpu.api import MSTResult
@@ -35,7 +36,10 @@ from distributed_ghs_implementation_tpu.batch.warmup import (
 )
 from distributed_ghs_implementation_tpu.graphs.edgelist import Graph
 from distributed_ghs_implementation_tpu.obs.events import BUS
-from distributed_ghs_implementation_tpu.obs.slo import tagged_class
+from distributed_ghs_implementation_tpu.obs.slo import (
+    sanitize_class,
+    tagged_class,
+)
 from distributed_ghs_implementation_tpu.serve.dynamic import DynamicMST
 from distributed_ghs_implementation_tpu.serve.scheduler import SolveScheduler
 from distributed_ghs_implementation_tpu.serve.store import (
@@ -139,15 +143,9 @@ class MSTService:
         # tagged_class context, so nested layers (scheduler serve.solve
         # spans, the batch engine's queue-wait histograms) attribute their
         # telemetry to the same class without any API threading.
-        cls = request.get("slo_class")
+        cls = sanitize_class(request.get("slo_class"))
         span_args = {"op": str(op)}
         if cls is not None:
-            # Sanitize: the label comes from untrusted request JSON and is
-            # interpolated into bus histogram names downstream — keep it a
-            # short, dotted-identifier-safe token.
-            cls = "".join(
-                ch if ch.isalnum() or ch in "_-" else "_" for ch in str(cls)
-            )[:32] or "untagged"
             span_args["cls"] = cls
         with tagged_class(cls), BUS.span(
             "serve.request", cat="serve", **span_args
@@ -319,26 +317,77 @@ class MSTService:
         return out
 
 
+class _DrainSignal(Exception):
+    """Raised by the SIGTERM/SIGINT handlers while the loop is idle."""
+
+
 def serve_loop(
-    in_stream: IO[str], out_stream: IO[str], service: Optional[MSTService] = None
+    in_stream: IO[str], out_stream: IO[str], service=None
 ) -> int:
     """Drain JSONL requests from ``in_stream`` until EOF or ``shutdown``;
-    one flushed JSON response line each. Returns a process exit code."""
+    one flushed JSON response line each. Returns a process exit code.
+
+    ``service`` is anything with an ``MSTService``-shaped ``handle`` (the
+    fleet router qualifies); ``None`` builds a default :class:`MSTService`.
+
+    **Graceful shutdown**: SIGTERM/SIGINT drain instead of killing the
+    process mid-line. A signal arriving while a request is being handled
+    lets the solve finish and its response flush, THEN ends the loop; a
+    signal arriving while blocked on input ends the loop immediately. An
+    accepted request therefore always gets its response — previously a
+    mid-solve SIGINT tore the loop between accept and respond, which is
+    exactly the lost-query shape the fleet drills hunt. Handlers install
+    only on the main thread (threaded callers keep their own handling) and
+    the previous handlers are restored on exit.
+    """
+    import signal
+
     service = service or MSTService()
-    with BUS.span("serve.session", cat="serve"):
-        for line in in_stream:
-            line = line.strip()
-            if not line:
-                continue
+    draining = threading.Event()
+    in_request = [False]
+
+    def _drain_handler(signum, frame):
+        draining.set()
+        if not in_request[0]:
+            # Idle (blocked reading): nothing in flight to protect.
+            raise _DrainSignal()
+
+    previous = {}
+    try:
+        for sig in (signal.SIGTERM, signal.SIGINT):
+            previous[sig] = signal.signal(sig, _drain_handler)
+    except ValueError:
+        previous = {}  # not the main thread: run without drain handlers
+    try:
+        with BUS.span("serve.session", cat="serve"):
             try:
-                request = json.loads(line)
-            except json.JSONDecodeError as e:
-                BUS.count("serve.errors")
-                response = {"ok": False, "error": f"bad JSON: {e}"}
-            else:
-                response = service.handle(request)
-            out_stream.write(json.dumps(response) + "\n")
-            out_stream.flush()
-            if response.get("op") == "shutdown" and response.get("ok"):
-                break
+                for line in in_stream:
+                    # A line read off the stream IS an accepted request:
+                    # flip the flag before touching it, so a signal landing
+                    # anywhere past the read drains-after-response instead
+                    # of dropping it.
+                    in_request[0] = True
+                    line = line.strip()
+                    if line:
+                        try:
+                            request = json.loads(line)
+                        except json.JSONDecodeError as e:
+                            BUS.count("serve.errors")
+                            response = {"ok": False, "error": f"bad JSON: {e}"}
+                        else:
+                            response = service.handle(request)
+                        out_stream.write(json.dumps(response) + "\n")
+                        out_stream.flush()
+                    else:
+                        response = {}
+                    in_request[0] = False
+                    if draining.is_set():
+                        break
+                    if response.get("op") == "shutdown" and response.get("ok"):
+                        break
+            except _DrainSignal:
+                pass  # caught while idle: responses are already flushed
+    finally:
+        for sig, handler in previous.items():
+            signal.signal(sig, handler)
     return 0

@@ -17,7 +17,9 @@ Two layers:
   ``.bak``-generation write path checkpoints use, so a crash mid-write never
   leaves a poisoned cache entry (the ``serve.store.save`` fault site tears
   writes in chaos drills). Disk hits are re-validated against the graph's
-  digest before they are served and promoted into memory.
+  digest before they are served and promoted into memory. Writes take an
+  advisory per-key ``flock`` (:func:`_flocked`) so fleet workers sharing one
+  ``disk_dir`` cannot interleave a publish; reads stay lock-free.
 
 Telemetry (``obs`` bus): ``serve.store.hit`` / ``.miss`` / ``.disk_hit`` /
 ``.put`` / ``.evict`` counters; all methods are thread-safe (the scheduler
@@ -27,11 +29,18 @@ calls in from concurrent request threads).
 from __future__ import annotations
 
 import collections
+import contextlib
 import os
 import threading
+import time
 from typing import Optional
 
 import numpy as np
+
+try:  # advisory write locking (fleet workers share one disk_dir)
+    import fcntl
+except ImportError:  # pragma: no cover — non-POSIX: single-writer only
+    fcntl = None
 
 from distributed_ghs_implementation_tpu.api import MSTResult
 from distributed_ghs_implementation_tpu.graphs.edgelist import Graph
@@ -51,6 +60,65 @@ def solve_cache_key(graph: Graph, *, backend: str = "device") -> str:
 
 def _disk_path(disk_dir: str, key: str) -> str:
     return os.path.join(disk_dir, key.replace(":", "_") + ".npz")
+
+
+#: How long a writer waits for a contended per-key lock before giving up
+#: (the write-behind is best-effort; a timeout is a skipped cache fill,
+#: never a failed request).
+_LOCK_TIMEOUT_S = 2.0
+_LOCK_POLL_S = 0.005
+
+
+@contextlib.contextmanager
+def _flocked(path: str, timeout_s: float = _LOCK_TIMEOUT_S):
+    """Advisory per-key write lock (``<path>.lock``, ``fcntl.flock``).
+
+    Fleet workers share one ``disk_dir``; without this, two processes
+    publishing the same digest can interleave the ``.bak`` rotation inside
+    :func:`~...utils.checkpoint.atomic_write_npz` (rotate, rotate, rename,
+    rename) and momentarily leave BOTH generations holding the same bytes —
+    or rotate a half-published primary over the last good ``.bak``. The
+    lock serializes *writers only*: the read path stays lock-free (rename
+    is atomic and reads re-validate digests), so lookups never block on a
+    slow writer. Raises ``TimeoutError`` past ``timeout_s``; holding
+    processes that die release the lock automatically (flock is
+    fd-scoped, the kernel drops it on process exit).
+    """
+    if fcntl is None:
+        yield
+        return
+    # The lock file precedes the npz (the writer beneath us creates the
+    # directory lazily — the lock must not fail on a fresh disk_dir).
+    os.makedirs(os.path.dirname(os.path.abspath(path)) or ".", exist_ok=True)
+    lock_path = path + ".lock"
+    deadline = time.monotonic() + timeout_s
+    while True:
+        fd = os.open(lock_path, os.O_CREAT | os.O_RDWR, 0o644)
+        try:
+            try:
+                fcntl.flock(fd, fcntl.LOCK_EX | fcntl.LOCK_NB)
+            except OSError:
+                if time.monotonic() >= deadline:
+                    BUS.count("serve.store.lock_timeout")
+                    raise TimeoutError(
+                        f"store write lock busy > {timeout_s}s: {path}"
+                    ) from None
+                time.sleep(_LOCK_POLL_S)
+                continue
+            # Re-validate after acquiring: the sweep may have unlinked this
+            # lock file between our open and our flock, in which case we
+            # hold a lock on an anonymous inode while a newer writer holds
+            # one on the recreated file — retry on the current file.
+            try:
+                current_ino = os.stat(lock_path).st_ino
+            except FileNotFoundError:
+                current_ino = -1
+            if os.fstat(fd).st_ino != current_ino:
+                continue  # stale inode: reopen and re-acquire
+            yield
+            return
+        finally:
+            os.close(fd)  # closing the fd releases the flock
 
 
 class ResultStore:
@@ -140,17 +208,19 @@ class ResultStore:
             atomic_write_npz,
         )
 
-        atomic_write_npz(
-            _disk_path(self.disk_dir, key),
-            {
-                "digest": result.graph.digest_words(),
-                "edge_ids": result.edge_ids,
-                "num_levels": result.num_levels,
-                "num_components": result.num_components,
-                "backend": np.asarray(result.backend),
-            },
-            fault_site="serve.store.save",
-        )
+        path = _disk_path(self.disk_dir, key)
+        with _flocked(path):
+            atomic_write_npz(
+                path,
+                {
+                    "digest": result.graph.digest_words(),
+                    "edge_ids": result.edge_ids,
+                    "num_levels": result.num_levels,
+                    "num_components": result.num_components,
+                    "backend": np.asarray(result.backend),
+                },
+                fault_site="serve.store.save",
+            )
 
     def _disk_sweep(self) -> None:
         """Bound the disk layer: drop the oldest entries (and their ``.bak``
@@ -164,9 +234,37 @@ class ResultStore:
         entries.sort(key=lambda e: e.stat().st_mtime)
         for entry in entries[: len(entries) - self.disk_max_entries]:
             for path in (entry.path, entry.path + ".bak"):
-                if os.path.exists(path):
+                # Concurrent workers sweep the shared directory too — a
+                # sibling winning the unlink race is success, not an error.
+                with contextlib.suppress(FileNotFoundError):
                     os.unlink(path)
+            self._sweep_lock_file(entry.path + ".lock")
             BUS.count("serve.store.disk_evict")
+
+    @staticmethod
+    def _sweep_lock_file(lock_path: str) -> None:
+        """GC an evicted entry's lock file — but only while HOLDING it.
+
+        Unlinking a lock file someone else holds (or is about to flock)
+        would let two writers lock different inodes of the same name and
+        interleave a publish; :func:`_flocked` re-validates its inode
+        after acquiring, which makes this held-then-unlink safe. A busy
+        lock is simply left behind (tiny, retried next sweep)."""
+        if fcntl is None:
+            return
+        try:
+            fd = os.open(lock_path, os.O_RDWR)
+        except FileNotFoundError:
+            return
+        try:
+            try:
+                fcntl.flock(fd, fcntl.LOCK_EX | fcntl.LOCK_NB)
+            except OSError:
+                return  # a writer holds it: not ours to reap
+            with contextlib.suppress(FileNotFoundError):
+                os.unlink(lock_path)
+        finally:
+            os.close(fd)
 
     def _disk_get(self, key: str, graph: Graph) -> Optional[MSTResult]:
         path = _disk_path(self.disk_dir, key)

@@ -4,6 +4,7 @@ solve touches no solver span; a single-edge insert on a cached 10k-node
 graph never re-solves)."""
 
 import json
+import os
 import threading
 
 import numpy as np
@@ -430,3 +431,165 @@ def test_serve_cli_input_file(tmp_path, capsys):
     out = capsys.readouterr().out
     responses = [json.loads(ln) for ln in out.splitlines()]
     assert responses[0]["ok"] and responses[-1]["op"] == "shutdown"
+
+
+# ----------------------------------------------------------------------
+# Satellite: advisory flock on the shared disk store's write path
+# ----------------------------------------------------------------------
+def test_store_flock_timeout_is_best_effort(tmp_path):
+    import fcntl
+
+    from distributed_ghs_implementation_tpu.serve.store import (
+        _disk_path,
+        _flocked,
+    )
+
+    disk = str(tmp_path / "store")
+    store = ResultStore(capacity=4, disk_dir=disk)
+    g = gnm_random_graph(24, 48, seed=7)
+    result = minimum_spanning_forest(g)
+    key = solve_cache_key(g)
+    store.put(key, result)  # creates the entry + its .lock file
+    path = _disk_path(disk, key)
+
+    # Hold the lock as "another worker"; a writer must time out...
+    fd = os.open(path + ".lock", os.O_CREAT | os.O_RDWR)
+    try:
+        fcntl.flock(fd, fcntl.LOCK_EX)
+        with pytest.raises(TimeoutError):
+            with _flocked(path, timeout_s=0.05):
+                pass
+        assert BUS.counters()["serve.store.lock_timeout"] >= 1
+        # ...and put() treats that as a best-effort miss, never a failure.
+        store.put(key, result)
+        assert BUS.counters()["serve.store.disk_write_failed"] == 1
+    finally:
+        os.close(fd)
+    # Lock released: writes flow again and the entry stays readable.
+    store.put(key, result)
+    fresh = ResultStore(capacity=4, disk_dir=disk)
+    assert fresh.get(key, graph=g) is not None
+
+
+def test_store_concurrent_processes_hammer_same_digest(tmp_path):
+    """Two real processes publishing the same digest to one disk_dir must
+    interleave cleanly: no torn primary, no lost .bak generation, entry
+    always readable afterward."""
+    import subprocess
+    import sys as _sys
+    import zipfile
+
+    disk = str(tmp_path / "shared")
+    child = (
+        "import sys\n"
+        "from distributed_ghs_implementation_tpu.api import "
+        "minimum_spanning_forest\n"
+        "from distributed_ghs_implementation_tpu.graphs.generators import "
+        "gnm_random_graph\n"
+        "from distributed_ghs_implementation_tpu.serve.store import "
+        "ResultStore, solve_cache_key\n"
+        "g = gnm_random_graph(24, 48, seed=11)\n"
+        "res = minimum_spanning_forest(g)\n"
+        "store = ResultStore(capacity=4, disk_dir=sys.argv[1])\n"
+        "key = solve_cache_key(g)\n"
+        "for _ in range(40):\n"
+        "    store.put(key, res)\n"
+    )
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = {**os.environ, "JAX_PLATFORMS": "cpu",
+           "PYTHONPATH": repo + os.pathsep + os.environ.get("PYTHONPATH", "")}
+    procs = [
+        subprocess.Popen([_sys.executable, "-c", child, disk], env=env,
+                         stdout=subprocess.PIPE, stderr=subprocess.PIPE)
+        for _ in range(2)
+    ]
+    for p in procs:
+        _, err = p.communicate(timeout=180)
+        assert p.returncode == 0, err.decode()
+    g = gnm_random_graph(24, 48, seed=11)
+    key = solve_cache_key(g)
+    from distributed_ghs_implementation_tpu.serve.store import _disk_path
+
+    path = _disk_path(disk, key)
+    assert zipfile.is_zipfile(path)  # the published generation is whole
+    if os.path.exists(path + ".bak"):
+        assert zipfile.is_zipfile(path + ".bak")
+    store = ResultStore(capacity=4, disk_dir=disk)
+    got = store.get(key, graph=g)
+    assert got is not None
+    expect = minimum_spanning_forest(g)
+    assert got.total_weight == expect.total_weight
+
+
+# ----------------------------------------------------------------------
+# Satellite: graceful drain of the single-process serve loop
+# ----------------------------------------------------------------------
+def test_serve_loop_sigterm_idle_exits_clean(tmp_path):
+    import subprocess
+    import sys as _sys
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = {**os.environ, "JAX_PLATFORMS": "cpu",
+           "PYTHONPATH": repo + os.pathsep + os.environ.get("PYTHONPATH", "")}
+    proc = subprocess.Popen(
+        [_sys.executable, "-m", "distributed_ghs_implementation_tpu",
+         "serve", "--no-compile-cache"],
+        stdin=subprocess.PIPE, stdout=subprocess.PIPE,
+        stderr=subprocess.DEVNULL, env=env, text=True,
+    )
+    try:
+        g = gnm_random_graph(20, 60, seed=31)
+        proc.stdin.write(json.dumps(
+            {"op": "solve", "num_nodes": 20, "edges": _edges(g)}) + "\n")
+        proc.stdin.flush()
+        assert json.loads(proc.stdout.readline())["ok"]  # loop is live
+        import signal as _signal
+
+        proc.send_signal(_signal.SIGTERM)  # idle: drains immediately
+        assert proc.wait(timeout=60) == 0
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+
+
+def test_serve_loop_sigterm_mid_solve_flushes_response(tmp_path):
+    """A SIGTERM landing while a request is being solved must let the
+    solve finish and flush its response before exiting 0 — previously the
+    default handler killed the process mid-line and the accepted request
+    was lost."""
+    import subprocess
+    import sys as _sys
+    import time as _time
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = {**os.environ, "JAX_PLATFORMS": "cpu",
+           "PYTHONPATH": repo + os.pathsep + os.environ.get("PYTHONPATH", "")}
+    proc = subprocess.Popen(
+        [_sys.executable, "-m", "distributed_ghs_implementation_tpu",
+         "serve", "--no-compile-cache"],
+        stdin=subprocess.PIPE, stdout=subprocess.PIPE,
+        stderr=subprocess.DEVNULL, env=env, text=True,
+    )
+    try:
+        small = gnm_random_graph(20, 60, seed=31)
+        proc.stdin.write(json.dumps(
+            {"op": "solve", "num_nodes": 20, "edges": _edges(small)}) + "\n")
+        proc.stdin.flush()
+        assert json.loads(proc.stdout.readline())["ok"]  # loop is live
+        # An uncached shape: the solve pays a compile, giving the signal a
+        # wide window to land mid-request.
+        big = gnm_random_graph(3000, 12000, seed=5)
+        proc.stdin.write(json.dumps(
+            {"op": "solve", "num_nodes": 3000, "edges": _edges(big)}) + "\n")
+        proc.stdin.flush()
+        _time.sleep(0.5)
+        import signal as _signal
+
+        proc.send_signal(_signal.SIGTERM)
+        line = proc.stdout.readline()  # the accepted request's response
+        assert line, "accepted request lost on SIGTERM"
+        assert json.loads(line)["ok"]
+        assert proc.wait(timeout=120) == 0
+    finally:
+        if proc.poll() is None:
+            proc.kill()

@@ -331,3 +331,48 @@ def test_load_drill_micro_deck_end_to_end(tmp_path):
     assert report["chaos"]["lost_accepted"] == 0
     assert report["gate_metrics"]["metrics"]["queries_sent"] == \
         report["slo"]["totals"]["sent"]
+
+
+# ----------------------------------------------------------------------
+# Fleet: fleet.request spans join like serve.request + per-worker breakdown
+# ----------------------------------------------------------------------
+def test_fleet_request_spans_join_with_worker_breakdown():
+    with BUS.span("fleet.request", cat="fleet", op="solve", cls="hit",
+                  ok=True, worker=0):
+        pass
+    with BUS.span("fleet.request", cat="fleet", op="solve", cls="hit",
+                  ok=True, worker=1):
+        pass
+    with BUS.span("fleet.request", cat="fleet", op="solve", cls="miss",
+                  ok=False, worker=1):
+        pass
+    with BUS.span("fleet.request", cat="fleet", op="solve", cls="shed-me",
+                  ok=False, shed=True):
+        pass  # shed before dispatch: no worker attribution
+    summary = slo.summarize_bus(BUS, wall_s=1.0)
+    assert summary["classes"]["hit"]["sent"] == 2
+    assert summary["classes"]["miss"]["errors"] == 1
+    assert summary["classes"]["shed-me"]["shed"] == 1
+    workers = summary["workers"]
+    assert set(workers) == {"0", "1"}
+    assert workers["0"]["classes"]["hit"]["sent"] == 1
+    assert workers["1"]["classes"]["hit"]["sent"] == 1
+    assert workers["1"]["classes"]["miss"]["errors"] == 1
+    assert workers["1"]["totals"]["sent"] == 2
+
+
+def test_single_process_summary_has_no_worker_section():
+    with BUS.span("serve.request", cat="serve", op="solve", cls="hit",
+                  ok=True):
+        pass
+    summary = slo.summarize_bus(BUS, wall_s=1.0)
+    assert "workers" not in summary
+
+
+def test_sanitize_class_normalizes_hostile_labels():
+    assert slo.sanitize_class(None) is None
+    assert slo.sanitize_class("hit") == "hit"
+    assert slo.sanitize_class("a.b c/d") == "a_b_c_d"
+    assert slo.sanitize_class("x" * 99) == "x" * 32
+    assert slo.sanitize_class("!!!") == "___"
+    assert slo.sanitize_class("") == "untagged"

@@ -29,10 +29,24 @@ stopwatch accounting rides along as a cross-check). The report
 and goodput regressions fail CI the way weight parity does. See
 ``docs/LOAD_TESTING.md``.
 
+**Fleet mode** (``--fleet N``): the same open-loop deck drives a
+:class:`fleet.router.FleetRouter` over N worker subprocesses instead of the
+in-process service — per-class latency then *includes* routing, framed-pipe
+transport, per-worker queueing, and any failover re-queue, joined from the
+router's ``fleet.request`` spans (with a per-worker SLO breakdown).
+``--kill-worker [K]`` arms the fault registry inside worker K mid-window
+(``fleet.worker.crash``: it dies in place of its next request, no response
+flushed) and the drill then asserts the zero-lost-query contract: every
+accepted query is answered (the crashed worker's in-flight requests
+re-queue onto survivors), the dead worker restarts with backoff, rejoins
+the ring, and serves a probe query. See ``docs/FLEET.md``.
+
     python tools/load_drill.py --smoke --output load_report.json \
         --gate-baseline docs/BENCH_BASELINE_LOAD.json
     python tools/load_drill.py --smoke --update-baseline   # rewrite baseline
     python tools/load_drill.py --chaos --duration 20       # chaos scenario
+    python tools/load_drill.py --smoke --no-chaos --fleet 3 --kill-worker 1 \
+        --obs-dir fleet_obs --output fleet_kill.json       # kill drill
 
 Exit code 0 iff every check passed (and the gate, when a baseline is given).
 """
@@ -55,6 +69,8 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 REPORT_SCHEMA = "ghs-load-report-v1"
 WORKLOAD = "gate-load-v1"
+WORKLOAD_FLEET = "gate-fleet-v1"
+WORKLOAD_FLEET_KILL = "gate-fleet-kill-v1"
 DEFAULT_BASELINE = os.path.join(
     os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
     "docs",
@@ -241,22 +257,25 @@ def build_deck(args, rng: np.random.Generator):
 # Execution
 # ----------------------------------------------------------------------
 class _StreamState:
-    __slots__ = ("digest", "lock")
+    __slots__ = ("digest", "lock", "seed_request")
 
-    def __init__(self, digest: str):
+    def __init__(self, digest: str, seed_request: Optional[dict] = None):
         self.digest = digest
         self.lock = threading.Lock()
+        # Fleet mode: a worker crash loses its materialized update
+        # sessions; a client re-subscribes by re-solving its seed graph.
+        self.seed_request = seed_request
 
 
-def run_window(service, schedule, streams, args, chaos_plan):
+def run_window(service, schedule, streams, args, chaos_plan, arm_chaos):
     """Dispatch the schedule open-loop; returns client-side records + wall.
 
     Latency is measured from the SCHEDULED arrival instant (not dispatch),
     so client-pool backlog counts against the service — the open-loop
-    convention that makes queueing delay visible.
+    convention that makes queueing delay visible. ``arm_chaos(plan)``
+    applies one chaos-plan entry (in-process fault arming, or fleet-worker
+    arming/kill over the control pipe).
     """
-    from distributed_ghs_implementation_tpu.utils.resilience import FAULTS
-
     records: List[dict] = []
     records_lock = threading.Lock()
 
@@ -264,6 +283,7 @@ def run_window(service, schedule, streams, args, chaos_plan):
 
     def fire(arrival: Arrival) -> None:
         scheduled = t0 + arrival.at_s
+        reset = False
         try:
             if arrival.stream is not None:
                 state = streams[arrival.stream]
@@ -278,6 +298,17 @@ def run_window(service, schedule, streams, args, chaos_plan):
                     )
                     if response.get("ok"):
                         state.digest = response["digest"]
+                    elif (
+                        state.seed_request is not None
+                        and "no session" in str(response.get("error", ""))
+                    ):
+                        # The worker holding this stream's session died:
+                        # the update was ANSWERED (not lost), and the
+                        # client re-subscribes from its seed graph.
+                        reseed = service.handle(dict(state.seed_request))
+                        if reseed.get("ok"):
+                            state.digest = reseed["digest"]
+                            reset = True
             else:
                 response = service.handle(arrival.request)
             ok = bool(response.get("ok"))
@@ -291,7 +322,7 @@ def run_window(service, schedule, streams, args, chaos_plan):
             return
         with records_lock:
             records.append(
-                {"cls": arrival.cls, "ok": ok, "lost": False,
+                {"cls": arrival.cls, "ok": ok, "lost": False, "reset": reset,
                  "error": response.get("error"),
                  "latency_s": time.perf_counter() - scheduled}
             )
@@ -308,8 +339,7 @@ def run_window(service, schedule, streams, args, chaos_plan):
                 # Chaos lands MID-FLIGHT, between dispatches: earlier
                 # queries are still in the pool when the faults arm.
                 plan = chaos_plan[next_chaos]
-                for site, times in plan["sites"].items():
-                    FAULTS.arm(site, times=times)
+                arm_chaos(plan)
                 chaos_armed.append(plan)
                 next_chaos += 1
             delay = (t0 + arrival.at_s) - time.perf_counter()
@@ -335,23 +365,75 @@ def client_summary(records, wall_s) -> dict:
 # ----------------------------------------------------------------------
 # The drill
 # ----------------------------------------------------------------------
+def _fleet_worker_counters(router) -> dict:
+    """Summed ``serve.*``/``batch.*``/``compile.*`` counters across the
+    fleet's live workers (each worker has its own bus; the router's stats
+    op fans out and sums)."""
+    stats = router.handle({"op": "stats"})
+    return dict(stats.get("counters", {}))
+
+
 def run_drill(args) -> dict:
+    """Run the drill with teardown guaranteed: the fleet drains (flushing
+    in-flight responses + per-worker obs exports) and its temporary shared
+    store is removed even when the drill body raises."""
+    import shutil
+
+    resources: dict = {}
+    try:
+        return _run_drill(args, resources)
+    finally:
+        router = resources.get("router")
+        if router is not None:
+            router.shutdown()
+        disk_tmp = resources.get("disk_tmp")
+        if disk_tmp:
+            shutil.rmtree(disk_tmp, ignore_errors=True)
+
+
+def _run_drill(args, resources: dict) -> dict:
+    import tempfile
+
     from distributed_ghs_implementation_tpu.obs import slo
     from distributed_ghs_implementation_tpu.obs.events import BUS
     from distributed_ghs_implementation_tpu.obs.export import write_events_jsonl
-    from distributed_ghs_implementation_tpu.serve.service import MSTService
     from distributed_ghs_implementation_tpu.utils.resilience import FAULTS
 
     BUS.enable()
     rng = np.random.default_rng(args.seed)
     schedule, warm_graphs, stream_seeds, counts = build_deck(args, rng)
 
-    service = MSTService(
-        batch_lanes=args.lanes,
-        batch_wait_s=args.batch_wait,
-        max_sessions=256,  # solve seeds must not LRU-evict update sessions
-        store_capacity=max(256, len(schedule)),
-    )
+    fleet_router = None
+    if args.fleet:
+        from distributed_ghs_implementation_tpu.fleet.router import (
+            FleetConfig,
+            FleetRouter,
+        )
+
+        resources["disk_tmp"] = tempfile.mkdtemp(prefix="ghs-fleet-store-")
+        config = FleetConfig(
+            workers=args.fleet,
+            batch_lanes=args.lanes,
+            batch_wait_s=args.batch_wait,
+            max_sessions=256,
+            store_capacity=max(256, len(schedule)),
+            # The SHARED persistent layer: a restarted worker re-serves its
+            # keyspace from disk hits instead of re-solving everything.
+            disk_dir=resources["disk_tmp"],
+            obs_dir=args.obs_dir,
+            request_timeout_s=max(120.0, 12 * args.duration),
+        )
+        service = fleet_router = FleetRouter(config).start()
+        resources["router"] = fleet_router
+    else:
+        from distributed_ghs_implementation_tpu.serve.service import MSTService
+
+        service = MSTService(
+            batch_lanes=args.lanes,
+            batch_wait_s=args.batch_wait,
+            max_sessions=256,  # solve seeds must not LRU-evict update sessions
+            store_capacity=max(256, len(schedule)),
+        )
 
     # Warm phase: prime every bucket the deck touches (compiles, rank
     # caches, the hit pool, update sessions) OUTSIDE the measured window —
@@ -368,11 +450,23 @@ def run_drill(args) -> dict:
             raise RuntimeError(f"warm solve failed: {response.get('error')}")
         stream_digests.append(response["digest"])
     warm_s = time.perf_counter() - t_warm
-    streams = [_StreamState(d) for d in stream_digests]
+    streams = [
+        _StreamState(
+            d,
+            seed_request=(
+                _graph_request(g, "update") if fleet_router is not None
+                else None
+            ),
+        )
+        for d, g in zip(stream_digests, stream_seeds)
+    ]
 
     # Chaos plan: transient faults armed mid-flight (seeded offsets). The
     # supervisor ladder + batch retry must absorb them — degraded latency
-    # is expected, lost accepted queries are not.
+    # is expected, lost accepted queries are not. In fleet mode the faults
+    # arm INSIDE the workers over the control pipe; ``--kill-worker`` adds
+    # the fleet.worker.crash entry (the worker dies in place of its next
+    # request — no response flushed, the router must re-queue).
     chaos_plan = []
     if not args.no_chaos:
         chaos_plan.append(
@@ -388,24 +482,99 @@ def run_drill(args) -> dict:
                     "sites": {"resilience.attempt.device": 4, "batch.attempt": 2},
                 }
             )
+    if fleet_router is not None and args.kill_worker is not None:
+        chaos_plan.append(
+            {"at_s": 0.45 * args.duration, "kill_worker": args.kill_worker}
+        )
+    chaos_plan.sort(key=lambda plan: plan["at_s"])
 
+    def arm_chaos(plan: dict) -> None:
+        if fleet_router is not None:
+            for site, times in plan.get("sites", {}).items():
+                for wid in range(args.fleet):
+                    fleet_router.arm_worker_fault(wid, site=site, times=times)
+            if "kill_worker" in plan:
+                fleet_router.arm_worker_fault(
+                    plan["kill_worker"], site="fleet.worker.crash", times=1
+                )
+        else:
+            for site, times in plan.get("sites", {}).items():
+                FAULTS.arm(site, times=times)
+
+    pre_window = (
+        _fleet_worker_counters(fleet_router) if fleet_router is not None
+        else {}
+    )
     BUS.clear()  # the measured window starts here
     try:
         records, wall_s, chaos_armed = run_window(
-            service, schedule, streams, args, chaos_plan
+            service, schedule, streams, args, chaos_plan, arm_chaos
         )
     finally:
         FAULTS.reset()
 
-    # Server-side accounting: the per-class join over real bus events.
+    # Kill-drill recovery: wait for the dead worker to restart and rejoin
+    # the ring, then drive a probe query onto it — "goodput recovery" is a
+    # query actually served by the restarted process, not just a counter.
+    rejoined = None
+    probe = None
+    if fleet_router is not None and args.kill_worker is not None:
+        rejoined = False
+        deadline = time.perf_counter() + 30.0
+        while time.perf_counter() < deadline:
+            stats = fleet_router.handle({"op": "stats"})
+            if sorted(stats.get("ring", [])) == list(range(args.fleet)):
+                rejoined = True
+                break
+            time.sleep(0.25)
+        if rejoined:
+            from distributed_ghs_implementation_tpu.fleet.hashing import (
+                HashRing,
+            )
+            from distributed_ghs_implementation_tpu.graphs.generators import (
+                gnm_random_graph,
+            )
+
+            ring = HashRing(
+                range(args.fleet),
+                replicas=fleet_router.config.ring_replicas,
+            )
+            hint = next(
+                f"probe-{i}" for i in range(10_000)
+                if ring.assign(f"probe-{i}") == args.kill_worker
+            )
+            probe_req = _graph_request(
+                gnm_random_graph(*HIT_SHAPE, seed=args.seed + 7), "probe"
+            )
+            probe_req["digest"] = hint  # route straight at the rejoiner
+            probe = service.handle(probe_req)
+
+    # Server-side accounting: the per-class join over real bus events (the
+    # router's fleet.request spans in fleet mode — which then carry the
+    # per-worker breakdown).
     summary = slo.summarize_bus(BUS, wall_s=wall_s)
     client = client_summary(records, wall_s)
+    if fleet_router is not None:
+        # Worker counters live in the worker processes; the window's share
+        # is the post-minus-pre delta, summed over live workers. A killed
+        # worker's pre-restart counters die with it, so clamp at zero.
+        post_window = _fleet_worker_counters(fleet_router)
+        window_counters = {
+            k: max(0, v - pre_window.get(k, 0))
+            for k, v in post_window.items()
+        }
+        fleet_counters = {
+            k: v for k, v in BUS.counters().items() if k.startswith("fleet.")
+        }
+    else:
+        window_counters = dict(BUS.counters())
+        fleet_counters = {}
     compile_counters = {
-        k: v for k, v in BUS.counters().items() if k.startswith("compile.")
+        k: v for k, v in window_counters.items() if k.startswith("compile.")
     }
     serve_counters = {
         k: v
-        for k, v in BUS.counters().items()
+        for k, v in window_counters.items()
         if k.startswith(("serve.", "batch."))
     }
     if args.jsonl:
@@ -413,35 +582,82 @@ def run_drill(args) -> dict:
 
     lost = sum(1 for rec in records if rec["lost"])
     answered = len(records)
-    errors = sum(1 for rec in records if not rec["ok"] and not rec["lost"])
+    resets = sum(1 for rec in records if rec.get("reset"))
+    errors = sum(
+        1 for rec in records
+        if not rec["ok"] and not rec["lost"] and not rec.get("reset")
+    )
     expected_classes = [c for c, n in counts.items() if n > 0]
     bus_classes = summary["classes"]
 
+    # Every scheduled arrival, plus the out-of-schedule requests the drill
+    # itself makes in fleet mode (session re-subscribe solves, the
+    # post-kill recovery probe), must appear as exactly one request span.
+    expected_spans = len(schedule) + resets + (1 if probe is not None else 0)
     checks = [
         ("every accepted query answered",
          answered == len(schedule) and lost == 0),
-        ("zero errors (chaos absorbed by the supervisor)", errors == 0),
         ("all classes present in the bus-joined report",
          all(c in bus_classes for c in expected_classes)),
         ("bus join saw every request span",
-         summary["totals"]["sent"] == len(schedule)),
+         summary["totals"]["sent"] == expected_spans),
         ("no events dropped during the window (report trustworthy)",
          not summary["dropped_warning"]),
-        ("p99 bounded under chaos",
-         client["totals"]["latency_s"].get("p99", float("inf"))
-         <= args.p99_bound),
-        ("duplicate storms coalesced (single-flight)",
-         serve_counters.get("serve.scheduler.coalesced", 0) >= 1),
         ("chaos armed mid-flight", len(chaos_armed) == len(chaos_plan)),
-        ("cache absorbed the hit class",
-         serve_counters.get("serve.store.hit", 0) >= counts["hit"]),
-        ("zero request-time compiles in the measured window",
-         compile_counters.get("compile.miss", 0) == 0),
     ]
+    if fleet_router is None:
+        checks += [
+            ("zero errors (chaos absorbed by the supervisor)", errors == 0),
+            ("p99 bounded under chaos",
+             client["totals"]["latency_s"].get("p99", float("inf"))
+             <= args.p99_bound),
+            ("duplicate storms coalesced (single-flight)",
+             serve_counters.get("serve.scheduler.coalesced", 0) >= 1),
+            ("cache absorbed the hit class",
+             serve_counters.get("serve.store.hit", 0) >= counts["hit"]),
+            ("zero request-time compiles in the measured window",
+             compile_counters.get("compile.miss", 0) == 0),
+        ]
+    else:
+        checks += [
+            ("zero errors beyond session re-subscribes", errors == 0),
+            ("p99 bounded under failover (degraded but bounded)",
+             client["totals"]["latency_s"].get("p99", float("inf"))
+             <= args.p99_bound),
+            ("per-worker SLO breakdown present",
+             bool(summary.get("workers"))),
+        ]
+        if args.kill_worker is not None:
+            checks += [
+                ("worker killed mid-traffic",
+                 fleet_counters.get("fleet.worker.dead", 0) >= 1),
+                ("accepted requests re-queued onto survivors",
+                 fleet_counters.get("fleet.requeue", 0) >= 1),
+                ("dead worker restarted with backoff",
+                 fleet_counters.get("fleet.worker.restart", 0) >= 1),
+                ("fleet healed: full ring after the drill", bool(rejoined)),
+                ("restarted worker serves traffic (goodput recovery)",
+                 bool(probe and probe.get("ok")
+                      and probe.get("worker") == args.kill_worker)),
+            ]
+        else:
+            # No kill: the fleet must ride the window without ANY failover.
+            checks += [
+                ("no unplanned worker deaths",
+                 fleet_counters.get("fleet.worker.dead", 0) == 0),
+                ("zero request-time compiles in the measured window",
+                 compile_counters.get("compile.miss", 0) == 0),
+            ]
     ok = all(passed for _, passed in checks)
 
+    if fleet_router is None:
+        workload = WORKLOAD
+    elif args.kill_worker is not None:
+        workload = WORKLOAD_FLEET_KILL
+    else:
+        workload = WORKLOAD_FLEET
     config = {
-        "workload": WORKLOAD,
+        "workload": workload,
         "deck": "smoke" if args.smoke else "custom",
         "seed": args.seed,
         "arrival": args.arrival,
@@ -451,13 +667,23 @@ def run_drill(args) -> dict:
         "counts": counts,
         "chaos": "off" if args.no_chaos else ("heavy" if args.chaos else "mid"),
     }
+    if args.fleet:
+        config["fleet"] = args.fleet
+        config["kill_worker"] = args.kill_worker
+    extra_metrics = {"lost_accepted": lost, "answered": answered}
+    if fleet_router is not None:
+        extra_metrics["session_resets"] = resets
+        extra_metrics["worker_restarts"] = fleet_counters.get(
+            "fleet.worker.restart", 0
+        )
+        extra_metrics["requeued"] = fleet_counters.get("fleet.requeue", 0)
     gate = slo.gate_metrics(
         summary,
-        workload=WORKLOAD,
+        workload=workload,
         config=config,
-        extra_metrics={"lost_accepted": lost, "answered": answered},
+        extra_metrics=extra_metrics,
     )
-    return {
+    report = {
         "schema": REPORT_SCHEMA,
         "config": config,
         "wall_s": round(wall_s, 3),
@@ -477,6 +703,17 @@ def run_drill(args) -> dict:
         "ok": ok,
         "gate_metrics": gate,
     }
+    if fleet_router is not None:
+        report["fleet"] = {
+            "workers": args.fleet,
+            "counters": fleet_counters,
+            "session_resets": resets,
+            "rejoined": rejoined,
+            "probe": probe,
+        }
+        # run_drill's finally drains the fleet: workers flush in-flight
+        # responses + export their per-worker obs JSONL (--obs-dir).
+    return report
 
 
 def run_gate(report: dict, baseline_path: str, time_tolerance: float):
@@ -516,6 +753,19 @@ def main(argv=None) -> int:
                    help="oversize-bypass queries in the deck")
     p.add_argument("--workers", type=int, default=16,
                    help="client threads (the open-loop dispatch pool)")
+    p.add_argument("--fleet", type=int, default=0, metavar="N",
+                   help="drive a fleet of N worker processes through the "
+                   "digest router instead of the in-process service "
+                   "(fleet/router.py, docs/FLEET.md)")
+    p.add_argument("--kill-worker", type=int, nargs="?", const=1,
+                   default=None, metavar="K",
+                   help="with --fleet: arm fleet.worker.crash inside worker "
+                   "K mid-window (it dies in place of its next request); "
+                   "the drill then asserts zero lost accepted queries, "
+                   "re-queue, restart-with-backoff, and goodput recovery")
+    p.add_argument("--obs-dir",
+                   help="with --fleet: per-worker obs JSONL exports land "
+                   "here on drain (worker<K>.<incarnation>.jsonl)")
     p.add_argument("--p99-bound", type=float, default=30.0,
                    help="degraded-but-BOUNDED: fail if total p99 exceeds this")
     p.add_argument("--jsonl", help="also export the window's bus events")
@@ -528,6 +778,10 @@ def main(argv=None) -> int:
     p.add_argument("--update-baseline", nargs="?", const=DEFAULT_BASELINE,
                    help="write the gate baseline from this run and exit")
     args = p.parse_args(argv)
+    if args.kill_worker is not None and (
+        not args.fleet or not 0 <= args.kill_worker < args.fleet
+    ):
+        p.error("--kill-worker needs --fleet N with 0 <= K < N")
 
     report = run_drill(args)
     if args.output:
