@@ -239,6 +239,128 @@ def run_batch_bench(args) -> int:
     return 0
 
 
+def run_sharded_bench(args) -> int:
+    """Oversize-lane serving metrics: cold staging vs warm device-resident
+    re-solve on the mesh (``parallel/lane.py``), plus the donated-buffer
+    incremental-update path.
+
+    The pair that matters is ``resolve_cold_s`` (host prep + staging +
+    dispatch) vs ``resolve_warm_s`` (dispatch-only on a resident graph —
+    the repeat-solve path the serving scheduler hits after routing an
+    oversize miss); ``reshard_skipped`` counts the dispatches that reused
+    the pre-partitioned device arrays, and is DETERMINISTIC (one per warm
+    repeat + one per donated update), so it gates exactly. Metrics land in
+    the ``ghs-bench-metrics-v1`` schema and gate against
+    ``docs/BENCH_BASELINE_SHARDED.json`` (``gate-sharded-v1``).
+    """
+    import numpy as np
+
+    from distributed_ghs_implementation_tpu.api import minimum_spanning_forest
+    from distributed_ghs_implementation_tpu.graphs.edgelist import Graph
+    from distributed_ghs_implementation_tpu.graphs.generators import (
+        gnm_random_graph,
+    )
+    from distributed_ghs_implementation_tpu.obs.events import BUS
+    from distributed_ghs_implementation_tpu.parallel.lane import ShardedLane
+
+    BUS.enable()
+    BUS.clear()
+    lane = ShardedLane()
+    g = gnm_random_graph(
+        args.sharded_nodes, args.sharded_edges, seed=SEED
+    )
+
+    t0 = time.perf_counter()
+    lane.precompile(g.num_nodes, g.num_edges)
+    warmup_s = time.perf_counter() - t0
+    print(
+        f"mesh warmup ({lane.n_dev} device(s)): {warmup_s:.3f}s",
+        file=sys.stderr,
+    )
+
+    t0 = time.perf_counter()
+    ids_cold, _, levels = lane.solve(g)
+    resolve_cold_s = time.perf_counter() - t0
+
+    warm_times = []
+    for _ in range(args.repeats):
+        t0 = time.perf_counter()
+        ids_warm, _, _ = lane.solve(g)
+        warm_times.append(time.perf_counter() - t0)
+    resolve_warm_s = min(warm_times)
+
+    # Donated incremental update: a top-weight true insert (one changed
+    # rank slot — the scatter regime), then the dispatch-only re-solve.
+    existing = {(int(a), int(b)) for a, b in zip(g.u, g.v)}
+    ins_v = next(x for x in range(1, g.num_nodes) if (0, x) not in existing)
+    g2 = Graph.from_arrays(
+        g.num_nodes,
+        np.concatenate([g.u, [0]]),
+        np.concatenate([g.v, [ins_v]]),
+        np.concatenate([g.w, [int(g.w.max()) + 1]]),
+    )
+    t0 = time.perf_counter()
+    ids_upd, _, _ = lane.update(g.digest(), g2)
+    update_donated_s = time.perf_counter() - t0
+
+    ref = minimum_spanning_forest(g, backend="device")
+    ref2 = minimum_spanning_forest(g2, backend="device")
+    if not (
+        np.array_equal(ids_cold, ref.edge_ids)
+        and np.array_equal(ids_warm, ref.edge_ids)
+        and np.array_equal(ids_upd, ref2.edge_ids)
+    ):
+        print("SHARDED LANE PARITY FAILED vs device solve", file=sys.stderr)
+        return 1
+
+    counters = BUS.counters()
+    reshard_skipped = int(counters.get("lane.reshard.skipped", 0))
+    update_donated = int(counters.get("lane.update.donated", 0))
+    out = {
+        "metric": f"sharded-lane oversize serving, gnm({g.num_nodes},"
+        f"{g.num_edges}) on {lane.n_dev} device(s)",
+        "value": round(g.num_edges / resolve_warm_s, 1),
+        "unit": "edges/s (warm resident re-solve)",
+        "warmup_s": round(warmup_s, 3),
+        "resolve_cold_s": round(resolve_cold_s, 3),
+        "resolve_warm_s": round(resolve_warm_s, 3),
+        "update_donated_s": round(update_donated_s, 3),
+        "reshard_skipped": reshard_skipped,
+        "update_donated": update_donated,
+        "levels": int(levels),
+        "parity": "edge-exact vs device solve (incl. updated graph)",
+    }
+    print(json.dumps(out))
+    if args.metrics_out:
+        metrics = {
+            "warmup_s": warmup_s,
+            "resolve_cold_s": resolve_cold_s,
+            "resolve_warm_s": resolve_warm_s,
+            "warm_edges_per_sec": g.num_edges / resolve_warm_s,
+            "update_donated_s": update_donated_s,
+            "reshard_skipped": reshard_skipped,
+            "update_donated": update_donated,
+            "levels": int(levels),
+            "mst_weight": int(g.w[ids_cold].sum()),
+        }
+        with open(args.metrics_out, "w") as f:
+            json.dump(
+                {
+                    "schema": "ghs-bench-metrics-v1",
+                    "config": {
+                        "workload": f"sharded-lane-gnm({args.sharded_nodes},"
+                        f"{args.sharded_edges})-seed{SEED}"
+                        f"-{lane.n_dev}dev-r{args.repeats}",
+                    },
+                    "metrics": metrics,
+                },
+                f,
+                indent=2,
+            )
+            f.write("\n")
+    return 0
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(description=__doc__)
     p.add_argument("--scale", type=int, default=24, help="RMAT scale (2^scale vertices)")
@@ -266,7 +388,19 @@ def main(argv=None) -> int:
         "clock (batch/warmup.py) — the cold/warm comparison pair for "
         "cold_first_solve_s (batch mode only)",
     )
+    p.add_argument(
+        "--sharded-lane", action="store_true",
+        help="measure the oversize sharded-lane serving path (cold staging "
+        "vs warm device-resident re-solve, donated updates) instead of the "
+        "RMAT bench; set XLA_FLAGS=--xla_force_host_platform_device_count=8 "
+        "for the CI dryrun mesh",
+    )
+    p.add_argument("--sharded-nodes", type=int, default=70_000,
+                   help="oversize workload nodes for --sharded-lane")
+    p.add_argument("--sharded-edges", type=int, default=140_000)
     args = p.parse_args(argv)
+    if args.sharded_lane:
+        return run_sharded_bench(args)
     if args.batch_lanes:
         return run_batch_bench(args)
 

@@ -91,6 +91,18 @@ class BatchPolicy:
         n_pad, m_pad = bucket_key(graph)
         return n_pad <= self.max_bucket_nodes and m_pad <= self.max_bucket_edges
 
+    def route(self, graph: Graph, *, sharded_available: bool = False) -> str:
+        """Where one solve goes, as a routing label: ``"lane"`` (admitted —
+        the bucketed lane engine / small-graph path), ``"sharded_lane"``
+        (oversize with a mesh lane attached — ``parallel/lane.py``), or
+        ``"bypass"`` (oversize, no sharded lane: the legacy single-graph
+        supervised path). The ONE encoding of the oversize decision — the
+        serving scheduler stamps the label on its ``serve.solve`` spans so
+        load/SLO summaries can tell the two oversize paths apart."""
+        if self.admits(graph):
+            return "lane"
+        return "sharded_lane" if sharded_available else "bypass"
+
     def form(
         self, graphs: Sequence[Graph]
     ) -> Tuple[List[FormedBatch], List[int]]:

@@ -82,6 +82,13 @@ class WarmupPlan:
     single-graph kernel). ``keys`` are exact replayed solver keys (each
     carries its own lane count/mode). ``warm_single`` additionally warms
     the single-graph fused kernel per distinct shape bucket.
+
+    ``mesh_buckets`` are RAW ``(nodes, edges)`` workload sizes for the
+    OVERSIZE path: each warms the sharded lane's mesh programs
+    (``parallel/lane.py`` — head/finish at that bucket's padded shapes)
+    when :func:`run_warmup` is handed a lane, so the first oversize query
+    pays zero request-time compiles too. Raw sizes, not padded shapes:
+    the lane derives its own mesh-aligned padding.
     """
 
     buckets: Tuple[Tuple[int, int], ...] = ()
@@ -89,9 +96,10 @@ class WarmupPlan:
     mode: str = "fused"
     keys: Tuple[SolverKey, ...] = ()
     warm_single: bool = True
+    mesh_buckets: Tuple[Tuple[int, int], ...] = ()
 
     def is_empty(self) -> bool:
-        return not self.buckets and not self.keys
+        return not self.buckets and not self.keys and not self.mesh_buckets
 
 
 def parse_bucket_list(spec: str) -> List[Tuple[int, int]]:
@@ -204,17 +212,48 @@ def load_bucket_record(path: str) -> WarmupPlan:
     return WarmupPlan(keys=keys)
 
 
+def parse_mesh_bucket_list(spec: str) -> List[Tuple[int, int]]:
+    """Parse ``"70000x140000,..."`` into raw mesh-bucket workload sizes.
+
+    Same NODESxEDGES surface as :func:`parse_bucket_list`, but entries stay
+    RAW — the sharded lane pads to its own mesh-aligned shapes, so padding
+    here would double-bucket. Duplicates collapse.
+    """
+    spec = spec.strip()
+    if not spec:
+        return []
+    out: List[Tuple[int, int]] = []
+    for entry in spec.split(","):
+        entry = entry.strip()
+        if not entry:
+            continue
+        parts = entry.lower().split("x")
+        if len(parts) != 2:
+            raise ValueError(
+                f"bad mesh bucket spec {entry!r}; expected NODESxEDGES"
+            )
+        n, m = int(parts[0]), int(parts[1])
+        if n < 1 or m < 1:
+            raise ValueError(
+                f"bad mesh bucket spec {entry!r}: sizes must be positive"
+            )
+        if (n, m) not in out:
+            out.append((n, m))
+    return out
+
+
 def plan_from_flags(
     buckets: Optional[str] = None,
     replay: Optional[str] = None,
     lanes: int = 0,
+    mesh_buckets: Optional[str] = None,
 ) -> Optional[WarmupPlan]:
     """A :class:`WarmupPlan` from the serve-CLI flag surface, or ``None``.
 
-    The ONE mapping from ``--warmup-buckets`` / ``--warmup-replay`` strings
-    to a plan — shared by ``ghs serve`` and every fleet worker
-    (``fleet/worker.py``), so a bucket ladder declared on the router warms
-    identically in all N worker processes.
+    The ONE mapping from ``--warmup-buckets`` / ``--warmup-replay`` /
+    ``--warmup-mesh-buckets`` strings to a plan — shared by ``ghs serve``
+    and every fleet worker (``fleet/worker.py``), so a bucket ladder
+    declared on the router warms identically in all N worker processes.
     """
     plans: List[WarmupPlan] = []
     if buckets:
@@ -223,6 +262,12 @@ def plan_from_flags(
         )
     if replay:
         plans.append(load_bucket_record(replay))
+    if mesh_buckets:
+        plans.append(
+            WarmupPlan(
+                mesh_buckets=tuple(parse_mesh_bucket_list(mesh_buckets))
+            )
+        )
     if not plans:
         return None
     return merge_plans(*plans)
@@ -231,12 +276,16 @@ def plan_from_flags(
 def merge_plans(*plans: WarmupPlan) -> WarmupPlan:
     """Union of several plans (CLI: ``--warmup-buckets`` + ``--warmup-replay``)."""
     buckets: List[Tuple[int, int]] = []
+    mesh_buckets: List[Tuple[int, int]] = []
     keys: List[SolverKey] = []
     lanes, mode, warm_single = 0, "fused", True
     for p in plans:
         for b in p.buckets:
             if b not in buckets:
                 buckets.append(b)
+        for b in p.mesh_buckets:
+            if b not in mesh_buckets:
+                mesh_buckets.append(b)
         for k in p.keys:
             if k not in keys:
                 keys.append(k)
@@ -247,6 +296,7 @@ def merge_plans(*plans: WarmupPlan) -> WarmupPlan:
     return WarmupPlan(
         buckets=tuple(buckets), lanes=lanes, mode=mode,
         keys=tuple(keys), warm_single=warm_single,
+        mesh_buckets=tuple(mesh_buckets),
     )
 
 
@@ -267,12 +317,16 @@ def _warm_single_graph_kernel(n_pad: int, m_pad: int) -> None:
     _solve_from_iota(src, src, rank, ra, ra, num_nodes=n_pad)
 
 
-def run_warmup(plan: WarmupPlan) -> dict:
+def run_warmup(plan: WarmupPlan, *, lane=None) -> dict:
     """Execute a warmup plan; returns a report dict.
 
     Idempotent: already-compiled buckets are skipped (and reported as
     ``cached``). The whole phase is one ``compile.warmup_phase`` span so a
-    trace shows exactly what boot paid for.
+    trace shows exactly what boot paid for. ``lane`` (a
+    ``parallel.lane.ShardedLane``) receives the plan's ``mesh_buckets`` —
+    each warms the oversize path's mesh programs; without a lane they are
+    counted ``mesh_skipped`` (declared but unreachable, like oversize
+    shape buckets on the fused kernel).
     """
     report = {
         "buckets": 0,
@@ -280,6 +334,8 @@ def run_warmup(plan: WarmupPlan) -> dict:
         "cached": 0,
         "skipped": 0,
         "single_warmed": 0,
+        "mesh_warmed": 0,
+        "mesh_skipped": 0,
         "wall_s": 0.0,
     }
     if plan.is_empty():
@@ -294,6 +350,7 @@ def run_warmup(plan: WarmupPlan) -> dict:
     with BUS.span(
         "compile.warmup_phase", cat="compile",
         lane_buckets=len(keys), shape_buckets=len(plan.buckets),
+        mesh_buckets=len(plan.mesh_buckets),
     ) as span:
         for n_pad, m_pad, lanes, mode in keys:
             if lanes < 1:
@@ -317,6 +374,12 @@ def run_warmup(plan: WarmupPlan) -> dict:
                     continue  # routed to the rank solver, never this kernel
                 _warm_single_graph_kernel(n_pad, m_pad)
                 report["single_warmed"] += 1
+        for nodes, edges in plan.mesh_buckets:
+            if lane is None:
+                report["mesh_skipped"] += 1
+                continue
+            lane.precompile(nodes, edges)
+            report["mesh_warmed"] += 1
         span.set(compiled=report["compiled"], cached=report["cached"])
     report["wall_s"] = time.perf_counter() - t0
     return report

@@ -374,9 +374,11 @@ def _cmd_serve(args) -> int:
             ),
             warmup_buckets=args.warmup_buckets,
             warmup_replay=args.warmup_replay,
+            warmup_mesh_buckets=args.warmup_mesh_buckets,
             compile_cache_dir=args.compile_cache_dir,
             no_compile_cache=args.no_compile_cache,
             obs_dir=args.fleet_obs_dir,
+            sharded_lane_workers=args.sharded_lane,
         )
         # Workers enable the (shared, machine-fingerprinted) persistent
         # compile cache and run warmup themselves; the router never
@@ -409,6 +411,7 @@ def _cmd_serve(args) -> int:
         buckets=args.warmup_buckets,
         replay=args.warmup_replay,
         lanes=args.batch_lanes,
+        mesh_buckets=args.warmup_mesh_buckets,
     )
 
     service = MSTService(
@@ -419,6 +422,9 @@ def _cmd_serve(args) -> int:
         resolve_threshold=args.resolve_threshold,
         batch_lanes=args.batch_lanes,
         warmup=warmup_plan,
+        # -1 = the bare flag: all devices; N > 0 = a submesh of N.
+        sharded_lane=(True if args.sharded_lane == -1
+                      else max(0, args.sharded_lane)),
     )
     if service.warmup_report is not None:
         print(f"warmup: {json.dumps(service.warmup_report)}", file=sys.stderr)
@@ -626,6 +632,22 @@ def build_parser() -> argparse.ArgumentParser:
         "--warmup-replay",
         help="AOT-precompile the buckets recorded in this file (written by "
         "--warmup-record on a prior run)",
+    )
+    srv.add_argument(
+        "--sharded-lane", type=int, nargs="?", const=-1, default=0,
+        metavar="N",
+        help="route oversize solves to a mesh-sharded solve lane over N "
+        "devices (bare flag = all devices; 0 = off) with device-resident "
+        "graph residency and donated incremental updates; with --fleet, "
+        "N is instead the number of worker slots that own a lane (bare "
+        "flag = every worker) and the router steers oversize digests at "
+        "them (docs/SHARDED_LANE.md)",
+    )
+    srv.add_argument(
+        "--warmup-mesh-buckets",
+        help="AOT-warm the sharded lane's mesh programs for these RAW "
+        "NODESxEDGES oversize workloads before serving (needs "
+        "--sharded-lane)",
     )
     srv.add_argument(
         "--warmup-record",

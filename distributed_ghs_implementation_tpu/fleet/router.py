@@ -77,6 +77,12 @@ class FleetConfig:
     no_compile_cache: bool = False
     queue_depth: int = 64
     shed_classes: Tuple[str, ...] = ()
+    # Oversize routing: the first K worker slots own a mesh-sharded solve
+    # lane (spawned with --sharded-lane; -1 = every worker). Oversize
+    # solves consistent-hash onto the LANE ring so they land on a
+    # mesh-owning worker; 0 leaves oversize on the normal ring (bypass).
+    sharded_lane_workers: int = 0
+    warmup_mesh_buckets: Optional[str] = None  # passed to lane workers
     # A dead process is caught instantly by pipe EOF; heartbeats exist for
     # WEDGED processes, so the threshold errs generous — a false-positive
     # kill under load-spike GIL starvation costs more than slow detection.
@@ -93,13 +99,44 @@ class FleetConfig:
     worker_env: Optional[Dict[int, Dict[str, str]]] = None  # incarnation 0 only
 
 
+#: Default admission-ceiling BUCKETS mirrored from ``batch.policy
+#: .BatchPolicy`` (max_bucket_nodes / max_bucket_edges) — mirrored, not
+#: imported, because the policy module pulls in jax and the router must
+#: stay importable without it (echo-worker tests); a drift guard in
+#: tests/test_lane.py pins these to the real policy defaults.
+_OVERSIZE_NODE_BUCKET = 1 << 16
+_OVERSIZE_EDGE_BUCKET = 1 << 17
+
+
+def _next_pow2(x: int) -> int:
+    return 1 << max(0, (x - 1)).bit_length()
+
+
+def _request_oversize(request: dict) -> bool:
+    """Would this solve bypass the lane engine (oversize)? Judged from the
+    raw request so the router can steer it at a mesh-owning worker without
+    building a Graph twice. ``graph_path`` solves (size unknown without
+    I/O) and updates (session-pinned anyway) route normally."""
+    if request.get("op") != "solve" or "edges" not in request:
+        return False
+    n = _next_pow2(max(1, int(request.get("num_nodes", 0))))
+    m = _next_pow2(max(1, len(request["edges"])))
+    return n > _OVERSIZE_NODE_BUCKET or m > _OVERSIZE_EDGE_BUCKET
+
+
 class _Pending:
     """One accepted request: survives its worker by being re-dispatched."""
 
     __slots__ = ("request", "key", "cls", "event", "response", "worker_id",
-                 "requeues")
+                 "requeues", "lane")
 
-    def __init__(self, request: dict, key: Optional[str], cls: Optional[str]):
+    def __init__(
+        self,
+        request: dict,
+        key: Optional[str],
+        cls: Optional[str],
+        lane: bool = False,
+    ):
         self.request = request
         self.key = key
         self.cls = cls
@@ -107,6 +144,7 @@ class _Pending:
         self.response: Optional[dict] = None
         self.worker_id: Optional[int] = None
         self.requeues = 0
+        self.lane = lane  # prefers a mesh-owning worker (oversize solve)
 
 
 class _Worker:
@@ -123,6 +161,7 @@ class _Worker:
         self.slots = threading.BoundedSemaphore(queue_depth)
         self.last_pong = 0.0
         self.restarts = 0
+        self.lane_advertised = False  # capability from the ready frame
 
 
 class FleetRouter:
@@ -144,6 +183,13 @@ class FleetRouter:
             for i in range(self.config.workers)
         ]
         self._ring = HashRing(replicas=self.config.ring_replicas)
+        # Mesh-owning worker slots (config-derived — stable across
+        # incarnations): oversize solves hash onto this subring.
+        k = self.config.sharded_lane_workers
+        self._lane_ids = set(
+            range(self.config.workers if k == -1 else max(0, min(k, self.config.workers)))
+        )
+        self._lane_ring = HashRing(replicas=self.config.ring_replicas)
         self._ring_lock = threading.Lock()
         self._sessions: Dict[str, int] = {}  # update-session digest -> worker
         self._next_id = 0
@@ -174,6 +220,8 @@ class FleetRouter:
                 w.alive = True
                 w.last_pong = now
                 self._ring.add(w.id)
+                if w.id in self._lane_ids:
+                    self._lane_ring.add(w.id)
         self._heartbeat = threading.Thread(
             target=self._heartbeat_loop, name="fleet-heartbeat", daemon=True
         )
@@ -244,6 +292,10 @@ class FleetRouter:
             argv += ["--warmup-buckets", cfg.warmup_buckets]
         if cfg.warmup_replay:
             argv += ["--warmup-replay", cfg.warmup_replay]
+        if w.id in self._lane_ids:
+            argv += ["--sharded-lane", "-1"]
+            if cfg.warmup_mesh_buckets:
+                argv += ["--warmup-mesh-buckets", cfg.warmup_mesh_buckets]
         if cfg.compile_cache_dir:
             argv += ["--compile-cache-dir", cfg.compile_cache_dir]
         if cfg.no_compile_cache:
@@ -297,6 +349,7 @@ class FleetRouter:
                 break
             if "ready" in frame:
                 w.last_pong = time.monotonic()
+                w.lane_advertised = bool(frame.get("lane"))
                 w.ready.set()
                 continue
             if "pong" in frame:
@@ -378,6 +431,8 @@ class FleetRouter:
             w.alive = False
             w.ready.clear()
             self._ring.remove(w.id)
+            if w.id in self._lane_ids:
+                self._lane_ring.remove(w.id)
             for digest in [
                 d for d, wid in self._sessions.items() if wid == w.id
             ]:
@@ -445,6 +500,8 @@ class FleetRouter:
                     w.alive = True
                     w.last_pong = time.monotonic()
                     self._ring.add(w.id)
+                    if w.id in self._lane_ids:
+                        self._lane_ring.add(w.id)
                 BUS.count("fleet.worker.restart")
                 BUS.instant("fleet.worker.rejoin", cat="fleet", worker=w.id,
                             incarnation=w.incarnation, backoff_s=backoff)
@@ -473,12 +530,25 @@ class FleetRouter:
                 ).digest()
         return None
 
-    def _route(self, key: Optional[str]) -> Optional[_Worker]:
+    def _route(
+        self, key: Optional[str], *, lane: bool = False
+    ) -> Optional[_Worker]:
         with self._ring_lock:
             if key is not None:
                 wid = self._sessions.get(key)
                 if wid is not None and self._workers[wid].alive:
                     return self._workers[wid]
+                if lane:
+                    # Oversize: prefer a mesh-owning worker (cache
+                    # affinity within the lane subring). All lane workers
+                    # down -> fall through to the full ring: a bypass
+                    # solve is slow, never wrong.
+                    try:
+                        wid = self._lane_ring.assign(key)
+                        BUS.count("fleet.route.sharded_lane")
+                        return self._workers[wid]
+                    except LookupError:
+                        BUS.count("fleet.route.lane_fallback")
                 try:
                     return self._workers[self._ring.assign(key)]
                 except LookupError:
@@ -501,7 +571,7 @@ class FleetRouter:
             if self._closed:
                 return {"ok": False, "op": p.request.get("op"),
                         "error": "fleet shutting down"}
-            w = self._route(p.key)
+            w = self._route(p.key, lane=p.lane)
             if w is None:
                 if time.monotonic() >= deadline:
                     BUS.count("fleet.unroutable")
@@ -564,7 +634,14 @@ class FleetRouter:
                 BUS.count("fleet.errors")
                 return {"ok": False, "op": op,
                         "error": f"{type(e).__name__}: {e}"}
-            p = _Pending(request, key, cls)
+            # lane preference only exists in a fleet that HAS lane
+            # workers — otherwise every oversize request would probe the
+            # empty lane ring and pollute the lane_fallback counter
+            # (documented as the all-lane-workers-down signal).
+            p = _Pending(
+                request, key, cls,
+                lane=bool(self._lane_ids) and _request_oversize(request),
+            )
             err = self._dispatch(p)
             if err is not None:
                 span.set(ok=False, shed=bool(err.get("shed")))
@@ -634,6 +711,7 @@ class FleetRouter:
                 "incarnation": w.incarnation,
                 "restarts": w.restarts,
                 "pending": len(w.pending),
+                "lane": w.id in self._lane_ids,
             }
             if w.alive and w.ready.is_set():
                 resp = self._request_worker(w, {"op": "stats"})

@@ -124,8 +124,11 @@ def _build_service(args):
         batch_wait_s=args.batch_wait,
         warmup=plan_from_flags(
             buckets=args.warmup_buckets, replay=args.warmup_replay,
-            lanes=args.batch_lanes,
+            lanes=args.batch_lanes, mesh_buckets=args.warmup_mesh_buckets,
         ),
+        # -1 = the bare flag: a lane over all of this worker's devices.
+        sharded_lane=(True if args.sharded_lane == -1
+                      else max(0, args.sharded_lane)),
     )
 
 
@@ -167,9 +170,12 @@ def run_worker(args) -> int:
         max_workers=args.threads, thread_name_prefix=f"worker{args.worker_id}"
     )
     with out_lock:
+        # The lane capability flag rides the ready frame: the router sends
+        # oversize digests only to mesh-owning workers (fleet/router.py).
         write_frame(
             stdout,
-            {"ready": True, "worker": args.worker_id, "pid": os.getpid()},
+            {"ready": True, "worker": args.worker_id, "pid": os.getpid(),
+             "lane": bool(args.sharded_lane)},
         )
     try:
         while True:
@@ -225,6 +231,13 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--threads", type=int, default=4,
                    help="request threads (lets the batch engine coalesce)")
     p.add_argument("--warmup-buckets", default=None)
+    p.add_argument("--warmup-mesh-buckets", default=None,
+                   help="RAW NODESxEDGES oversize workloads to warm on the "
+                   "sharded lane before serving")
+    p.add_argument("--sharded-lane", type=int, nargs="?", const=-1,
+                   default=0, metavar="N",
+                   help="own a mesh-sharded oversize solve lane over N "
+                   "devices (bare flag = all; 0 = off)")
     p.add_argument("--compile-cache-dir", default=None)
     p.add_argument("--no-compile-cache", action="store_true")
     p.add_argument("--obs-jsonl", default=None,

@@ -8,6 +8,7 @@ combines per-fragment minima with ``lax.pmin`` over ICI. Vertex arrays stay
 replicated (67 MB at RMAT-24 — cheap next to the 8.6 GB edge partition).
 """
 
+from distributed_ghs_implementation_tpu.parallel.lane import ShardedLane
 from distributed_ghs_implementation_tpu.parallel.mesh import (
     edge_mesh,
     shard_map_compat,
@@ -18,6 +19,7 @@ from distributed_ghs_implementation_tpu.parallel.sharded import (
 )
 
 __all__ = [
+    "ShardedLane",
     "edge_mesh",
     "make_sharded_solver",
     "shard_map_compat",

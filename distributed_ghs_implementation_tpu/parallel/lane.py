@@ -1,0 +1,644 @@
+"""ShardedLane: the mesh-sharded solve lane behind the serving scheduler.
+
+Round 1-5 built the multichip rank-sharded solver
+(``parallel/rank_sharded.py`` — the RMAT-26 / 1.05B-edge certification);
+rounds 8-12 built the serving stack. They never met: ``serve``/``batch``
+only drive the single-device solver, and oversize admissions just bypass
+the lane engine onto the semaphore path. This module is the join — a
+solve lane owning a mesh (real devices or the 8-device CPU dryrun) that
+the scheduler routes oversize misses to, with the two levers that make
+routing them worthwhile rather than merely possible:
+
+* **Pre-partitioned residency** — a bounded LRU of device-resident
+  graphs: the m-sized rank-endpoint arrays (``ra``/``rb``) are staged
+  ONCE with ``jax.device_put`` onto the exact block sharding the solver's
+  ``in_specs`` declare (``P(EDGE_AXIS)``), and the n-sized level-1 state
+  rides replicated beside them. A repeat solve on a resident graph is
+  dispatch-only: no host pass, no transfer, no resharding — inputs
+  already match ``in_axis_resources``, so XLA moves nothing
+  (``lane.reshard.skipped`` counts exactly these).
+* **Donated incremental updates** — an edge insert/delete/reweight on a
+  resident graph shifts a contiguous rank interval of ``ra``/``rb``.
+  Instead of re-staging the full m-sized arrays from host, the changed
+  slots are scattered into the resident buffers by a jitted update whose
+  input buffers are DONATED on accelerators (``donate_argnums`` — the
+  old device allocation is consumed in place, the SNIPPETS donation
+  pattern), and the entry re-keys under the new content digest. Updates
+  that dirty more than ``max_update_frac`` of the rank space fall back
+  to a full restage (``lane.restage``) — the scatter would cost more
+  than the transfer it avoids.
+
+Compile accounting: the sharded programs compile under plain ``jit``
+(per shape), outside the lane engine's AOT executable cache — so the
+lane keeps its own first-dispatch ledger per program shape and lands the
+events on the shared ``compile.*`` taxonomy: a shape first dispatched
+during :meth:`ShardedLane.precompile` counts ``compile.warmup``; one
+first dispatched by live traffic counts ``compile.miss``; every repeat
+is ``compile.hit``. "Zero request-time compiles on the oversize path"
+is therefore the same assertable property the warm path has
+(``tools/serve_drill.py --sharded-smoke``).
+
+Priority: solves accept a ``yield_fn`` called between device dispatches
+(head / in-place guard levels / finish — the stepped-solve boundaries).
+The serving scheduler passes its two-class gate's checkpoint there, so a
+bulk mesh solve pauses between levels while interactive small-graph
+traffic is pending instead of starving it (``serve/scheduler.py``).
+
+Exactness: the lane runs the PLAIN (non-filtered) rank-sharded program —
+head (levels 1-2), capacity-guard in-place levels, compact/all-gather
+finish — which is edge-for-edge identical to every other backend on any
+graph (the filtered split is a perf specialization the residency
+contract deliberately skips: its prefix arrays would double the resident
+footprint). Harvest is the single-process chunked fetch; multi-process
+serving fronts each process with its own lane.
+"""
+
+from __future__ import annotations
+
+import collections
+import dataclasses
+import functools
+import math
+import threading
+import time
+from typing import Callable, Dict, List, Optional, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from distributed_ghs_implementation_tpu.api import MSTResult
+from distributed_ghs_implementation_tpu.graphs.edgelist import Graph
+from distributed_ghs_implementation_tpu.models.boruvka import (
+    _bucket_size,
+    _max_levels,
+    _next_pow2,
+)
+from distributed_ghs_implementation_tpu.models.rank_solver import (
+    _INT32_RANK_LIMIT,
+    fetch_mst_edge_ids,
+    host_level1,
+)
+from distributed_ghs_implementation_tpu.obs.events import BUS
+from distributed_ghs_implementation_tpu.parallel.mesh import EDGE_AXIS, edge_mesh
+from distributed_ghs_implementation_tpu.parallel.rank_sharded import (
+    _FINISH_GATHER_MAX_SLOTS,
+    make_rank_sharded_finish,
+    make_rank_sharded_head,
+    make_rank_sharded_level,
+)
+from distributed_ghs_implementation_tpu.parallel.sharded import _stage
+
+_INT32_MAX = np.iinfo(np.int32).max
+
+#: Default resident-graph LRU capacity. Each entry pins ~2 int32 arrays of
+#: m_pad on device plus 2 of n_pad replicated per device and 2 host-side
+#: m_pad copies — size to HBM, not request rate (docs/SHARDED_LANE.md).
+DEFAULT_CAPACITY = 4
+
+#: Updates dirtying more than this fraction of the rank space restage in
+#: full: past it the padded scatter (index transfer + gather-scatter
+#: dispatch) loses to one contiguous host->device copy.
+DEFAULT_MAX_UPDATE_FRAC = 0.5
+
+# First-dispatch ledger: one entry per compiled program shape, process
+# wide (the jit caches underneath are process-wide too). Guarded because
+# the scheduler may drive lanes from concurrent request threads.
+_SEEN_SHAPES: set = set()
+_SEEN_LOCK = threading.Lock()
+
+
+def _note_dispatch(shape_key: tuple, phase: str) -> None:
+    """Land a lane dispatch on the ``compile.*`` taxonomy: the first time a
+    program shape is dispatched in this process it compiles (jit caches by
+    shape), so first-seen counts as ``compile.warmup`` or ``compile.miss``
+    by who paid; repeats are ``compile.hit``."""
+    with _SEEN_LOCK:
+        first = shape_key not in _SEEN_SHAPES
+        if first:
+            _SEEN_SHAPES.add(shape_key)
+    if first:
+        BUS.count("lane.compile")
+        BUS.count("compile.warmup" if phase == "warmup" else "compile.miss")
+    else:
+        BUS.count("compile.hit")
+
+
+def _reset_shape_ledger() -> None:
+    """Tests simulate a process restart (pairs with clearing jit caches)."""
+    with _SEEN_LOCK:
+        _SEEN_SHAPES.clear()
+
+
+@functools.lru_cache(maxsize=16)
+def _make_scatter_update(mesh: Mesh, donate: bool):
+    """Jitted in-place slot scatter for resident rank arrays.
+
+    ``arr`` stays on its block sharding; ``idx`` is padded to a power-of-
+    two bucket with the out-of-range sentinel (``mode="drop"`` discards
+    the pads), so compiles are bounded by log2 of the changed-slot count.
+    With ``donate`` (accelerators, no concurrent reader of the buffer)
+    the resident allocation is consumed in place; the non-donating
+    variant leaves the old buffers valid for an in-flight solve still
+    holding them.
+    """
+    blk = NamedSharding(mesh, P(EDGE_AXIS))
+
+    def upd(arr, idx, vals):
+        return arr.at[idx].set(vals, mode="drop")
+
+    kwargs = {}
+    if donate and jax.default_backend() in ("tpu", "gpu"):
+        kwargs["donate_argnums"] = (0,)  # donation no-ops on CPU anyway
+    return jax.jit(upd, out_shardings=blk, **kwargs)
+
+
+@dataclasses.dataclass
+class ResidentGraph:
+    """One device-resident graph: staged arrays pre-partitioned to the
+    mesh layout, plus the host-side rank endpoints updates diff against."""
+
+    digest: str
+    num_nodes: int
+    num_edges: int
+    n_pad: int
+    m_pad: int
+    vmin0: jax.Array  # replicated, n_pad
+    parent1: jax.Array  # replicated, n_pad
+    ra: jax.Array  # block-sharded over EDGE_AXIS, m_pad
+    rb: jax.Array  # block-sharded over EDGE_AXIS, m_pad
+    ra_np: np.ndarray  # host copies: the delta diff base for updates
+    rb_np: np.ndarray
+
+
+class ShardedLane:
+    """Mesh-owning solve lane with a bounded device-resident graph LRU.
+
+    The serving-facing surface mirrors the lane engine's contract
+    (``batch/engine.py``): :meth:`solve_result` /: meth:`update_result`
+    return :class:`api.MSTResult`; ``admits`` is the routing predicate the
+    scheduler consults. One device batch in flight at a time
+    (``_dispatch`` lock) — the mesh is a single shared resource.
+    """
+
+    def __init__(
+        self,
+        mesh: Optional[Mesh] = None,
+        *,
+        capacity: int = DEFAULT_CAPACITY,
+        max_update_frac: float = DEFAULT_MAX_UPDATE_FRAC,
+        max_in_flight: int = 2,
+    ):
+        if capacity < 1:
+            raise ValueError(f"capacity must be >= 1, got {capacity}")
+        if not 0.0 <= max_update_frac <= 1.0:
+            raise ValueError(
+                f"max_update_frac must be in [0, 1], got {max_update_frac}"
+            )
+        if max_in_flight < 1:
+            raise ValueError(
+                f"max_in_flight must be >= 1, got {max_in_flight}"
+            )
+        self.mesh = mesh if mesh is not None else edge_mesh()
+        self.n_dev = int(self.mesh.devices.size)
+        self.capacity = capacity
+        self.max_update_frac = max_update_frac
+        self._lru: "collections.OrderedDict[str, ResidentGraph]" = (
+            collections.OrderedDict()
+        )
+        self._lock = threading.Lock()  # LRU + in-use bookkeeping
+        self._dispatch = threading.Lock()  # one mesh solve in flight
+        # Admission bound on lane work as a whole: dispatch is serialized,
+        # but COLD STAGING happens before the dispatch lock — without this
+        # semaphore, K concurrent distinct oversize misses would stage K
+        # sets of m-sized device arrays at once (the LRU bounds retained
+        # entries, not in-flight stagings).
+        self._admit = threading.BoundedSemaphore(max_in_flight)
+        # digest -> count of solves currently holding the entry's device
+        # buffers (between LRU lookup and dispatch completion): an entry
+        # with readers must never be DONATED out from under them.
+        self._in_use: Dict[str, int] = {}
+
+    # ------------------------------------------------------------------
+    # Routing predicate
+    # ------------------------------------------------------------------
+    def pad_shape(self, num_nodes: int, num_edges: int) -> Tuple[int, int]:
+        """The padded ``(n_pad, m_pad)`` a graph stages at on this mesh —
+        bucket sizes, with the rank width rounded up so every shard block
+        is byte-aligned for the bit-packed harvest."""
+        n_pad = _bucket_size(max(1, num_nodes))
+        unit = 8 * self.n_dev
+        m_pad = int(math.ceil(_bucket_size(max(1, num_edges)) / unit) * unit)
+        return n_pad, m_pad
+
+    def admits(self, graph: Graph) -> bool:
+        """Can this graph run on the lane's plain sharded program? (The
+        2^31+ rank regime needs the split-key program — route those
+        through ``solve_graph_rank_sharded`` directly.)"""
+        n_pad, m_pad = self.pad_shape(graph.num_nodes, graph.num_edges)
+        return n_pad < _INT32_RANK_LIMIT and m_pad < _INT32_RANK_LIMIT
+
+    # ------------------------------------------------------------------
+    # Residency
+    # ------------------------------------------------------------------
+    def resident_digests(self) -> List[str]:
+        with self._lock:
+            return list(self._lru)
+
+    def _get_resident(
+        self, digest: str, *, checkout: bool = False
+    ) -> Optional[ResidentGraph]:
+        with self._lock:
+            res = self._lru.get(digest)
+            if res is not None:
+                self._lru.move_to_end(digest)
+                if checkout:
+                    self._in_use[digest] = self._in_use.get(digest, 0) + 1
+            return res
+
+    def _put_resident(
+        self, res: ResidentGraph, *, checkout: bool = False
+    ) -> None:
+        with self._lock:
+            self._lru[res.digest] = res
+            self._lru.move_to_end(res.digest)
+            if checkout:
+                self._in_use[res.digest] = (
+                    self._in_use.get(res.digest, 0) + 1
+                )
+            while len(self._lru) > self.capacity:
+                self._lru.popitem(last=False)  # dropping refs frees HBM
+                BUS.count("lane.resident.evict")
+
+    def _release(self, digest: str) -> None:
+        with self._lock:
+            n = self._in_use.get(digest, 0) - 1
+            if n <= 0:
+                self._in_use.pop(digest, None)
+            else:
+                self._in_use[digest] = n
+
+    def _pop_resident(self, digest: str) -> Tuple[Optional[ResidentGraph], bool]:
+        """Remove ``digest``'s entry; also reports whether any in-flight
+        solve still holds its device buffers (a busy entry's buffers must
+        not be donated — the non-donating scatter leaves them valid)."""
+        with self._lock:
+            return (
+                self._lru.pop(digest, None),
+                self._in_use.get(digest, 0) > 0,
+            )
+
+    def _stage_resident(
+        self,
+        graph: Graph,
+        digest: str,
+        pad_shape: Optional[Tuple[int, int]] = None,
+    ) -> ResidentGraph:
+        """Cold path: host level-1 prep + one staging pass onto the mesh
+        layout the solver's ``in_specs`` declare. Everything a warm
+        re-solve or donated update later skips happens here. ``pad_shape``
+        overrides the graph's own padded shape (warmup stages a small
+        inert graph at the TARGET bucket's shapes)."""
+        n = graph.num_nodes
+        n_pad, m_pad = pad_shape or self.pad_shape(n, graph.num_edges)
+        with BUS.span(
+            "lane.stage", cat="lane", nodes=n, edges=graph.num_edges,
+            n_pad=n_pad, m_pad=m_pad, devices=self.n_dev,
+        ):
+            ra_np, rb_np = graph.rank_endpoints(pad_to=m_pad)
+            vmin0_np = np.full(n_pad, _INT32_MAX, dtype=np.int32)
+            vmin0_np[:n] = graph.first_ranks
+            parent1_np = host_level1(vmin0_np, ra_np, rb_np)
+            rep = NamedSharding(self.mesh, P())
+            blk = NamedSharding(self.mesh, P(EDGE_AXIS))
+            return ResidentGraph(
+                digest=digest,
+                num_nodes=n,
+                num_edges=graph.num_edges,
+                n_pad=n_pad,
+                m_pad=m_pad,
+                vmin0=_stage(vmin0_np, rep),
+                parent1=_stage(parent1_np, rep),
+                ra=_stage(ra_np, blk),
+                rb=_stage(rb_np, blk),
+                ra_np=ra_np,
+                rb_np=rb_np,
+            )
+
+    # ------------------------------------------------------------------
+    # Solve
+    # ------------------------------------------------------------------
+    def solve(
+        self,
+        graph: Graph,
+        *,
+        yield_fn: Optional[Callable[[], None]] = None,
+        phase: str = "request",
+    ) -> Tuple[np.ndarray, np.ndarray, int]:
+        """Solve on the mesh; ``(edge_ids, fragment, levels)`` — the
+        ``models.boruvka.solve_graph`` contract, edge-for-edge identical
+        to every other backend. Resident graphs re-solve dispatch-only."""
+        n = graph.num_nodes
+        if n == 0 or graph.num_edges == 0:
+            return np.zeros(0, dtype=np.int64), np.arange(n, dtype=np.int32), 0
+        if not self.admits(graph):
+            raise ValueError(
+                "graph exceeds the lane's int32 rank envelope; use "
+                "solve_graph_rank_sharded(rank64=True)"
+            )
+        digest = graph.digest()
+        with self._admit:  # bounds stage+solve in flight, not just dispatch
+            res = self._get_resident(digest, checkout=True)
+            resident_hit = res is not None
+            if resident_hit:
+                BUS.count("lane.resident.hit")
+                BUS.count("lane.reshard.skipped")
+            else:
+                BUS.count("lane.resident.miss")
+                res = self._stage_resident(graph, digest)
+                self._put_resident(res, checkout=True)
+            try:
+                return self._dispatch_solve(
+                    res, graph, yield_fn=yield_fn, phase=phase,
+                    resident=resident_hit,
+                )
+            finally:
+                # The checkout pins the entry's buffers against donation
+                # by a concurrent refresh for the dispatch's duration.
+                self._release(digest)
+
+    def _dispatch_solve(
+        self,
+        res: ResidentGraph,
+        graph: Graph,
+        *,
+        yield_fn: Optional[Callable[[], None]] = None,
+        phase: str = "request",
+        resident: bool = True,
+    ) -> Tuple[np.ndarray, np.ndarray, int]:
+        """The plain rank-sharded program over staged arrays: head (levels
+        1-2) -> capacity-guard in-place levels -> compact/all-gather
+        finish. ``yield_fn`` runs between dispatches — the stepped-solve
+        boundaries the priority gate hooks."""
+        mesh = self.mesh
+        n_pad, m_pad = res.n_pad, res.m_pad
+
+        def checkpoint():
+            if yield_fn is not None:
+                yield_fn()
+
+        with self._dispatch, BUS.span(
+            "lane.solve", cat="lane", nodes=graph.num_nodes,
+            edges=graph.num_edges, devices=self.n_dev, resident=resident,
+        ) as span:
+            _note_dispatch(("head", n_pad, m_pad, self.n_dev, mesh), phase)
+            head = make_rank_sharded_head(mesh)
+            fragment, mst, fa, fb, stats = head(
+                res.vmin0, res.parent1, res.ra, res.rb
+            )
+            lv, total, cmax = (int(x) for x in jax.device_get(stats))
+            checkpoint()
+            while (
+                total > 0
+                and self.n_dev * _bucket_size(cmax) > _FINISH_GATHER_MAX_SLOTS
+            ):
+                _note_dispatch(("level", n_pad, m_pad, self.n_dev, mesh), phase)
+                level_fn = make_rank_sharded_level(mesh)
+                fragment, mst, fa, fb, lstats = level_fn(fragment, mst, fa, fb)
+                total, cmax, progressed = (
+                    int(x) for x in jax.device_get(lstats)
+                )
+                lv += 1
+                if not progressed:
+                    break  # isolated remainder (disconnected pads)
+                checkpoint()
+            if total > 0:
+                fs_local = self._finish_width(m_pad, cmax)
+                max_levels = _max_levels(n_pad)
+                _note_dispatch(
+                    ("finish", n_pad, m_pad, fs_local, max_levels,
+                     self.n_dev, mesh),
+                    phase,
+                )
+                finish = make_rank_sharded_finish(mesh, fs_local, max_levels)
+                fragment, mst, extra = finish(fragment, mst, fa, fb)
+                lv += int(extra)
+            checkpoint()
+            edge_ids = fetch_mst_edge_ids(graph, mst)
+            span.set(levels=lv)
+        return edge_ids, np.asarray(fragment)[: graph.num_nodes], lv
+
+    def _finish_width(self, m_pad: int, cmax: int) -> int:
+        """The finish program's compact width — pinned to the full
+        shard-width bucket (capped by the gather budget) so every graph in
+        a shape bucket shares ONE finish shape: :meth:`precompile` covers
+        it deterministically, and no survivor set can overflow it below
+        the cap. Only past the gather budget (m_pad > 2^25-class graphs,
+        where the capacity-guard levels run first anyway) does the width
+        fall back to the measured survivor bucket — one extra compile
+        that is noise next to a solve at that scale."""
+        spec = min(
+            max(_bucket_size(m_pad // self.n_dev), 1024),
+            _FINISH_GATHER_MAX_SLOTS // self.n_dev,
+        )
+        if cmax <= spec:
+            return spec
+        BUS.count("lane.finish.overflow")
+        return max(_bucket_size(cmax), 1024)
+
+    # ------------------------------------------------------------------
+    # Donated incremental update
+    # ------------------------------------------------------------------
+    def refresh_resident(self, old_digest: str, new_graph: Graph) -> bool:
+        """Migrate ``old_digest``'s device residency to ``new_graph``
+        (the incremental-update path): the changed rank slots are
+        scattered into the resident ``ra``/``rb`` buffers — DONATED on
+        accelerators, so the update mutates the existing device
+        allocation instead of re-staging the m-sized arrays from host —
+        and the entry re-keys under the new content digest. No solve runs;
+        the next solve on the new digest is dispatch-only.
+
+        Returns ``True`` when residency now covers ``new_graph``. An
+        update that changes the padded shape drops the stale entry
+        (``lane.update.dropped`` — the next solve stages cold); one that
+        dirties more than ``max_update_frac`` of the rank space restages
+        in full (``lane.restage``) — past that the padded scatter loses
+        to one contiguous host->device copy.
+        """
+        res, busy = self._pop_resident(old_digest)
+        if res is None:
+            return False
+        n = new_graph.num_nodes
+        n_pad, m_pad = self.pad_shape(n, new_graph.num_edges)
+        digest = new_graph.digest()
+        if (res.n_pad, res.m_pad) != (n_pad, m_pad) or res.num_nodes != n:
+            BUS.count("lane.update.dropped")
+            return False
+
+        new_ra, new_rb = new_graph.rank_endpoints(pad_to=m_pad)
+        changed = np.nonzero((new_ra != res.ra_np) | (new_rb != res.rb_np))[0]
+        frac = changed.size / max(1, m_pad)
+        BUS.record("lane.update.changed_frac", frac)
+        if frac > self.max_update_frac:
+            BUS.count("lane.restage")
+            with self._admit:
+                self._put_resident(self._stage_resident(new_graph, digest))
+            return True
+
+        with BUS.span(
+            "lane.update", cat="lane", changed=int(changed.size),
+            m_pad=m_pad, devices=self.n_dev,
+        ):
+            if changed.size:
+                # Donate only when no in-flight solve still holds the
+                # popped entry's buffers — a busy entry's solve would
+                # otherwise dispatch on deleted device arrays. The
+                # non-donating variant leaves the old buffers valid (the
+                # reader's ref keeps them alive until it lands).
+                scatter = _make_scatter_update(self.mesh, not busy)
+                # 1024-slot floor: single-edge deltas share one scatter
+                # shape per bucket, which precompile() warms — wider
+                # deltas pay one pow2-width compile each, truthfully
+                # counted compile.miss (docs/SHARDED_LANE.md).
+                bucket = max(1024, _next_pow2(int(changed.size)))
+                _note_dispatch(
+                    ("scatter", m_pad, bucket, not busy, self.n_dev,
+                     self.mesh),
+                    "request",
+                )
+                idx = np.full(bucket, m_pad, dtype=np.int32)  # pads dropped
+                idx[: changed.size] = changed
+                vra = np.zeros(bucket, dtype=np.int32)
+                vrb = np.zeros(bucket, dtype=np.int32)
+                vra[: changed.size] = new_ra[changed]
+                vrb[: changed.size] = new_rb[changed]
+                with self._dispatch:
+                    ra = scatter(res.ra, idx, vra)
+                    rb = scatter(res.rb, idx, vrb)
+            else:
+                ra, rb = res.ra, res.rb
+            # The n-sized level-1 state re-derives on host (two O(n)-ish
+            # passes) and restages replicated — small next to the m-sized
+            # transfer the scatter just avoided.
+            vmin0_np = np.full(n_pad, _INT32_MAX, dtype=np.int32)
+            vmin0_np[:n] = new_graph.first_ranks
+            parent1_np = host_level1(vmin0_np, new_ra, new_rb)
+            rep = NamedSharding(self.mesh, P())
+            fresh = ResidentGraph(
+                digest=digest,
+                num_nodes=n,
+                num_edges=new_graph.num_edges,
+                n_pad=n_pad,
+                m_pad=m_pad,
+                vmin0=_stage(vmin0_np, rep),
+                parent1=_stage(parent1_np, rep),
+                ra=ra,
+                rb=rb,
+                ra_np=new_ra,
+                rb_np=new_rb,
+            )
+        self._put_resident(fresh)
+        BUS.count("lane.update.donated")
+        return True
+
+    def update(
+        self,
+        old_digest: str,
+        new_graph: Graph,
+        *,
+        yield_fn: Optional[Callable[[], None]] = None,
+    ) -> Tuple[np.ndarray, np.ndarray, int]:
+        """Re-solve ``new_graph`` reusing ``old_digest``'s residency
+        through the donated-buffer refresh, then the normal (now
+        dispatch-only) solve path."""
+        self.refresh_resident(old_digest, new_graph)
+        return self.solve(new_graph, yield_fn=yield_fn)
+
+    # ------------------------------------------------------------------
+    # MSTResult surface (what the serving scheduler consumes)
+    # ------------------------------------------------------------------
+    def solve_result(
+        self, graph: Graph, *, yield_fn: Optional[Callable[[], None]] = None
+    ) -> MSTResult:
+        t0 = time.perf_counter()
+        edge_ids, fragment, levels = self.solve(graph, yield_fn=yield_fn)
+        return self._wrap(graph, edge_ids, fragment, levels, t0)
+
+    def update_result(
+        self,
+        old_digest: str,
+        new_graph: Graph,
+        *,
+        yield_fn: Optional[Callable[[], None]] = None,
+    ) -> MSTResult:
+        t0 = time.perf_counter()
+        edge_ids, fragment, levels = self.update(
+            old_digest, new_graph, yield_fn=yield_fn
+        )
+        return self._wrap(new_graph, edge_ids, fragment, levels, t0)
+
+    @staticmethod
+    def _wrap(graph, edge_ids, fragment, levels, t0) -> MSTResult:
+        return MSTResult(
+            graph=graph,
+            edge_ids=edge_ids,
+            num_levels=levels,
+            wall_time_s=time.perf_counter() - t0,
+            backend="sharded_lane",
+            num_components=(
+                int(np.unique(fragment).size) if graph.num_nodes else 0
+            ),
+        )
+
+    # ------------------------------------------------------------------
+    # Warmup
+    # ------------------------------------------------------------------
+    def precompile(self, num_nodes: int, num_edges: int) -> dict:
+        """Warm one mesh-shaped bucket ahead of traffic: solve an inert
+        high-diameter graph padded into the bucket through the exact
+        request path, so the head/finish programs compile now (counted
+        ``compile.warmup``) and the bucket's first real query hits the jit
+        cache. The warm graph is never put in the LRU — warming must not
+        consume residency capacity. Returns a small report dict.
+
+        Coverage is deterministic below the gather budget:
+        :meth:`_finish_width` pins the finish's compact width per shape
+        bucket, so the warm graph and every real graph in the bucket
+        share ONE finish program. Only past the budget (``m_pad > 2^25``-
+        class graphs) does the width fall back to the measured survivor
+        bucket and possibly pay one request-time compile
+        (docs/SHARDED_LANE.md "Warmup coverage").
+        """
+        n_pad, m_pad = self.pad_shape(num_nodes, num_edges)
+        # The warm graph must SURVIVE the head with alive edges or the
+        # finish program stays cold (a monotone-weight path chains all its
+        # level-1 hooks and merges completely). A path whose weights cycle
+        # [1, 100, 1, 50] pairs up locally instead: after levels 1-2 the
+        # fragments are short runs with the 100-edges still crossing, so
+        # the finish compiles on the warmup clock.
+        k = int(min(num_nodes, 32))
+        if k < 2 or num_edges < k - 1:
+            k = max(2, min(num_nodes, num_edges + 1))
+        cycle = (1, 100, 1, 50)
+        warm = Graph.from_edges(
+            num_nodes,
+            [(i, i + 1, cycle[i % 4] * (i + 1)) for i in range(k - 1)],
+        )
+        # Staged at the TARGET bucket's padded shapes — the compile keys
+        # are the padded array shapes, not the warm graph's own sizes.
+        res = self._stage_resident(
+            warm, warm.digest(), pad_shape=(n_pad, m_pad)
+        )
+        self._dispatch_solve(res, warm, phase="warmup", resident=False)
+        # Warm the donated-update scatter at its floor width too: a
+        # single-edge update on this bucket then compiles nothing. The
+        # warm entry is being discarded, so donation consuming its
+        # buffers is fine.
+        scatter = _make_scatter_update(self.mesh, True)
+        _note_dispatch(
+            ("scatter", m_pad, 1024, True, self.n_dev, self.mesh), "warmup"
+        )
+        idx = np.full(1024, m_pad, dtype=np.int32)  # all pads: a no-op write
+        with self._dispatch:
+            scatter(res.ra, idx, np.zeros(1024, dtype=np.int32))
+        return {"bucket": (n_pad, m_pad), "devices": self.n_dev}

@@ -33,7 +33,9 @@ device dispatches), else sequentially.
 
 from __future__ import annotations
 
+import contextlib
 import threading
+import time
 from typing import List, Optional, Sequence, Tuple
 
 from distributed_ghs_implementation_tpu.api import MSTResult, minimum_spanning_forest
@@ -62,6 +64,60 @@ class _Flight:
         self.error: Optional[BaseException] = None
 
 
+class PriorityGate:
+    """Two-class priority over the shared device: bulk yields to interactive.
+
+    A bulk mesh solve (oversize → the sharded lane) is seconds of work; an
+    interactive small-graph miss is milliseconds. Without priority, one
+    RMAT-24 in flight starves every small query behind it. The gate is the
+    minimal mechanism that prevents that:
+
+    * interactive misses run inside :meth:`interactive` — a pending-count
+      context the solve holds for its duration;
+    * bulk solves call :meth:`checkpoint` between device dispatches (the
+      stepped-solve boundaries ``parallel/lane.py`` exposes): while
+      interactive work is pending, the bulk solve PAUSES — bounded by
+      ``max_pause_s`` per checkpoint, so a steady interactive stream delays
+      bulk work rather than deadlocking it.
+
+    Telemetry: ``serve.gate.yields`` counts checkpoints that actually
+    paused; ``serve.gate.bulk_pause_s`` records how long — the receipts
+    behind "interactive p99 protected under concurrent bulk load"
+    (``tools/load_drill.py --oversize-heavy``).
+    """
+
+    def __init__(self, max_pause_s: float = 5.0):
+        self.max_pause_s = max_pause_s
+        self._cv = threading.Condition()
+        self._pending = 0
+
+    @contextlib.contextmanager
+    def interactive(self):
+        with self._cv:
+            self._pending += 1
+        try:
+            yield
+        finally:
+            with self._cv:
+                self._pending -= 1
+                if self._pending <= 0:
+                    self._cv.notify_all()
+
+    def checkpoint(self) -> None:
+        """Bulk-side yield point: wait out pending interactive work."""
+        t0 = time.monotonic()
+        with self._cv:
+            while (
+                self._pending > 0
+                and time.monotonic() - t0 < self.max_pause_s
+            ):
+                self._cv.wait(timeout=0.05)
+        paused = time.monotonic() - t0
+        if paused >= 0.002:
+            BUS.count("serve.gate.yields")
+            BUS.record("serve.gate.bulk_pause_s", paused)
+
+
 class SolveScheduler:
     """Cache-fronted, single-flight, capacity-bounded solve dispatch."""
 
@@ -73,16 +129,34 @@ class SolveScheduler:
         max_concurrent: int = 2,
         supervisor_config=None,
         batch_engine=None,
+        sharded_lane=None,
     ):
         if max_concurrent < 1:
             raise ValueError(f"max_concurrent must be >= 1, got {max_concurrent}")
         self.store = store if store is not None else ResultStore()
         self.backend = backend
         self.batch_engine = batch_engine
+        # sharded_lane (a parallel.lane.ShardedLane) opens the oversize
+        # route: device-backend misses past the batch policy's admission
+        # ceiling run on the mesh instead of bypassing to the semaphore
+        # path — where one such solve used to hold a max_concurrent slot
+        # for seconds, starving interactive misses behind it.
+        self.sharded_lane = sharded_lane
+        self.gate = PriorityGate()
         self._supervisor_config = supervisor_config
         self._sem = threading.BoundedSemaphore(max_concurrent)
         self._flights: dict = {}
         self._lock = threading.Lock()
+        # The oversize decision is the batch policy's admission rule even
+        # when no engine is attached (one rule set, batch/policy.py).
+        if batch_engine is not None:
+            self._route_policy = batch_engine.policy
+        else:
+            from distributed_ghs_implementation_tpu.batch.policy import (
+                BatchPolicy,
+            )
+
+            self._route_policy = BatchPolicy()
 
     def solve(
         self, graph: Graph, *, backend: Optional[str] = None
@@ -221,27 +295,67 @@ class SolveScheduler:
             BUS.sample("serve.queue.depth", len(self._flights))
         flight.event.set()
 
+    def _route(self, graph: Graph, backend: str) -> str:
+        """One solve's route: ``"batch"`` (engine-admitted), ``"direct"``
+        (small graph on the semaphore path), ``"sharded_lane"``, or
+        ``"bypass"`` (oversize without a usable mesh lane)."""
+        if backend != "device":
+            return "direct"
+        route = self._route_policy.route(
+            graph,
+            sharded_available=(
+                self.sharded_lane is not None
+                and self.sharded_lane.admits(graph)
+            ),
+        )
+        if route == "lane":
+            return "batch" if self.batch_engine is not None else "direct"
+        return route
+
     def _solve_miss(self, graph: Graph, backend: str) -> MSTResult:
-        """One cache miss: batch-engine submission (device backend) or a
-        semaphore-bounded supervised solve. Graphs the engine's policy
-        would bypass anyway (oversize) stay on the semaphore path — the
-        engine only replaces the admission bound for solves it actually
-        queues and serializes."""
-        if (
-            self.batch_engine is not None
-            and backend == "device"
-            and self.batch_engine.policy.admits(graph)
-        ):
-            with BUS.span(
+        """One cache miss, routed: batch-engine submission (admitted,
+        device backend), the mesh-sharded lane (oversize with a lane
+        attached — ``parallel/lane.py``), or a semaphore-bounded
+        supervised solve (small graphs without an engine, non-device
+        backends, and the oversize BYPASS when no lane is attached).
+        Oversize spans carry ``route`` (``sharded_lane`` vs ``bypass``) so
+        SLO summaries can tell the two oversize paths apart; interactive
+        (non-oversize) solves register with the priority gate the bulk
+        lane yields to."""
+        route = self._route(graph, backend)
+        if route == "batch":
+            with self.gate.interactive(), BUS.span(
                 "serve.solve", cat="serve", backend="batch",
                 nodes=graph.num_nodes, edges=graph.num_edges, **_cls_args(),
             ):
                 return self.batch_engine.submit(graph).wait()
-        with self._sem:
+        if route == "sharded_lane":
+            BUS.count("serve.route.sharded_lane")
             with BUS.span(
-                "serve.solve", cat="serve", backend=backend,
-                nodes=graph.num_nodes, edges=graph.num_edges, **_cls_args(),
+                "serve.solve", cat="serve", backend="sharded_lane",
+                route="sharded_lane", nodes=graph.num_nodes,
+                edges=graph.num_edges, **_cls_args(),
             ):
+                # Bulk class: no semaphore slot held (interactive misses
+                # must not queue behind a bulk solve), one mesh solve in
+                # flight at a time inside the lane, yielding to pending
+                # interactive work at every stepped-solve boundary.
+                return self.sharded_lane.solve_result(
+                    graph, yield_fn=self.gate.checkpoint
+                )
+        span_args = dict(
+            backend=backend, nodes=graph.num_nodes, edges=graph.num_edges,
+            **_cls_args(),
+        )
+        if route == "bypass":
+            BUS.count("serve.route.bypass")
+            span_args["route"] = "bypass"
+        gate = (
+            self.gate.interactive() if route == "direct"
+            else contextlib.nullcontext()
+        )
+        with gate, self._sem:
+            with BUS.span("serve.solve", cat="serve", **span_args):
                 return minimum_spanning_forest(
                     graph, backend=backend, supervised=True,
                     supervisor=self._make_supervisor(),
@@ -250,13 +364,30 @@ class SolveScheduler:
     def _solve_misses(
         self, graphs: List[Graph], backend: str
     ) -> List[MSTResult]:
-        """The distinct misses of one batch, as a group."""
+        """The distinct misses of one batch, as a group: engine-admitted
+        misses coalesce into device batches; sharded-lane-routed oversize
+        misses peel off to the mesh (the engine would bypass them to the
+        slow single-graph path otherwise)."""
         if self.batch_engine is not None and backend == "device":
-            with BUS.span(
-                "serve.solve", cat="serve", backend="batch",
-                misses=len(graphs), **_cls_args(),
-            ):
-                return self.batch_engine.solve_many(graphs)
+            lane_set = {
+                i for i, g in enumerate(graphs)
+                if self._route(g, backend) == "sharded_lane"
+            }
+            results: List[Optional[MSTResult]] = [None] * len(graphs)
+            rest = [i for i in range(len(graphs)) if i not in lane_set]
+            if rest:
+                with BUS.span(
+                    "serve.solve", cat="serve", backend="batch",
+                    misses=len(rest), **_cls_args(),
+                ):
+                    solved = self.batch_engine.solve_many(
+                        [graphs[i] for i in rest]
+                    )
+                for i, result in zip(rest, solved):
+                    results[i] = result
+            for i in sorted(lane_set):
+                results[i] = self._solve_miss(graphs[i], backend)
+            return results  # type: ignore[return-value]
         return [self._solve_miss(g, backend) for g in graphs]
 
     # ------------------------------------------------------------------

@@ -67,6 +67,7 @@ class MSTService:
         batch_lanes: int = 0,
         batch_wait_s: Optional[float] = None,
         warmup=None,
+        sharded_lane=False,
     ):
         self.store = store if store is not None else ResultStore(
             capacity=store_capacity, disk_dir=disk_dir
@@ -85,9 +86,25 @@ class MSTService:
             if batch_wait_s is not None:
                 policy_kwargs["max_wait_s"] = batch_wait_s
             engine = BatchEngine(policy=BatchPolicy(**policy_kwargs))
+        # sharded_lane opens the oversize route: device-backend misses past
+        # the batch admission ceiling run on a mesh (parallel/lane.py —
+        # device-resident LRU, donated updates) instead of bypassing to
+        # the single-device path. True = all devices; an int = that many.
+        lane = None
+        if sharded_lane:
+            from distributed_ghs_implementation_tpu.parallel.lane import (
+                ShardedLane,
+            )
+            from distributed_ghs_implementation_tpu.parallel.mesh import (
+                edge_mesh,
+            )
+
+            num = None if sharded_lane is True else int(sharded_lane)
+            lane = ShardedLane(edge_mesh(num_devices=num))
+        self.sharded_lane = lane
         self.scheduler = SolveScheduler(
             self.store, backend=backend, max_concurrent=max_concurrent,
-            batch_engine=engine,
+            batch_engine=engine, sharded_lane=lane,
         )
         self.backend = backend
         self.resolve_threshold = resolve_threshold
@@ -132,7 +149,10 @@ class MSTService:
                 warmup, buckets=shapes, keys=(), lanes=batch_lanes,
                 mode=engine.policy.mode if engine else "fused",
             )
-            self.warmup_report = run_warmup(warmup)
+            # Mesh-shaped buckets warm on the sharded lane (the oversize
+            # path's AOT coverage); without a lane they are skipped, the
+            # same way oversize shape buckets skip the fused kernel warm.
+            self.warmup_report = run_warmup(warmup, lane=lane)
 
     # ------------------------------------------------------------------
     def handle(self, request: dict) -> dict:
@@ -237,6 +257,13 @@ class MSTService:
         # graph, and the updated result is cached for future solve requests.
         del self._sessions[digest]
         self._sessions[new_digest] = session
+        if self.sharded_lane is not None:
+            # Migrate any device residency along the digest chain: the
+            # changed rank slots scatter into the resident (donated)
+            # buffers, so a later re-solve of the updated oversize graph
+            # stays dispatch-only. A no-op unless the old digest was
+            # actually resident on the mesh.
+            self.sharded_lane.refresh_resident(digest, result.graph)
         # Cache under the backend the session's solves used (a client pinned
         # to a non-default backend must hit this entry on its next solve).
         self.store.put(
@@ -257,7 +284,7 @@ class MSTService:
         counters = {
             name: value
             for name, value in BUS.counters().items()
-            if name.startswith(("serve.", "batch.", "compile."))
+            if name.startswith(("serve.", "batch.", "compile.", "lane."))
         }
         out = {
             "ok": True,

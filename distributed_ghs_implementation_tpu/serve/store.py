@@ -53,7 +53,11 @@ def solve_cache_key(graph: Graph, *, backend: str = "device") -> str:
     ``backend`` is the *requested* entry (e.g. ``"device"``), not the rung a
     supervised solve eventually lands on — a degraded result is still the
     exact MSF (every rung computes the identical forest), so it may serve
-    later requests for the same entry.
+    later requests for the same entry. The same holds for the oversize
+    route: a ``"device"`` request the scheduler sends to the mesh-sharded
+    lane (``parallel/lane.py``) caches under its requested ``"device"``
+    key, so the repeat query is a hit regardless of which path solved it
+    (tests/test_lane.py pins the memory and disk round trips).
     """
     return f"{graph.digest()}:{backend}"
 

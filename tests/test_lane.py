@@ -1,0 +1,504 @@
+"""Sharded big-graph lane (parallel/lane.py) + oversize serve routing.
+
+The round-13 acceptance in code: an oversize query through the serving
+stack executes on the mesh (8-virtual-device dryrun here) edge-for-edge
+equal to the single-device solver; a repeat solve / incremental update on
+a resident graph performs no host re-staging or resharding (asserted via
+the ``lane.*`` obs counters); and interactive traffic is protected from
+bulk solves by the scheduler's two-class priority gate.
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from distributed_ghs_implementation_tpu.api import minimum_spanning_forest
+from distributed_ghs_implementation_tpu.graphs.edgelist import Graph
+from distributed_ghs_implementation_tpu.graphs.generators import (
+    gnm_random_graph,
+)
+from distributed_ghs_implementation_tpu.obs.events import BUS
+from distributed_ghs_implementation_tpu.parallel.lane import (
+    ShardedLane,
+    _reset_shape_ledger,
+)
+from distributed_ghs_implementation_tpu.utils.verify import verify_result
+
+# Oversize by NODE bucket (2^16 < 70000's bucket) with few edges: routes
+# like a billion-edge graph, solves in test time.
+OVERSIZE_NODES = 70_000
+OVERSIZE_EDGES = 3_000
+
+
+def _edges(g):
+    return [[int(a), int(b), int(c)] for a, b, c in zip(g.u, g.v, g.w)]
+
+
+def _oversize_graph(seed):
+    return gnm_random_graph(OVERSIZE_NODES, OVERSIZE_EDGES, seed=seed)
+
+
+@pytest.fixture(autouse=True)
+def _bus():
+    BUS.enable()
+    BUS.clear()
+    yield
+
+
+def _lane_solve_spans():
+    return sum(1 for e in BUS.events() if e[1] == "lane.solve")
+
+
+# ----------------------------------------------------------------------
+# Parity
+# ----------------------------------------------------------------------
+@pytest.mark.parametrize("seed", range(3))
+def test_lane_matches_device_exactly(seed):
+    lane = ShardedLane()
+    g = gnm_random_graph(300, 900, seed=seed)
+    ids, frag, lv = lane.solve(g)
+    ref = minimum_spanning_forest(g, backend="device")
+    assert np.array_equal(ids, ref.edge_ids)
+    assert np.unique(frag).size == ref.num_components
+    assert verify_result(ref, oracle="scipy").ok
+
+
+def test_lane_disconnected_and_trivial():
+    lane = ShardedLane()
+    g = Graph.from_edges(9, [(0, 1, 1), (1, 2, 2), (3, 4, 1), (4, 5, 5)])
+    ids, frag, _ = lane.solve(g)
+    assert len(ids) == 4
+    assert np.unique(frag).size == 5
+    ids0, frag0, lv0 = lane.solve(Graph.from_edges(3, []))
+    assert ids0.size == 0 and frag0.size == 3 and lv0 == 0
+
+
+def test_lane_oversize_parity():
+    lane = ShardedLane()
+    g = _oversize_graph(5)
+    ids, _, _ = lane.solve(g)
+    ref = minimum_spanning_forest(g, backend="device")
+    assert np.array_equal(ids, ref.edge_ids)
+
+
+# ----------------------------------------------------------------------
+# Residency: warm re-solve is dispatch-only
+# ----------------------------------------------------------------------
+def test_warm_resolve_skips_restaging():
+    lane = ShardedLane()
+    g = gnm_random_graph(400, 1600, seed=3)
+    ids1, _, _ = lane.solve(g)
+    stage_spans = sum(1 for e in BUS.events() if e[1] == "lane.stage")
+    ids2, _, _ = lane.solve(g)
+    assert np.array_equal(ids1, ids2)
+    c = BUS.counters()
+    assert c.get("lane.resident.hit") == 1
+    assert c.get("lane.resident.miss") == 1
+    assert c.get("lane.reshard.skipped") == 1
+    # No second lane.stage span: the m-sized arrays were not re-staged.
+    assert sum(1 for e in BUS.events() if e[1] == "lane.stage") == stage_spans
+
+
+def test_residency_lru_bounded():
+    lane = ShardedLane(capacity=2)
+    graphs = [gnm_random_graph(200, 600, seed=s) for s in range(3)]
+    for g in graphs:
+        lane.solve(g)
+    assert len(lane.resident_digests()) == 2
+    assert BUS.counters().get("lane.resident.evict") == 1
+    # The evicted (oldest) graph restages on its next solve.
+    lane.solve(graphs[0])
+    assert BUS.counters().get("lane.resident.miss") == 4
+
+
+# ----------------------------------------------------------------------
+# Donated incremental updates
+# ----------------------------------------------------------------------
+def test_update_donated_reweight_parity():
+    lane = ShardedLane()
+    g = gnm_random_graph(400, 1600, seed=7)
+    lane.solve(g)
+    edges = _edges(g)
+    edges[10][2] += 1  # small rank shift: the donated-scatter regime
+    g2 = Graph.from_edges(g.num_nodes, edges)
+    ids, _, _ = lane.update(g.digest(), g2)
+    ref = minimum_spanning_forest(g2, backend="device")
+    assert np.array_equal(ids, ref.edge_ids)
+    c = BUS.counters()
+    assert c.get("lane.update.donated") == 1
+    assert c.get("lane.restage") is None
+    # The refresh + solve path never re-staged the m-sized arrays.
+    assert c.get("lane.reshard.skipped") == 1  # the post-refresh solve
+    assert lane.resident_digests() == [g2.digest()]
+
+
+def test_update_delete_and_heavy_insert_parity():
+    lane = ShardedLane()
+    g = gnm_random_graph(400, 1600, seed=8)
+    lane.solve(g)
+    # Heavy insert: lands at the top of the rank order, shifting nothing.
+    edges = _edges(g) + [[0, 399, 10_000]]
+    g2 = Graph.from_edges(g.num_nodes, edges)
+    ids, _, _ = lane.update(g.digest(), g2)
+    assert np.array_equal(
+        ids, minimum_spanning_forest(g2, backend="device").edge_ids
+    )
+    # Delete the edge again (same bucket, small shift).
+    g3 = Graph.from_edges(g.num_nodes, _edges(g2)[:-1])
+    ids3, _, _ = lane.update(g2.digest(), g3)
+    assert np.array_equal(
+        ids3, minimum_spanning_forest(g3, backend="device").edge_ids
+    )
+    assert BUS.counters().get("lane.update.donated") == 2
+
+
+def test_update_wide_delta_restages_exactly():
+    """Reversing the weight order moves (almost) every rank slot: past
+    max_update_frac the refresh restages in full — still exact, counted
+    ``lane.restage``."""
+    lane = ShardedLane()
+    g = gnm_random_graph(400, 1600, seed=9)
+    lane.solve(g)
+    top = int(g.w.max()) + 1
+    edges = [[u, v, top - w] for u, v, w in _edges(g)]  # rank order reversed
+    g2 = Graph.from_edges(g.num_nodes, edges)
+    ids, _, _ = lane.update(g.digest(), g2)
+    assert np.array_equal(
+        ids, minimum_spanning_forest(g2, backend="device").edge_ids
+    )
+    c = BUS.counters()
+    assert c.get("lane.restage") == 1
+    assert c.get("lane.update.donated") is None
+
+
+def test_update_bucket_change_drops_residency():
+    lane = ShardedLane()
+    g = gnm_random_graph(100, 300, seed=4)
+    lane.solve(g)
+    # Enough inserts to cross the edge bucket: residency is dropped, the
+    # next solve stages cold (and is still exact).
+    extra = [[i, i + 50, 1000 + i] for i in range(40)]
+    g2 = Graph.from_edges(g.num_nodes, _edges(g) + extra)
+    assert lane.pad_shape(g2.num_nodes, g2.num_edges) != lane.pad_shape(
+        g.num_nodes, g.num_edges
+    )
+    ids, _, _ = lane.update(g.digest(), g2)
+    assert np.array_equal(
+        ids, minimum_spanning_forest(g2, backend="device").edge_ids
+    )
+    assert BUS.counters().get("lane.update.dropped") == 1
+
+
+def test_refresh_while_entry_in_use_keeps_old_buffers_valid():
+    """A refresh racing an in-flight solve must not donate the buffers
+    that solve still holds: with the entry checked out, the non-donating
+    scatter runs and the old device arrays stay readable."""
+    lane = ShardedLane()
+    g = gnm_random_graph(300, 900, seed=13)
+    lane.solve(g)
+    digest = g.digest()
+    res = lane._get_resident(digest, checkout=True)  # simulated reader
+    try:
+        edges = _edges(g)
+        edges[5][2] += 1
+        g2 = Graph.from_edges(g.num_nodes, edges)
+        assert lane.refresh_resident(digest, g2)
+        # The reader's buffers were not consumed.
+        assert np.asarray(res.ra).shape[0] == res.m_pad
+        ids, _, _ = lane.solve(g2)
+        assert np.array_equal(
+            ids, minimum_spanning_forest(g2, backend="device").edge_ids
+        )
+    finally:
+        lane._release(digest)
+    assert not lane._in_use
+
+
+def test_concurrent_distinct_solves_are_admission_bounded():
+    """Distinct oversize misses must queue on the lane's admission bound
+    (staging included), and all land exactly."""
+    lane = ShardedLane(max_in_flight=2)
+    graphs = [gnm_random_graph(250, 800, seed=40 + s) for s in range(4)]
+    results = [None] * len(graphs)
+
+    def solve_one(i):
+        results[i] = lane.solve(graphs[i])[0]
+
+    threads = [
+        threading.Thread(target=solve_one, args=(i,))
+        for i in range(len(graphs))
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(60)
+    for g, ids in zip(graphs, results):
+        assert ids is not None
+        assert np.array_equal(
+            ids, minimum_spanning_forest(g, backend="device").edge_ids
+        )
+    assert not lane._in_use  # every checkout released
+
+
+# ----------------------------------------------------------------------
+# Warmup: zero request-time compiles on the oversize path
+# ----------------------------------------------------------------------
+def test_precompile_covers_request_shapes():
+    _reset_shape_ledger()
+    lane = ShardedLane()
+    lane.precompile(2000, 6000)
+    miss0 = BUS.counters().get("compile.miss", 0)
+    g = gnm_random_graph(2000, 6000, seed=11)
+    ids, _, _ = lane.solve(g)
+    assert np.array_equal(
+        ids, minimum_spanning_forest(g, backend="device").edge_ids
+    )
+    assert BUS.counters().get("compile.miss", 0) == miss0
+    assert BUS.counters().get("compile.warmup", 0) >= 1
+
+
+def test_warmup_plan_mesh_buckets():
+    from distributed_ghs_implementation_tpu.batch.warmup import (
+        WarmupPlan,
+        merge_plans,
+        parse_mesh_bucket_list,
+        plan_from_flags,
+        run_warmup,
+    )
+
+    assert parse_mesh_bucket_list("70000x140000, 500x1500,70000x140000") == [
+        (70000, 140000), (500, 1500),
+    ]
+    plan = plan_from_flags(mesh_buckets="500x1500")
+    assert plan.mesh_buckets == ((500, 1500),)
+    merged = merge_plans(
+        WarmupPlan(buckets=((64, 256),), lanes=4),
+        WarmupPlan(mesh_buckets=((500, 1500),)),
+    )
+    assert merged.buckets == ((64, 256),)
+    assert merged.mesh_buckets == ((500, 1500),)
+    # Without a lane the mesh buckets are declared-but-unreachable.
+    report = run_warmup(WarmupPlan(mesh_buckets=((500, 1500),)))
+    assert report["mesh_skipped"] == 1 and report["mesh_warmed"] == 0
+    report = run_warmup(
+        WarmupPlan(mesh_buckets=((500, 1500),)), lane=ShardedLane()
+    )
+    assert report["mesh_warmed"] == 1
+
+
+# ----------------------------------------------------------------------
+# Scheduler routing + the store contract
+# ----------------------------------------------------------------------
+def test_scheduler_routes_oversize_to_lane_and_caches():
+    from distributed_ghs_implementation_tpu.serve.service import MSTService
+
+    svc = MSTService(batch_lanes=4, sharded_lane=True)
+    g = _oversize_graph(21)
+    req = {"op": "solve", "num_nodes": g.num_nodes, "edges": _edges(g),
+           "slo_class": "oversize"}
+    r1 = svc.handle(req)
+    assert r1["ok"] and r1["backend"] == "sharded_lane"
+    assert r1["source"] == "solved"
+    assert r1["total_weight"] == minimum_spanning_forest(g).total_weight
+    assert BUS.counters().get("serve.route.sharded_lane") == 1
+    # Route arg on the serve.solve span (bypass vs sharded_lane).
+    routes = [
+        e[6]["route"] for e in BUS.events()
+        if e[1] == "serve.solve" and e[6] and "route" in e[6]
+    ]
+    assert routes == ["sharded_lane"]
+
+    # Satellite (serve/store.py): the sharded result is cached under the
+    # same Graph.digest() contract — the second query is a store hit with
+    # NO second mesh dispatch.
+    spans = _lane_solve_spans()
+    r2 = svc.handle(req)
+    assert r2["cached"] is True and r2["source"] == "cache"
+    assert _lane_solve_spans() == spans
+
+
+def test_sharded_result_disk_cache_round_trip(tmp_path):
+    """Oversize miss -> sharded solve -> a RESTARTED service (fresh memory,
+    shared disk store) answers the repeat from disk, zero mesh dispatches."""
+    from distributed_ghs_implementation_tpu.serve.service import MSTService
+
+    disk = str(tmp_path / "store")
+    g = _oversize_graph(22)
+    req = {"op": "solve", "num_nodes": g.num_nodes, "edges": _edges(g)}
+    svc1 = MSTService(sharded_lane=True, disk_dir=disk)
+    r1 = svc1.handle(req)
+    assert r1["ok"] and r1["backend"] == "sharded_lane"
+
+    svc2 = MSTService(sharded_lane=True, disk_dir=disk)
+    spans = _lane_solve_spans()
+    r2 = svc2.handle(req)
+    assert r2["ok"] and r2["cached"] is True
+    assert r2["total_weight"] == r1["total_weight"]
+    assert _lane_solve_spans() == spans
+    assert BUS.counters().get("serve.store.disk_hit", 0) >= 1
+
+
+def test_service_update_migrates_residency():
+    from distributed_ghs_implementation_tpu.serve.service import MSTService
+
+    svc = MSTService(sharded_lane=True)
+    g = _oversize_graph(23)
+    r1 = svc.handle(
+        {"op": "solve", "num_nodes": g.num_nodes, "edges": _edges(g)}
+    )
+    assert r1["ok"]
+    assert svc.sharded_lane.resident_digests() == [r1["digest"]]
+    up = svc.handle({
+        "op": "update", "digest": r1["digest"],
+        "updates": [{"kind": "insert", "u": 0, "v": 1, "w": 10_000}],
+    })
+    assert up["ok"]
+    # Residency followed the digest chain without a mesh solve.
+    assert svc.sharded_lane.resident_digests() == [up["digest"]]
+
+
+def test_scheduler_bypass_without_lane():
+    from distributed_ghs_implementation_tpu.serve.service import MSTService
+
+    svc = MSTService()
+    g = _oversize_graph(24)
+    r = svc.handle(
+        {"op": "solve", "num_nodes": g.num_nodes, "edges": _edges(g)}
+    )
+    assert r["ok"] and r["backend"].startswith("supervised/")
+    assert BUS.counters().get("serve.route.bypass") == 1
+    routes = [
+        e[6]["route"] for e in BUS.events()
+        if e[1] == "serve.solve" and e[6] and "route" in e[6]
+    ]
+    assert routes == ["bypass"]
+
+
+def test_solve_batch_peels_oversize_to_lane():
+    from distributed_ghs_implementation_tpu.serve.service import MSTService
+
+    svc = MSTService(batch_lanes=4, sharded_lane=True)
+    small = [gnm_random_graph(128, 400, seed=s) for s in range(3)]
+    big = _oversize_graph(25)
+    results = svc.scheduler.solve_batch(small + [big])
+    assert [r.backend for r, _ in results[:3]] == ["batch/fused"] * 3
+    assert results[3][0].backend == "sharded_lane"
+    for g, (r, _) in zip(small + [big], results):
+        assert np.array_equal(
+            r.edge_ids, minimum_spanning_forest(g).edge_ids
+        )
+
+
+# ----------------------------------------------------------------------
+# Two-class priority gate
+# ----------------------------------------------------------------------
+def test_priority_gate_bulk_yields_to_interactive():
+    from distributed_ghs_implementation_tpu.serve.scheduler import PriorityGate
+
+    gate = PriorityGate(max_pause_s=5.0)
+    order = []
+    release = threading.Event()
+
+    def interactive_work():
+        with gate.interactive():
+            release.wait(2.0)
+            order.append("interactive")
+
+    t = threading.Thread(target=interactive_work)
+    t.start()
+    time.sleep(0.05)  # the interactive solve is pending now
+
+    def bulk_work():
+        gate.checkpoint()  # must pause until interactive lands
+        order.append("bulk")
+
+    b = threading.Thread(target=bulk_work)
+    b.start()
+    time.sleep(0.1)
+    assert order == []  # bulk is paused at the checkpoint
+    release.set()
+    t.join(5)
+    b.join(5)
+    assert order == ["interactive", "bulk"]
+    assert BUS.counters().get("serve.gate.yields", 0) >= 1
+
+
+def test_priority_gate_pause_is_bounded():
+    from distributed_ghs_implementation_tpu.serve.scheduler import PriorityGate
+
+    gate = PriorityGate(max_pause_s=0.2)
+    ctx = gate.interactive()
+    ctx.__enter__()  # a pending interactive solve that never finishes
+    try:
+        t0 = time.monotonic()
+        gate.checkpoint()
+        assert 0.15 <= time.monotonic() - t0 < 2.0  # bounded, not deadlocked
+    finally:
+        ctx.__exit__(None, None, None)
+
+
+# ----------------------------------------------------------------------
+# Fleet: oversize digests land on mesh-owning workers
+# ----------------------------------------------------------------------
+def test_router_oversize_constants_match_policy():
+    """Drift guard: the router's jax-free mirror of the admission ceiling
+    must equal the real BatchPolicy defaults."""
+    from distributed_ghs_implementation_tpu.batch.policy import BatchPolicy
+    from distributed_ghs_implementation_tpu.fleet import router
+
+    policy = BatchPolicy()
+    assert router._OVERSIZE_NODE_BUCKET == policy.max_bucket_nodes
+    assert router._OVERSIZE_EDGE_BUCKET == policy.max_bucket_edges
+
+
+def test_router_request_oversize_predicate():
+    from distributed_ghs_implementation_tpu.fleet.router import (
+        _request_oversize,
+    )
+
+    assert _request_oversize(
+        {"op": "solve", "num_nodes": 70_000, "edges": [[0, 1, 1]]}
+    )
+    assert not _request_oversize(
+        {"op": "solve", "num_nodes": 128, "edges": [[0, 1, 1]]}
+    )
+    assert not _request_oversize({"op": "update", "digest": "x"})
+    assert not _request_oversize({"op": "solve", "graph_path": "g.npz"})
+
+
+def test_fleet_routes_oversize_to_lane_workers():
+    """Echo fleet: worker 0 owns the lane; every oversize digest must land
+    there while small digests spread over the full ring."""
+    from distributed_ghs_implementation_tpu.fleet.router import (
+        FleetConfig,
+        FleetRouter,
+    )
+
+    config = FleetConfig(
+        workers=3, test_echo=True, sharded_lane_workers=1,
+        ready_timeout_s=30.0,
+    )
+    with FleetRouter(config) as router:
+        stats = router.handle({"op": "stats"})
+        assert stats["workers"]["0"]["lane"] is True
+        assert stats["workers"]["1"]["lane"] is False
+        oversize_workers = set()
+        for i in range(6):
+            r = router.handle({
+                "op": "solve", "num_nodes": 70_000,
+                "edges": [[0, i + 1, i + 1]],
+            })
+            assert r["ok"]
+            oversize_workers.add(r["worker"])
+        assert oversize_workers == {0}
+        small_workers = set()
+        for i in range(24):
+            r = router.handle({
+                "op": "solve", "num_nodes": 16, "edges": [[0, i % 15 + 1, i]],
+            })
+            assert r["ok"]
+            small_workers.add(r["worker"])
+        assert len(small_workers) > 1  # the full ring still spreads
+    assert BUS.counters().get("fleet.route.sharded_lane", 0) >= 6

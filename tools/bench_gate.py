@@ -83,6 +83,12 @@ KINDS = {
     "batch_speedup": "throughput",
     "pipeline_speedup": "throughput",
     "lost_accepted": "exact",
+    # gate-sharded-v1 (bench.py --sharded-lane): residency bookkeeping is
+    # deterministic — a warm re-solve that re-staged (or an update that
+    # fell off the donated path) is a regression of the resharding-free
+    # contract, not jitter.
+    "reshard_skipped": "exact",
+    "update_donated": "exact",
     # Fleet drill extras: in a NO-kill fleet baseline these are exact
     # zeros (an unplanned failover is a regression, not jitter); kill-drill
     # reports are never baseline-gated, so nonzero values stay ungated.

@@ -71,6 +71,7 @@ REPORT_SCHEMA = "ghs-load-report-v1"
 WORKLOAD = "gate-load-v1"
 WORKLOAD_FLEET = "gate-fleet-v1"
 WORKLOAD_FLEET_KILL = "gate-fleet-kill-v1"
+WORKLOAD_OVERSIZE = "gate-oversize-v1"
 DEFAULT_BASELINE = os.path.join(
     os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
     "docs",
@@ -163,6 +164,12 @@ def build_deck(args, rng: np.random.Generator):
         "update": max(3, int(15 * scale)),
         "oversize": args.oversize,
     }
+    if args.oversize_heavy:
+        # The bulk-vs-interactive scenario: enough oversize solves that one
+        # is in flight for most of the window, with the interactive classes
+        # arriving concurrently — the drill then checks interactive p99
+        # stays bounded while bulk work runs (docs/SHARDED_LANE.md).
+        counts["oversize"] = max(counts["oversize"], 4)
     schedule: List[Arrival] = []
 
     # hit: repeats over a small pre-solved pool.
@@ -417,6 +424,9 @@ def _run_drill(args, resources: dict) -> dict:
             batch_wait_s=args.batch_wait,
             max_sessions=256,
             store_capacity=max(256, len(schedule)),
+            # Bare --sharded-lane: every worker owns a mesh lane and the
+            # router steers oversize digests at lane workers.
+            sharded_lane_workers=(-1 if args.sharded_lane else 0),
             # The SHARED persistent layer: a restarted worker re-serves its
             # keyspace from disk hits instead of re-solving everything.
             disk_dir=resources["disk_tmp"],
@@ -433,6 +443,8 @@ def _run_drill(args, resources: dict) -> dict:
             batch_wait_s=args.batch_wait,
             max_sessions=256,  # solve seeds must not LRU-evict update sessions
             store_capacity=max(256, len(schedule)),
+            sharded_lane=(True if args.sharded_lane == -1
+                          else max(0, args.sharded_lane)),
         )
 
     # Warm phase: prime every bucket the deck touches (compiles, rank
@@ -618,6 +630,21 @@ def _run_drill(args, resources: dict) -> dict:
             ("zero request-time compiles in the measured window",
              compile_counters.get("compile.miss", 0) == 0),
         ]
+        if args.oversize_heavy:
+            interactive_p99 = max(
+                bus_classes.get(c, {}).get("latency_s", {}).get("p99", 0.0)
+                for c in ("hit", "dup")
+            )
+            checks.append(
+                ("interactive p99 protected under concurrent bulk load",
+                 interactive_p99 <= args.interactive_p99_bound),
+            )
+            if args.sharded_lane:
+                checks.append(
+                    ("oversize solves rode the mesh lane",
+                     serve_counters.get("serve.route.sharded_lane", 0)
+                     >= counts["oversize"]),
+                )
     else:
         checks += [
             ("zero errors beyond session re-subscribes", errors == 0),
@@ -651,7 +678,7 @@ def _run_drill(args, resources: dict) -> dict:
     ok = all(passed for _, passed in checks)
 
     if fleet_router is None:
-        workload = WORKLOAD
+        workload = WORKLOAD_OVERSIZE if args.oversize_heavy else WORKLOAD
     elif args.kill_worker is not None:
         workload = WORKLOAD_FLEET_KILL
     else:
@@ -667,6 +694,9 @@ def _run_drill(args, resources: dict) -> dict:
         "counts": counts,
         "chaos": "off" if args.no_chaos else ("heavy" if args.chaos else "mid"),
     }
+    if args.oversize_heavy:
+        config["oversize_heavy"] = True
+        config["sharded_lane"] = bool(args.sharded_lane)
     if args.fleet:
         config["fleet"] = args.fleet
         config["kill_worker"] = args.kill_worker
@@ -751,6 +781,20 @@ def main(argv=None) -> int:
                    "so open-loop bursts actually share lanes")
     p.add_argument("--oversize", type=int, default=2,
                    help="oversize-bypass queries in the deck")
+    p.add_argument("--oversize-heavy", action="store_true",
+                   help="bulk-vs-interactive scenario (gate-oversize-v1): "
+                   "more oversize solves running concurrently with the "
+                   "interactive classes; checks interactive p99 stays "
+                   "within --interactive-p99-bound while bulk is in flight")
+    p.add_argument("--sharded-lane", type=int, nargs="?", const=-1, default=0,
+                   metavar="N",
+                   help="attach a mesh-sharded oversize lane to the service "
+                   "under test (bare flag = all devices; with --fleet: "
+                   "every worker owns a lane and the router steers "
+                   "oversize digests at lane workers)")
+    p.add_argument("--interactive-p99-bound", type=float, default=8.0,
+                   help="with --oversize-heavy: fail if the hit/dup classes' "
+                   "bus-joined p99 exceeds this while bulk solves run")
     p.add_argument("--workers", type=int, default=16,
                    help="client threads (the open-loop dispatch pool)")
     p.add_argument("--fleet", type=int, default=0, metavar="N",

@@ -16,6 +16,15 @@ Three modes:
   (no ``compile.miss``) — the "zero request-time XLA compiles" acceptance
   from docs/SERVING.md. The report carries the compile counters (CI
   uploads it as the compile-cache stats artifact).
+* ``--sharded-smoke`` — the oversize-path gate (8-device dryrun in CI):
+  start ``ghs serve --sharded-lane --warmup-mesh-buckets`` covering the
+  drill's OVERSIZE shape, drive an oversize deck (miss -> repeat ->
+  distinct miss -> incremental update -> repeat) and assert the solves
+  executed on the mesh (``backend == "sharded_lane"``), repeats were
+  store hits, the update rode the donated-buffer residency path
+  (``lane.update.donated``), and the query phase compiled NOTHING
+  (``compile.miss == 0`` — the same zero-request-time-compiles property,
+  now on the oversize path; docs/SHARDED_LANE.md).
 * default — an in-process replay: a seeded random graph, then ``--updates``
   random insert/delete/reweight requests through :class:`MSTService`, every
   response's MST weight checked against the SciPy oracle on an
@@ -241,6 +250,158 @@ def run_warmup_smoke(args) -> dict:
     }
 
 
+def run_sharded_smoke(args) -> dict:
+    """Oversize deck through ``serve --sharded-lane`` over its JSONL pipes:
+    mesh execution, store hits on repeats, donated-update residency, and
+    zero request-time compiles — all asserted via the ``stats`` op."""
+    from distributed_ghs_implementation_tpu.obs import slo
+
+    nodes, edges_n = args.oversize_nodes, args.oversize_edges
+    g1 = _seed_graph(nodes, edges_n, args.seed)
+    g2 = _seed_graph(nodes, edges_n, args.seed + 1)
+    # The donated-update step needs a TRUE insert (an existing pair would
+    # be a reweight — a wide rank shift that legitimately restages) with a
+    # top weight (new last rank: a one-slot delta).
+    existing = {(int(a), int(b)) for a, b in zip(g2.u, g2.v)}
+    ins_v = next(x for x in range(1, nodes) if (0, x) not in existing)
+    ins = [0, ins_v, 10_000]
+    env = {
+        **os.environ,
+        "JAX_PLATFORMS": os.environ.get("JAX_PLATFORMS", "cpu"),
+    }
+    if "xla_force_host_platform_device_count" not in env.get("XLA_FLAGS", ""):
+        # The dryrun mesh: 8 virtual CPU devices, as in tests/conftest.py.
+        env["XLA_FLAGS"] = (
+            env.get("XLA_FLAGS", "")
+            + " --xla_force_host_platform_device_count=8"
+        ).strip()
+    argv = [
+        sys.executable, "-m", "distributed_ghs_implementation_tpu", "serve",
+        "--sharded-lane",
+        # The REAL generated edge count (ensure_connected can exceed the
+        # requested size) — the warm bucket must be the traffic's bucket.
+        "--warmup-mesh-buckets", f"{g1.num_nodes}x{g1.num_edges}",
+    ]
+    if args.compile_cache_dir:
+        argv += ["--compile-cache-dir", args.compile_cache_dir]
+    proc = subprocess.Popen(
+        argv, stdin=subprocess.PIPE, stdout=subprocess.PIPE, text=True,
+        env=env,
+    )
+
+    acct = slo.ClassStats()
+
+    def roundtrip(request, cls=None):
+        t0 = time.perf_counter()
+        proc.stdin.write(json.dumps(request) + "\n")
+        proc.stdin.flush()
+        line = proc.stdout.readline()
+        if not line:
+            raise RuntimeError("serve process closed its pipe early")
+        response = json.loads(line)
+        if cls:
+            acct.observe(
+                cls, time.perf_counter() - t0, ok=bool(response.get("ok"))
+            )
+        return response
+
+    checks = []
+    counters = {}
+    warmup_report = None
+    stats = {}
+    wall_s = 0.0
+    try:
+        boot = roundtrip({"op": "stats"})  # absorbs boot + mesh warmup
+        checks.append(("serve booted", bool(boot.get("ok"))))
+        warmup_report = boot.get("warmup")
+        checks.append(
+            ("mesh bucket warmed",
+             bool(warmup_report) and warmup_report.get("mesh_warmed", 0) >= 1)
+        )
+        t_run = time.perf_counter()
+        solve1 = {"op": "solve", "num_nodes": g1.num_nodes,
+                  "edges": _graph_edges(g1), "slo_class": "oversize"}
+        first = roundtrip(solve1, "oversize")
+        checks.append(("oversize solve ok", bool(first.get("ok"))))
+        checks.append(
+            ("oversize solve ran on the mesh",
+             first.get("backend") == "sharded_lane")
+        )
+        repeat = roundtrip(solve1, "oversize")
+        checks.append(("repeat is a store hit", repeat.get("cached") is True))
+        second = roundtrip(
+            {"op": "solve", "num_nodes": g2.num_nodes,
+             "edges": _graph_edges(g2), "slo_class": "oversize"},
+            "oversize",
+        )
+        checks.append(
+            ("second oversize solve on the mesh",
+             bool(second.get("ok"))
+             and second.get("backend") == "sharded_lane")
+        )
+        # A top-weight true insert: a one-slot rank delta, i.e. the
+        # donated residency-refresh regime.
+        update = roundtrip(
+            {"op": "update", "digest": second.get("digest"),
+             "updates": [{"kind": "insert",
+                          "u": ins[0], "v": ins[1], "w": ins[2]}],
+             "slo_class": "update"},
+            "update",
+        )
+        checks.append(("update ok", bool(update.get("ok"))))
+        re_solve = roundtrip(
+            {"op": "solve", "num_nodes": g2.num_nodes,
+             "edges": _graph_edges(g2) + [ins],
+             "slo_class": "oversize"},
+            "oversize",
+        )
+        checks.append(
+            ("updated graph answered from the store",
+             re_solve.get("cached") is True
+             and re_solve.get("digest") == update.get("digest"))
+        )
+        stats = roundtrip({"op": "stats"})
+        counters = stats.get("counters", {})
+        wall_s = time.perf_counter() - t_run
+        checks.append(
+            ("update rode the donated residency path",
+             counters.get("lane.update.donated", 0) >= 1)
+        )
+        checks.append(
+            ("oversize routed (serve.route.sharded_lane)",
+             counters.get("serve.route.sharded_lane", 0) >= 2)
+        )
+        checks.append(
+            ("zero request-time compiles on the oversize path",
+             counters.get("compile.miss", 0) == 0)
+        )
+        checks.append(
+            ("warmup compiled the mesh programs",
+             counters.get("compile.warmup", 0) >= 1)
+        )
+        roundtrip({"op": "shutdown"})
+    finally:
+        proc.stdin.close()
+        proc.wait(timeout=180)
+    slo_summary = _slo_section(acct, wall_s, stats)
+    return {
+        "mode": "sharded-smoke",
+        "checks": [{"name": n, "ok": bool(ok)} for n, ok in checks],
+        "slo": slo_summary,
+        "events_dropped": slo_summary["events_dropped"],
+        "dropped_warning": slo_summary["dropped_warning"],
+        "warmup": warmup_report,
+        "compile_counters": {
+            k: v for k, v in counters.items() if k.startswith("compile.")
+        },
+        "lane_counters": {
+            k: v for k, v in counters.items()
+            if k.startswith(("lane.", "serve.route."))
+        },
+        "ok": all(ok for _, ok in checks),
+    }
+
+
 def run_replay(args) -> dict:
     """In-process update-stream replay, every step checked vs the oracle."""
     from distributed_ghs_implementation_tpu.graphs.edgelist import Graph
@@ -340,6 +501,14 @@ def main(argv=None) -> int:
     p.add_argument("--warmup-smoke", action="store_true",
                    help="CI warm-path smoke: serve --warmup-buckets, assert "
                    "zero request-time compiles via compile.* counters")
+    p.add_argument("--sharded-smoke", action="store_true",
+                   help="CI oversize-path smoke: serve --sharded-lane over "
+                   "the 8-device dryrun; oversize deck, store hits, donated "
+                   "update, zero request-time compiles")
+    p.add_argument("--oversize-nodes", type=int, default=70_000,
+                   help="oversize deck shape for --sharded-smoke (node "
+                   "bucket past the lane-admission ceiling)")
+    p.add_argument("--oversize-edges", type=int, default=3_000)
     p.add_argument("--compile-cache-dir",
                    help="persistent compile-cache dir for --warmup-smoke")
     p.add_argument("--chaos", action="store_true",
@@ -356,6 +525,8 @@ def main(argv=None) -> int:
         report = run_smoke(args)
     elif args.warmup_smoke:
         report = run_warmup_smoke(args)
+    elif args.sharded_smoke:
+        report = run_sharded_smoke(args)
     else:
         report = run_replay(args)
     if args.output:
