@@ -239,6 +239,136 @@ def run_batch_bench(args) -> int:
     return 0
 
 
+def run_update_stream_bench(args) -> int:
+    """Streaming-maintenance throughput: windowed batched apply
+    (``stream/window.py``) vs the sequential per-update exchange rules
+    (``serve/dynamic.py``) on one sustained, seeded update stream.
+
+    Both paths consume the IDENTICAL update list against the same seeded
+    graph and must land on the same forest — which must also be
+    edge-for-edge identical to a fresh solve of the final graph (the
+    ``(w, u, v)`` order makes the MSF unique). The headline pair is
+    ``window_updates_per_sec`` vs ``seq_updates_per_sec``; their ratio
+    ``window_speedup`` gates as a throughput floor against
+    ``docs/BENCH_BASELINE_STREAM_BENCH.json`` (``gate-stream-bench-v1``).
+    The windowed target from ROADMAP item 4: >= 5x at window size >= 64.
+    """
+    import numpy as np
+
+    from distributed_ghs_implementation_tpu.api import minimum_spanning_forest
+    from distributed_ghs_implementation_tpu.graphs.generators import (
+        gnm_random_graph,
+    )
+    from distributed_ghs_implementation_tpu.models.boruvka import solve_graph
+    from distributed_ghs_implementation_tpu.serve.dynamic import DynamicMST
+    from distributed_ghs_implementation_tpu.stream.window import (
+        WindowedMST,
+        random_update_stream,
+        warm_window_kernels,
+    )
+
+    n, m = args.stream_nodes, args.stream_edges
+    total, window = args.stream_updates, args.stream_window
+    g = gnm_random_graph(n, m, seed=SEED)
+    rng = np.random.default_rng(SEED)
+    seed_result = minimum_spanning_forest(g)
+
+    # One fixed update list both paths consume: the shared seeded
+    # generator (also the load drill's published-window workload) —
+    # path-independent, so sequential and windowed application see the
+    # same stream.
+    updates = random_update_stream(rng, g, total)
+
+    t0 = time.perf_counter()
+    # Warm both the grown shape and the seed shape: inserts/deletes
+    # roughly cancel, so the measured windows dispatch near next_pow2(m),
+    # not next_pow2(m + total) — an unwarmed bucket would put a jit trace
+    # inside the timed loop.
+    warm_window_kernels(n, m + total)
+    warm_window_kernels(n, m)
+    warmup_s = time.perf_counter() - t0
+    print(f"window-kernel warmup: {warmup_s:.3f}s", file=sys.stderr)
+
+    # Sequential per-update path (the round-8 serving behavior, measured
+    # on DynamicMST itself — apply() never touches the windowed
+    # machinery, so constructing a WindowedMST here would only mislabel
+    # what is timed).
+    seq = DynamicMST(seed_result, resolve_threshold=10**9)
+    t0 = time.perf_counter()
+    for upd in updates:
+        seq.apply([upd])
+    seq_s = time.perf_counter() - t0
+
+    # Windowed batched path.
+    win = WindowedMST(seed_result, resolve_threshold=10**9,
+                      window_resolve_threshold=10**9)
+    t0 = time.perf_counter()
+    modes = {}
+    for lo in range(0, total, window):
+        _result, info = win.apply_window(updates[lo:lo + window])
+        modes[info.mode] = modes.get(info.mode, 0) + 1
+    window_s = time.perf_counter() - t0
+
+    seq_result = seq.result()
+    win_result = win.result()
+    ids_ref, _, _ = solve_graph(win_result.graph)
+    parity_ok = (
+        np.array_equal(seq_result.graph.u, win_result.graph.u)
+        and np.array_equal(seq_result.graph.v, win_result.graph.v)
+        and np.array_equal(seq_result.graph.w, win_result.graph.w)
+        and np.array_equal(
+            np.sort(seq_result.edge_ids), np.sort(win_result.edge_ids)
+        )
+        and np.array_equal(np.sort(win_result.edge_ids), np.sort(ids_ref))
+    )
+    if not parity_ok:
+        print("UPDATE-STREAM PARITY FAILED (windowed vs sequential vs "
+              "fresh solve)", file=sys.stderr)
+        return 1
+
+    seq_ups = total / seq_s
+    win_ups = total / window_s
+    out = {
+        "metric": f"streaming MSF maintenance, gnm({n},{m}), {total} updates"
+        f" in windows of {window}",
+        "value": round(win_ups, 1),
+        "unit": "updates/s (windowed batched)",
+        "seq_updates_per_sec": round(seq_ups, 1),
+        "window_speedup": round(win_ups / seq_ups, 2),
+        "window_size": window,
+        "window_modes": modes,
+        "warmup_s": round(warmup_s, 3),
+        "parity": "edge-exact vs sequential AND fresh solve",
+    }
+    print(json.dumps(out))
+    if args.metrics_out:
+        metrics = {
+            "window_updates_per_sec": win_ups,
+            "seq_updates_per_sec": seq_ups,
+            "window_speedup": win_ups / seq_ups,
+            "window_apply_s": window_s,
+            "seq_apply_s": seq_s,
+            "warmup_s": warmup_s,
+            "mst_weight": int(win_result.graph.w[win_result.edge_ids].sum()),
+            "mst_edges": int(win_result.edge_ids.size),
+        }
+        with open(args.metrics_out, "w") as f:
+            json.dump(
+                {
+                    "schema": "ghs-bench-metrics-v1",
+                    "config": {
+                        "workload": f"update-stream-gnm({n},{m})"
+                        f"-u{total}w{window}-seed{SEED}",
+                    },
+                    "metrics": metrics,
+                },
+                f,
+                indent=2,
+            )
+            f.write("\n")
+    return 0
+
+
 def run_sharded_bench(args) -> int:
     """Oversize-lane serving metrics: cold staging vs warm device-resident
     re-solve on the mesh (``parallel/lane.py``), plus the donated-buffer
@@ -398,7 +528,21 @@ def main(argv=None) -> int:
     p.add_argument("--sharded-nodes", type=int, default=70_000,
                    help="oversize workload nodes for --sharded-lane")
     p.add_argument("--sharded-edges", type=int, default=140_000)
+    p.add_argument(
+        "--update-stream", action="store_true",
+        help="measure streaming MSF maintenance: windowed batched apply "
+        "(stream/window.py) vs the sequential per-update path, edge-exact "
+        "parity enforced (gate-stream-bench-v1)",
+    )
+    p.add_argument("--stream-nodes", type=int, default=1024)
+    p.add_argument("--stream-edges", type=int, default=4096)
+    p.add_argument("--stream-updates", type=int, default=256,
+                   help="updates in the measured stream")
+    p.add_argument("--stream-window", type=int, default=64,
+                   help="updates per committed window (the batching unit)")
     args = p.parse_args(argv)
+    if args.update_stream:
+        return run_update_stream_bench(args)
     if args.sharded_lane:
         return run_sharded_bench(args)
     if args.batch_lanes:

@@ -89,6 +89,12 @@ class WarmupPlan:
     when :func:`run_warmup` is handed a lane, so the first oversize query
     pays zero request-time compiles too. Raw sizes, not padded shapes:
     the lane derives its own mesh-aligned padding.
+
+    ``stream_buckets`` are RAW ``(nodes, edges)`` sizes of subscribed
+    graphs: each warms the windowed-maintenance Borůvka round
+    (``stream/window.py``) at the padded edge buckets a stream of that
+    size dispatches, so the first committed window — and a failover
+    replay — pays no jit tracing either.
     """
 
     buckets: Tuple[Tuple[int, int], ...] = ()
@@ -97,9 +103,15 @@ class WarmupPlan:
     keys: Tuple[SolverKey, ...] = ()
     warm_single: bool = True
     mesh_buckets: Tuple[Tuple[int, int], ...] = ()
+    stream_buckets: Tuple[Tuple[int, int], ...] = ()
 
     def is_empty(self) -> bool:
-        return not self.buckets and not self.keys and not self.mesh_buckets
+        return (
+            not self.buckets
+            and not self.keys
+            and not self.mesh_buckets
+            and not self.stream_buckets
+        )
 
 
 def parse_bucket_list(spec: str) -> List[Tuple[int, int]]:
@@ -247,13 +259,15 @@ def plan_from_flags(
     replay: Optional[str] = None,
     lanes: int = 0,
     mesh_buckets: Optional[str] = None,
+    stream_buckets: Optional[str] = None,
 ) -> Optional[WarmupPlan]:
     """A :class:`WarmupPlan` from the serve-CLI flag surface, or ``None``.
 
     The ONE mapping from ``--warmup-buckets`` / ``--warmup-replay`` /
-    ``--warmup-mesh-buckets`` strings to a plan — shared by ``ghs serve``
-    and every fleet worker (``fleet/worker.py``), so a bucket ladder
-    declared on the router warms identically in all N worker processes.
+    ``--warmup-mesh-buckets`` / ``--warmup-stream-buckets`` strings to a
+    plan — shared by ``ghs serve`` and every fleet worker
+    (``fleet/worker.py``), so a bucket ladder declared on the router warms
+    identically in all N worker processes.
     """
     plans: List[WarmupPlan] = []
     if buckets:
@@ -268,6 +282,14 @@ def plan_from_flags(
                 mesh_buckets=tuple(parse_mesh_bucket_list(mesh_buckets))
             )
         )
+    if stream_buckets:
+        # Same RAW NODESxEDGES surface as mesh buckets: the window kernels
+        # derive their own power-of-two padding.
+        plans.append(
+            WarmupPlan(
+                stream_buckets=tuple(parse_mesh_bucket_list(stream_buckets))
+            )
+        )
     if not plans:
         return None
     return merge_plans(*plans)
@@ -277,6 +299,7 @@ def merge_plans(*plans: WarmupPlan) -> WarmupPlan:
     """Union of several plans (CLI: ``--warmup-buckets`` + ``--warmup-replay``)."""
     buckets: List[Tuple[int, int]] = []
     mesh_buckets: List[Tuple[int, int]] = []
+    stream_buckets: List[Tuple[int, int]] = []
     keys: List[SolverKey] = []
     lanes, mode, warm_single = 0, "fused", True
     for p in plans:
@@ -286,6 +309,9 @@ def merge_plans(*plans: WarmupPlan) -> WarmupPlan:
         for b in p.mesh_buckets:
             if b not in mesh_buckets:
                 mesh_buckets.append(b)
+        for b in p.stream_buckets:
+            if b not in stream_buckets:
+                stream_buckets.append(b)
         for k in p.keys:
             if k not in keys:
                 keys.append(k)
@@ -297,6 +323,7 @@ def merge_plans(*plans: WarmupPlan) -> WarmupPlan:
         buckets=tuple(buckets), lanes=lanes, mode=mode,
         keys=tuple(keys), warm_single=warm_single,
         mesh_buckets=tuple(mesh_buckets),
+        stream_buckets=tuple(stream_buckets),
     )
 
 
@@ -336,6 +363,7 @@ def run_warmup(plan: WarmupPlan, *, lane=None) -> dict:
         "single_warmed": 0,
         "mesh_warmed": 0,
         "mesh_skipped": 0,
+        "stream_warmed": 0,
         "wall_s": 0.0,
     }
     if plan.is_empty():
@@ -380,6 +408,13 @@ def run_warmup(plan: WarmupPlan, *, lane=None) -> dict:
                 continue
             lane.precompile(nodes, edges)
             report["mesh_warmed"] += 1
+        if plan.stream_buckets:
+            from distributed_ghs_implementation_tpu.stream.window import (
+                warm_window_kernels,
+            )
+
+            for nodes, edges in plan.stream_buckets:
+                report["stream_warmed"] += warm_window_kernels(nodes, edges)
         span.set(compiled=report["compiled"], cached=report["cached"])
     report["wall_s"] = time.perf_counter() - t0
     return report

@@ -375,10 +375,13 @@ def _cmd_serve(args) -> int:
             warmup_buckets=args.warmup_buckets,
             warmup_replay=args.warmup_replay,
             warmup_mesh_buckets=args.warmup_mesh_buckets,
+            warmup_stream_buckets=args.warmup_stream_buckets,
             compile_cache_dir=args.compile_cache_dir,
             no_compile_cache=args.no_compile_cache,
             obs_dir=args.fleet_obs_dir,
             sharded_lane_workers=args.sharded_lane,
+            stream_dir=args.stream_dir,
+            stream_snapshot_every=args.stream_snapshot_every,
         )
         # Workers enable the (shared, machine-fingerprinted) persistent
         # compile cache and run warmup themselves; the router never
@@ -412,6 +415,7 @@ def _cmd_serve(args) -> int:
         replay=args.warmup_replay,
         lanes=args.batch_lanes,
         mesh_buckets=args.warmup_mesh_buckets,
+        stream_buckets=args.warmup_stream_buckets,
     )
 
     service = MSTService(
@@ -425,6 +429,8 @@ def _cmd_serve(args) -> int:
         # -1 = the bare flag: all devices; N > 0 = a submesh of N.
         sharded_lane=(True if args.sharded_lane == -1
                       else max(0, args.sharded_lane)),
+        stream_dir=args.stream_dir,
+        stream_snapshot_every=args.stream_snapshot_every,
     )
     if service.warmup_report is not None:
         print(f"warmup: {json.dumps(service.warmup_report)}", file=sys.stderr)
@@ -465,6 +471,8 @@ def _cmd_bench(args) -> int:
         argv += ["--batch-lanes", str(args.batch_lanes)]
     if args.warmup:
         argv.append("--warmup")
+    if args.update_stream:
+        argv.append("--update-stream")
     return bench_mod.main(argv)
 
 
@@ -650,6 +658,23 @@ def build_parser() -> argparse.ArgumentParser:
         "--sharded-lane)",
     )
     srv.add_argument(
+        "--stream-dir",
+        help="durable stream layer: subscription streams persist a "
+        "snapshot + update WAL per stream here (shared across fleet "
+        "workers; a restart replays instead of re-solving — "
+        "docs/STREAMING.md)",
+    )
+    srv.add_argument(
+        "--stream-snapshot-every", type=int, default=8,
+        help="committed windows between stream snapshots (the WAL holds "
+        "the deltas in between)",
+    )
+    srv.add_argument(
+        "--warmup-stream-buckets",
+        help="AOT-warm the windowed-maintenance kernels for subscribed "
+        "graphs of these RAW NODESxEDGES sizes before serving",
+    )
+    srv.add_argument(
         "--warmup-record",
         help="on exit, record the buckets this process compiled to this "
         "file (feed it to --warmup-replay after a restart)",
@@ -708,6 +733,12 @@ def build_parser() -> argparse.ArgumentParser:
         "--warmup", action="store_true",
         help="with --batch-lanes: AOT-precompile the bucket before the "
         "cold-first-query clock (bench.py --warmup)",
+    )
+    b.add_argument(
+        "--update-stream", action="store_true",
+        help="measure streaming MSF maintenance: windowed batched apply "
+        "vs the sequential per-update path (bench.py --update-stream, "
+        "docs/STREAMING.md)",
     )
     b.set_defaults(fn=_cmd_bench)
     return p

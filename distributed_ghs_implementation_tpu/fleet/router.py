@@ -83,6 +83,13 @@ class FleetConfig:
     # mesh-owning worker; 0 leaves oversize on the normal ring (bypass).
     sharded_lane_workers: int = 0
     warmup_mesh_buckets: Optional[str] = None  # passed to lane workers
+    warmup_stream_buckets: Optional[str] = None  # window-kernel warm (all)
+    # Durable stream layer: a SHARED directory (like disk_dir) holding
+    # every stream's snapshot + update log, so whichever worker inherits a
+    # stream's keyspace after a death replays it instead of re-solving
+    # (stream/log.py, docs/STREAMING.md).
+    stream_dir: Optional[str] = None
+    stream_snapshot_every: int = 8
     # A dead process is caught instantly by pipe EOF; heartbeats exist for
     # WEDGED processes, so the threshold errs generous — a false-positive
     # kill under load-spike GIL starvation costs more than slow detection.
@@ -286,12 +293,18 @@ class FleetRouter:
             argv += ["--batch-wait", str(cfg.batch_wait_s)]
         if cfg.disk_dir:
             argv += ["--disk-cache", cfg.disk_dir]
+        if cfg.stream_dir:
+            argv += ["--stream-dir", cfg.stream_dir,
+                     "--stream-snapshot-every",
+                     str(cfg.stream_snapshot_every)]
         if cfg.resolve_threshold is not None:
             argv += ["--resolve-threshold", str(cfg.resolve_threshold)]
         if cfg.warmup_buckets:
             argv += ["--warmup-buckets", cfg.warmup_buckets]
         if cfg.warmup_replay:
             argv += ["--warmup-replay", cfg.warmup_replay]
+        if cfg.warmup_stream_buckets:
+            argv += ["--warmup-stream-buckets", cfg.warmup_stream_buckets]
         if w.id in self._lane_ids:
             argv += ["--sharded-lane", "-1"]
             if cfg.warmup_mesh_buckets:
@@ -370,7 +383,11 @@ class FleetRouter:
                 BUS.count("fleet.duplicate.response")
                 continue
             self._release_slot(w)
-            if resp.get("ok") and resp.get("op") == "update":
+            if resp.get("ok") and resp.get("op") in (
+                "update", "publish", "subscribe"
+            ):
+                # update/publish rename the pinned digest along the chain;
+                # subscribe pins the head it returned (no predecessor).
                 self._note_session(
                     resp.get("digest"), w.id, prev=resp.get("prev_digest")
                 )
@@ -515,6 +532,13 @@ class FleetRouter:
         op = request.get("op")
         if op == "update":
             return request.get("digest")
+        if op in ("subscribe", "publish", "poll"):
+            # Stream ops ride the update-session digest-chain pinning: the
+            # head digest (session-pinned, renamed by every publish
+            # response) keeps a stream on the worker whose windowed
+            # session is live; the stream id is the stable fallback so
+            # polls without a head still hash consistently.
+            return request.get("digest") or request.get("stream")
         if op == "solve":
             if "digest" in request:
                 return str(request["digest"])  # client-side hint

@@ -125,10 +125,13 @@ def _build_service(args):
         warmup=plan_from_flags(
             buckets=args.warmup_buckets, replay=args.warmup_replay,
             lanes=args.batch_lanes, mesh_buckets=args.warmup_mesh_buckets,
+            stream_buckets=args.warmup_stream_buckets,
         ),
         # -1 = the bare flag: a lane over all of this worker's devices.
         sharded_lane=(True if args.sharded_lane == -1
                       else max(0, args.sharded_lane)),
+        stream_dir=args.stream_dir,
+        stream_snapshot_every=args.stream_snapshot_every,
     )
 
 
@@ -224,6 +227,11 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--store-capacity", type=int, default=128)
     p.add_argument("--disk-cache", default=None,
                    help="shared persistent result store directory")
+    p.add_argument("--stream-dir", default=None,
+                   help="shared durable stream log directory (snapshot + "
+                   "WAL per stream; failover replays from here)")
+    p.add_argument("--stream-snapshot-every", type=int, default=8,
+                   help="windows between stream snapshots")
     p.add_argument("--max-concurrent", type=int, default=2)
     p.add_argument("--max-sessions", type=int, default=32)
     p.add_argument("--resolve-threshold", type=int, default=None)
@@ -234,6 +242,9 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--warmup-mesh-buckets", default=None,
                    help="RAW NODESxEDGES oversize workloads to warm on the "
                    "sharded lane before serving")
+    p.add_argument("--warmup-stream-buckets", default=None,
+                   help="RAW NODESxEDGES subscribed-graph sizes whose "
+                   "window kernels warm before serving")
     p.add_argument("--sharded-lane", type=int, nargs="?", const=-1,
                    default=0, metavar="N",
                    help="own a mesh-sharded oversize solve lane over N "

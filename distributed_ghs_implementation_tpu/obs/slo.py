@@ -22,7 +22,11 @@ second), and ``latency_s`` / ``solve_s`` / ``queue_wait_s`` reservoirs
 (p50/p95/p99 via the repo-wide nearest-rank :func:`obs.events.quantile`).
 ``latency_s`` minus ``solve_s`` is the scheduling/queueing overhead a
 closed-loop micro-bench never sees; ``queue_wait_s`` narrows it to the
-batch engine's forming queue when lanes are on.
+batch engine's forming queue when lanes are on. The ``stream.*`` taxonomy
+joins the same way: a ``publish`` request's ``stream.window`` span (the
+window apply + durable-log append + notification) surfaces as
+``window_s`` under its class, so a report decomposes notification latency
+into commit cost vs routing/queueing.
 
 A summary computed while the ring overflowed is *flagged*
 (``dropped_warning``) — span-derived per-class counts under-count once
@@ -122,6 +126,7 @@ class ClassStats:
                 "latency": _Hist(),
                 "solve": _Hist(),
                 "queue_wait": _Hist(),
+                "window": _Hist(),
             }
         return entry
 
@@ -153,6 +158,12 @@ class ClassStats:
 
     def observe_queue_wait(self, cls: str, dur_s: float) -> None:
         self._entry(cls)["queue_wait"].add(float(dur_s))
+
+    def observe_window(self, cls: str, dur_s: float) -> None:
+        """Stream window-commit time attributed to class ``cls`` (the
+        ``stream.window`` span — the apply+log+notify cost of one window,
+        nested inside its publish request's end-to-end latency)."""
+        self._entry(cls)["window"].add(float(dur_s))
 
     def observe_worker(
         self,
@@ -187,7 +198,11 @@ class ClassStats:
             ),
             "latency_s": entry["latency"].summary(),
         }
-        for field, key in (("solve", "solve_s"), ("queue_wait", "queue_wait_s")):
+        for field, key in (
+            ("solve", "solve_s"),
+            ("queue_wait", "queue_wait_s"),
+            ("window", "window_s"),
+        ):
             if entry[field].count:
                 out[key] = entry[field].summary()
         return out
@@ -246,6 +261,12 @@ def _ingest(
             stats.observe_worker(str(worker), str(cls), dur_s, ok=ok, shed=shed)
     elif name == "serve.solve":
         stats.observe_solve(str(cls), dur_s)
+    elif name == "stream.window":
+        # The stream taxonomy's class-attributed span: publish requests
+        # tag their class, the session layer stamps it on the window
+        # commit, and the join exposes it as ``window_s`` — per-class
+        # commit cost next to end-to-end publish latency.
+        stats.observe_window(str(cls), dur_s)
 
 
 def ingest_bus_events(stats: ClassStats, events: Iterable[tuple]) -> None:

@@ -115,7 +115,13 @@ class DynamicMST:
         resolve_threshold: Optional[int] = None,
         backend: str = "device",
         supervisor=None,
+        solver=None,
     ):
+        # ``solver`` (graph -> MSTResult) overrides the direct supervised
+        # solve in :meth:`_resolve` — the stream layer injects the serving
+        # scheduler here so a windowed session's full-re-solve escape hatch
+        # is cached, single-flighted, and capacity-bounded like any other
+        # miss (stream/session.py).
         g = result.graph
         self._n = g.num_nodes
         # Canonical layout: sorted by (u, v), unique. Graph construction
@@ -131,6 +137,7 @@ class DynamicMST:
         self._in_tree = in_tree[order]
         self._backend = backend
         self._supervisor = supervisor
+        self._solver = solver
         self._threshold = resolve_threshold
         self._last_mode = "seed"
         self._dirty = False
@@ -389,10 +396,13 @@ class DynamicMST:
                 self._splice(a, b, upd.w, in_tree=False)
         BUS.count("serve.dynamic.resolve")
         graph = Graph(self._n, self._u.copy(), self._v.copy(), self._w.copy())
-        solved = minimum_spanning_forest(
-            graph, backend=self._backend, supervised=True,
-            supervisor=self._supervisor,
-        )
+        if self._solver is not None:
+            solved = self._solver(graph)
+        else:
+            solved = minimum_spanning_forest(
+                graph, backend=self._backend, supervised=True,
+                supervisor=self._supervisor,
+            )
         in_tree = np.zeros(graph.num_edges, dtype=bool)
         in_tree[solved.edge_ids] = True
         self._in_tree = in_tree

@@ -90,25 +90,36 @@ class PriorityGate:
         self.max_pause_s = max_pause_s
         self._cv = threading.Condition()
         self._pending = 0
+        self._local = threading.local()
 
     @contextlib.contextmanager
     def interactive(self):
         with self._cv:
             self._pending += 1
+        self._local.pending = getattr(self._local, "pending", 0) + 1
         try:
             yield
         finally:
+            self._local.pending -= 1
             with self._cv:
                 self._pending -= 1
                 if self._pending <= 0:
                     self._cv.notify_all()
 
     def checkpoint(self) -> None:
-        """Bulk-side yield point: wait out pending interactive work."""
+        """Bulk-side yield point: wait out pending interactive work.
+
+        Pending registrations held by THIS thread don't count: a solve
+        that degrades to bulk from inside an interactive context (a
+        stream window's resolve escape hatch routing to the sharded
+        lane) must not wait out its own registration at every stepped
+        boundary — it still yields to everyone else's.
+        """
+        own = getattr(self._local, "pending", 0)
         t0 = time.monotonic()
         with self._cv:
             while (
-                self._pending > 0
+                self._pending > own
                 and time.monotonic() - t0 < self.max_pause_s
             ):
                 self._cv.wait(timeout=0.05)
@@ -312,6 +323,15 @@ class SolveScheduler:
             return "batch" if self.batch_engine is not None else "direct"
         return route
 
+    def interactive(self):
+        """Register non-solve request work with the priority gate.
+
+        The stream layer wraps each window commit in this context so a
+        bulk mesh solve yields to window applies at its stepped-solve
+        checkpoints, the same way it yields to interactive misses.
+        """
+        return self.gate.interactive()
+
     def _solve_miss(self, graph: Graph, backend: str) -> MSTResult:
         """One cache miss, routed: batch-engine submission (admitted,
         device backend), the mesh-sharded lane (oversize with a lane
@@ -322,6 +342,10 @@ class SolveScheduler:
         SLO summaries can tell the two oversize paths apart; interactive
         (non-oversize) solves register with the priority gate the bulk
         lane yields to."""
+        # Every path below runs the solver on a graph nothing had cached —
+        # the one counter "zero fresh solves on recovery" drills assert
+        # stays flat while a restarted worker replays its streams.
+        BUS.count("serve.scheduler.fresh_solve")
         route = self._route(graph, backend)
         if route == "batch":
             with self.gate.interactive(), BUS.span(

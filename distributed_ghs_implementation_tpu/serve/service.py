@@ -14,6 +14,19 @@ protocol a test, the chaos drill, or a thin network front-end can all drive
   incremental maintenance against the session for ``digest``; the response
   carries the *new* digest (sessions re-key content-addressed) and ``mode``
   (``"incremental"`` or ``"resolve"``).
+* ``{"op": "subscribe", "digest": "..."}`` (or ``"stream": id`` to resume)
+  — pin a long-lived stream to a solved graph; the response carries the
+  ``stream`` id, current head ``digest``, and head ``seq``
+  (``stream/session.py``, docs/STREAMING.md).
+* ``{"op": "publish", "stream": id, "digest": head, "updates": [...]}`` —
+  commit one update window against the stream head: coalesced, applied in
+  one batched pass, appended to the durable log, and notified. The
+  response carries the new head ``digest`` + ``prev_digest`` (the fleet
+  router follows the chain) and the window's MST-change ``notification``.
+  A stale head fails with ``"stale": true`` plus the current head/seq.
+* ``{"op": "poll", "stream": id, "after_seq": N}`` — drain MST-change
+  notifications with ``seq > N`` (edges entered/left the forest, weight
+  delta — gapless, duplicate-free, failover-surviving sequence numbers).
 * ``{"op": "stats"}`` — serve counters from the ``obs`` bus + store stats.
 * ``{"op": "shutdown"}`` — acknowledge and end the loop (EOF also ends it).
 
@@ -44,6 +57,7 @@ from distributed_ghs_implementation_tpu.serve.dynamic import DynamicMST
 from distributed_ghs_implementation_tpu.serve.scheduler import SolveScheduler
 from distributed_ghs_implementation_tpu.serve.store import (
     ResultStore,
+    cache_key_for_digest,
     solve_cache_key,
 )
 
@@ -68,6 +82,10 @@ class MSTService:
         batch_wait_s: Optional[float] = None,
         warmup=None,
         sharded_lane=False,
+        stream_dir: Optional[str] = None,
+        stream_snapshot_every: int = 8,
+        stream_window_mode: str = "batched",
+        max_streams: Optional[int] = None,
     ):
         self.store = store if store is not None else ResultStore(
             capacity=store_capacity, disk_dir=disk_dir
@@ -109,6 +127,32 @@ class MSTService:
         self.backend = backend
         self.resolve_threshold = resolve_threshold
         self.max_sessions = max_sessions
+        # Subscription streams (stream/): long-lived windowed sessions with
+        # a durable log under stream_dir (shared across fleet workers, so a
+        # restarted worker replays instead of re-solving). The full-resolve
+        # escape hatch routes through the scheduler — cached, supervised,
+        # single-flighted — and window commits register with the priority
+        # gate so bulk mesh solves yield to them. Deferred import: the
+        # stream package reaches serve/__init__ (window -> serve.dynamic),
+        # which imports this module — a top-level import here deadlocks
+        # that chain when stream loads first.
+        from distributed_ghs_implementation_tpu.stream.session import (
+            StreamManager,
+        )
+
+        stream_kwargs = {}
+        if max_streams is not None:
+            stream_kwargs["max_streams"] = max_streams
+        self.streams = StreamManager(
+            root=stream_dir,
+            snapshot_every=stream_snapshot_every,
+            backend=backend,
+            resolve_threshold=resolve_threshold,
+            window_mode=stream_window_mode,
+            solver=lambda g: self.scheduler.solve(g, backend=backend)[0],
+            interactive_gate=self.scheduler.interactive,
+            **stream_kwargs,
+        )
         # digest -> DynamicMST (materialized by an update) or a lightweight
         # (result, backend) seed (parked by a solve).
         self._sessions: "collections.OrderedDict[str, object]" = (
@@ -156,6 +200,13 @@ class MSTService:
 
     # ------------------------------------------------------------------
     def handle(self, request: dict) -> dict:
+        # Deferred for the same serve <-> stream import cycle as the
+        # StreamManager import in __init__ — by the first request both
+        # packages are fully loaded, so this is a sys.modules lookup.
+        from distributed_ghs_implementation_tpu.stream.session import (
+            StaleDigest,
+        )
+
         op = request.get("op")
         # SLO class tag: clients label each query ("hit"/"miss"/"update"/
         # ...); the label rides the serve.request span args (what
@@ -176,14 +227,31 @@ class MSTService:
                     response = self._handle_solve(request)
                 elif op == "update":
                     response = self._handle_update(request)
+                elif op == "subscribe":
+                    response = self._handle_subscribe(request)
+                elif op == "publish":
+                    response = self._handle_publish(request)
+                elif op == "poll":
+                    response = self._handle_poll(request)
                 elif op == "stats":
                     response = self._handle_stats()
                 elif op == "shutdown":
                     response = {"ok": True, "op": "shutdown"}
                 else:
                     raise ValueError(
-                        f"unknown op {op!r}; expected solve|update|stats|shutdown"
+                        f"unknown op {op!r}; expected solve|update|"
+                        f"subscribe|publish|poll|stats|shutdown"
                     )
+            except StaleDigest as e:
+                # Not an error so much as a re-sync point: the client's
+                # head lost a race (or a failover replayed past it); the
+                # response carries the current head so it can catch up
+                # without re-solving.
+                response = {
+                    "ok": False, "op": op, "stale": True,
+                    "error": f"StaleDigest: {e}",
+                    "stream": e.stream_id, "digest": e.head, "seq": e.seq,
+                }
             except Exception as e:  # noqa: BLE001 — the loop must survive
                 BUS.count("serve.errors")
                 response = {
@@ -280,11 +348,100 @@ class MSTService:
         out.update(self._result_fields(result, request))
         return out
 
+    # -- streams (stream/session.py, docs/STREAMING.md) ------------------
+    def _seed_result(self, digest: str, backend: str):
+        """The solved seed a new stream pins to: the parked update-session
+        entry for this digest (a solve always parks one), falling back to
+        the store's memory LRU — the parked seed is bounded by
+        ``max_sessions``, but the cached result outlives it, and an
+        evicted stream's re-subscribe-by-digest must keep working without
+        a fresh solve. (The disk layer needs the graph to rebuild a
+        result, which a digest-only subscribe doesn't carry.) Store keys
+        carry the backend the solve ran on, so the probe honors the
+        request's backend — a seed solved with an explicit
+        ``backend=host`` is cached under the host key, not the service
+        default. ``None`` when neither layer knows the graph."""
+        entry = self._sessions.get(digest)
+        if entry is not None:
+            if isinstance(entry, DynamicMST):
+                return entry.result()
+            return entry[0]
+        return self.store.get(
+            cache_key_for_digest(digest, backend=backend),
+            record_miss=False,
+        )
+
+    def _handle_subscribe(self, request: dict) -> dict:
+        digest = request.get("digest")
+        stream = request.get("stream")
+        backend = request.get("backend", self.backend)
+        session = self.streams.subscribe(
+            digest=digest,
+            stream=stream,
+            result=self._seed_result(digest, backend) if digest else None,
+        )
+        return {
+            "ok": True,
+            "op": "subscribe",
+            "stream": session.id,
+            "digest": session.head,
+            "seq": session.seq,
+            "num_nodes": session.mst.num_nodes,
+            "num_components": session.mst.num_components,
+        }
+
+    def _handle_publish(self, request: dict) -> dict:
+        stream = request.get("stream")
+        if not stream:
+            raise ValueError("publish needs a stream id (from subscribe)")
+        # The chain moved: cache the new head for future solve requests and
+        # evict the superseded ancestor from the memory LRU — a long-lived
+        # stream must not fill the cache with dead chain links. A noop
+        # window (prev == new digest) moves nothing: evicting there would
+        # drop the result we just cached. Memory-only: the stream
+        # snapshot+WAL is the durable layer for every head on the chain.
+        # Runs as the commit hook (inside the session lock) so concurrent
+        # publishes on one stream maintain the cache in seq order — done
+        # after publish returns, a later window's eviction could land
+        # before an earlier window's insert and re-plant a dead ancestor.
+        def _cache_head(result, prev_digest, digest):
+            self.store.put(
+                solve_cache_key(result.graph, backend=self.backend),
+                result,
+                memory_only=True,
+            )
+            if prev_digest != digest:
+                self.store.evict_chain(
+                    cache_key_for_digest(prev_digest, backend=self.backend)
+                )
+                if self.sharded_lane is not None:
+                    self.sharded_lane.refresh_resident(
+                        prev_digest, result.graph
+                    )
+
+        out = self.streams.publish(
+            stream, request.get("digest"), request.get("updates", []),
+            on_commit=_cache_head,
+        )
+        result = out.pop("result")
+        response = {"ok": True, "op": "publish", **out}
+        response.update(self._result_fields(result, request))
+        return response
+
+    def _handle_poll(self, request: dict) -> dict:
+        stream = request.get("stream")
+        if not stream:
+            raise ValueError("poll needs a stream id (from subscribe)")
+        out = self.streams.poll(stream, int(request.get("after_seq", 0)))
+        return {"ok": True, "op": "poll", **out}
+
     def _handle_stats(self) -> dict:
         counters = {
             name: value
             for name, value in BUS.counters().items()
-            if name.startswith(("serve.", "batch.", "compile.", "lane."))
+            if name.startswith(
+                ("serve.", "batch.", "compile.", "lane.", "stream.")
+            )
         }
         out = {
             "ok": True,
@@ -292,10 +449,16 @@ class MSTService:
             "counters": counters,
             "store": self.store.stats(),
             "sessions": len(self._sessions),
+            "streams": len(self.streams),
             # Ring-overflow visibility: a drill reading stats over the
             # pipes must know when span-derived numbers under-count.
             "events_dropped": BUS.dropped,
         }
+        stream_stats = self.streams.stats()
+        # Durable streams outnumber resident ones after an LRU eviction
+        # or a restart; an operator needs the on-disk count to know a
+        # quiet worker still owns replayable state.
+        out["streams_recoverable"] = len(stream_stats.get("recoverable", ()))
         if self.warmup_report is not None:
             out["warmup"] = self.warmup_report
         return out

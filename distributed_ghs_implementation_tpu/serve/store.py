@@ -59,7 +59,14 @@ def solve_cache_key(graph: Graph, *, backend: str = "device") -> str:
     key, so the repeat query is a hit regardless of which path solved it
     (tests/test_lane.py pins the memory and disk round trips).
     """
-    return f"{graph.digest()}:{backend}"
+    return cache_key_for_digest(graph.digest(), backend=backend)
+
+
+def cache_key_for_digest(digest: str, *, backend: str = "device") -> str:
+    """:func:`solve_cache_key` for an already-computed digest — the stream
+    layer evicts superseded chain ancestors by digest alone, without
+    holding the ancestor graph."""
+    return f"{digest}:{backend}"
 
 
 def _disk_path(disk_dir: str, key: str) -> str:
@@ -176,10 +183,19 @@ class ResultStore:
             BUS.count("serve.store.miss")
         return None
 
-    def put(self, key: str, result: MSTResult) -> None:
+    def put(
+        self, key: str, result: MSTResult, *, memory_only: bool = False
+    ) -> None:
+        """Cache ``result``; ``memory_only=True`` skips the disk layer.
+
+        Stream window commits use ``memory_only``: their durability is the
+        stream snapshot+WAL (replay rebuilds any head), so a full-graph npz
+        write per committed window — for a head the next window supersedes
+        — would be pure disk churn on the commit hot path.
+        """
         BUS.count("serve.store.put")
         self._mem_put(key, result)
-        if self.disk_dir is not None:
+        if self.disk_dir is not None and not memory_only:
             try:
                 self._disk_put(key, result)
                 self._disk_sweep()
@@ -189,6 +205,23 @@ class ResultStore:
                 # nothing or a .bak generation behind, and reads re-validate
                 # digests, so the worst case is a future miss.
                 BUS.count("serve.store.disk_write_failed")
+
+    def evict_chain(self, key: str) -> bool:
+        """Drop a superseded digest-chain ancestor from the memory LRU.
+
+        A stream commit renames its graph content-addressed every window;
+        without this, every window's result lingers in memory until
+        capacity pressure — for a long-lived subscribed graph that is the
+        whole LRU filled with dead ancestors. Disk entries stay (the
+        bounded sweep handles those): a late query for an old chain link
+        is still answerable, just not at the cost of memory. Returns
+        whether an entry was dropped (``serve.store.chain_evicted``).
+        """
+        with self._lock:
+            if self._mem.pop(key, None) is None:
+                return False
+        BUS.count("serve.store.chain_evicted")
+        return True
 
     def stats(self) -> dict:
         with self._lock:

@@ -1,0 +1,40 @@
+"""High-rate graph mutation: windowed batched maintenance, a durable
+update log with snapshot+replay recovery, and MST-change subscription
+sessions (docs/STREAMING.md).
+
+Three pillars, one per module:
+
+* :mod:`stream.window` — coalesce an update window (last-write-wins per
+  edge) and apply the whole window in two batched passes built on the
+  solver's own ``fragment_moe`` / ``hook_and_compress`` primitives,
+  instead of ``serve/dynamic.py``'s one-exchange-rule-per-update walk.
+* :mod:`stream.log` — persist every committed window through the
+  checkpoint layer (snapshot every K windows + JSONL delta log with
+  torn-tail skip and ``.bak`` generation fallback), so a restarted worker
+  replays to the current digest without a single fresh solve.
+* :mod:`stream.session` — long-lived subscribed graphs: a digest-chained
+  stream per seed graph, MST-change notifications (edges entered/left the
+  forest, weight delta) per committed window, pull-based ``poll`` with
+  gapless/duplicate-free sequence numbers that survive worker failover
+  via log replay.
+"""
+
+from distributed_ghs_implementation_tpu.stream.log import UpdateLog
+from distributed_ghs_implementation_tpu.stream.session import (
+    StreamManager,
+    StreamSession,
+)
+from distributed_ghs_implementation_tpu.stream.window import (
+    WindowedMST,
+    coalesce,
+    random_update_stream,
+)
+
+__all__ = [
+    "UpdateLog",
+    "StreamManager",
+    "StreamSession",
+    "WindowedMST",
+    "coalesce",
+    "random_update_stream",
+]

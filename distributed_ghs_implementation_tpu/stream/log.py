@@ -1,0 +1,412 @@
+"""Durable update log: snapshot every K windows + a JSONL delta WAL.
+
+A maintained forest used to live only in a worker's memory — a restart
+threw away every windowed session and the first post-restart update paid a
+full fresh solve. This module gives each stream a directory under the
+(fleet-shared) stream root:
+
+* ``snapshot.npz`` — the session's whole state (``u/v/w/in_tree`` +
+  window sequence + head digest) written through
+  :func:`utils.checkpoint.atomic_write_npz`: tmp-file + rename with one
+  retained ``.bak`` generation, so a crash mid-snapshot costs at most one
+  snapshot interval (the ``stream.log.save`` fault site tears writes in
+  tests).
+* ``wal.jsonl`` — one JSON line per committed window
+  (``ghs-stream-wal-v1``: seq, prev/new digest, the raw updates). Appends
+  are flushed + fsynced and serialized across processes by the same
+  advisory per-path flock the shared result store uses
+  (``serve.store._flocked``) — the two-process hammer test drives exactly
+  that interleaving.
+
+**Replay** (:meth:`UpdateLog.load`) is snapshot-then-deltas: the newest
+loadable snapshot generation (primary, else ``.bak``) plus every WAL entry
+with a later sequence number, in order. A torn tail — a crash mid-append
+leaves a partial last line — is skipped and counted
+(``stream.log.torn_skipped``), never fatal; so is an unparsable *mid*-log
+line (``stream.log.corrupt_line`` — a retried append seals the torn
+record of its failed predecessor in place, leaving garbage between two
+good lines). A real chain break (sequence gap, or a ``prev`` digest that
+does not follow from the snapshot — the snapshot/log-disagreement case)
+stops replay at the break with ``stream.log.chain_broken``: everything
+before the break is still recovered, and the caller decides whether the
+shortened head is acceptable. After each snapshot the WAL is compacted (entries at or below
+the snapshot's sequence dropped via tmp + rename); a crash between
+snapshot and compaction just leaves already-covered entries that replay
+skips by sequence number.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+from distributed_ghs_implementation_tpu.obs.events import BUS
+from distributed_ghs_implementation_tpu.utils.checkpoint import (
+    atomic_write_npz,
+)
+
+
+def _flocked(path: str):
+    """The shared advisory per-path write lock (``serve.store._flocked``),
+    imported lazily: ``serve`` imports ``stream`` for the service verbs,
+    so a module-level import here would close an import cycle."""
+    from distributed_ghs_implementation_tpu.serve.store import (
+        _flocked as flocked,
+    )
+
+    return flocked(path)
+
+WAL_SCHEMA = "ghs-stream-wal-v1"
+FAULT_SITE = "stream.log.save"
+
+
+class ChainBreak(ValueError):
+    """The WAL does not follow from the snapshot (gap or digest mismatch),
+    or an append would not follow from the durable tail (a fork). Carries
+    the durable head when known so the caller can re-sync the client."""
+
+    def __init__(
+        self,
+        msg: str,
+        *,
+        seq: Optional[int] = None,
+        digest: Optional[str] = None,
+    ):
+        super().__init__(msg)
+        self.seq = seq
+        self.digest = digest
+
+
+def stream_dir(root: str, stream_id: str) -> str:
+    return os.path.join(root, stream_id)
+
+
+def list_streams(root: str) -> List[str]:
+    """Stream ids with a recoverable directory under ``root``."""
+    if not os.path.isdir(root):
+        return []
+    return sorted(
+        e.name for e in os.scandir(root)
+        if e.is_dir() and (
+            os.path.exists(os.path.join(e.path, "snapshot.npz"))
+            or os.path.exists(os.path.join(e.path, "snapshot.npz.bak"))
+        )
+    )
+
+
+class UpdateLog:
+    """One stream's durable layer: ``<root>/<stream_id>/{snapshot.npz,wal.jsonl}``."""
+
+    def __init__(self, root: str, stream_id: str):
+        self.dir = stream_dir(root, stream_id)
+        self.snap_path = os.path.join(self.dir, "snapshot.npz")
+        self.wal_path = os.path.join(self.dir, "wal.jsonl")
+
+    # -- writing -------------------------------------------------------
+    def append(
+        self, *, seq: int, prev_digest: str, digest: str, updates: list
+    ) -> None:
+        """Append one committed window (flushed + fsynced, flock-serialized).
+
+        The durable chain is validated under the same flock before the
+        write: an append must extend the on-disk tail (last WAL entry,
+        else the snapshot head). A mismatch raises :class:`ChainBreak`
+        carrying the durable head instead of forking the log — the
+        fleet-shared-``stream_dir`` race where a worker holding a stale
+        resident copy of a stream accepts a publish (its *in-memory* head
+        matched) after another worker already committed past it.
+        """
+        os.makedirs(self.dir, exist_ok=True)
+        line = json.dumps({
+            "schema": WAL_SCHEMA,
+            "seq": int(seq),
+            "prev": prev_digest,
+            "digest": digest,
+            "updates": updates,
+        })
+        with _flocked(self.wal_path):
+            tail = self._durable_head()
+            if tail is not None and (
+                int(seq) != tail[0] + 1 or prev_digest != tail[1]
+            ):
+                BUS.count("stream.log.fork_refused")
+                raise ChainBreak(
+                    f"append seq {seq} (prev {prev_digest[:12]}...) does "
+                    f"not extend the durable tail seq {tail[0]} "
+                    f"({tail[1][:12]}...)",
+                    seq=tail[0],
+                    digest=tail[1],
+                )
+            # Seal a torn tail first: a crash mid-append leaves a partial
+            # line with no trailing newline, and writing straight after it
+            # would fuse this (durably committed) record onto the garbage,
+            # making it unparsable on replay.
+            seal = b""
+            try:
+                with open(self.wal_path, "rb") as rf:
+                    rf.seek(-1, os.SEEK_END)
+                    if rf.read(1) != b"\n":
+                        seal = b"\n"
+                        BUS.count("stream.log.sealed_torn")
+            except (FileNotFoundError, OSError):
+                pass  # empty or missing: nothing to seal
+            with open(self.wal_path, "ab") as f:
+                f.write(seal + (line + "\n").encode())
+                f.flush()
+                os.fsync(f.fileno())
+        BUS.count("stream.log.append")
+
+    def snapshot(
+        self,
+        state: dict,
+        *,
+        seq: int,
+        digest: str,
+        notifications: Optional[list] = None,
+    ) -> None:
+        """Persist the session state (``WindowedMST.state_arrays``) and
+        compact the WAL down to entries the snapshot does not cover.
+
+        ``notifications`` rides along (JSON-encoded) so a recovered
+        stream's ring reaches BACK past the snapshot point — a subscriber
+        whose cursor predates the snapshot still drains gap-free after a
+        failover, instead of hitting ``truncated``."""
+        os.makedirs(self.dir, exist_ok=True)
+        arrays = dict(state)
+        arrays["seq"] = np.asarray(int(seq))
+        arrays["digest"] = np.asarray(digest)
+        arrays["notifications"] = np.asarray(
+            json.dumps(list(notifications or []))
+        )
+        with _flocked(self.snap_path):
+            atomic_write_npz(self.snap_path, arrays, fault_site=FAULT_SITE)
+        BUS.count("stream.log.snapshot")
+        self._compact(seq)
+
+    def _compact(self, covered_seq: int) -> None:
+        """Drop WAL entries the snapshot already covers (tmp + rename; a
+        crash anywhere leaves entries replay skips by sequence number)."""
+        try:
+            with _flocked(self.wal_path):
+                entries, _torn = self._read_wal()
+                keep = [e for e in entries if e["seq"] > covered_seq]
+                if len(keep) == len(entries):
+                    return
+                tmp = self.wal_path + ".tmp"
+                with open(tmp, "w") as f:
+                    for e in keep:
+                        f.write(json.dumps({"schema": WAL_SCHEMA, **e}) + "\n")
+                    f.flush()
+                    os.fsync(f.fileno())
+                os.replace(tmp, self.wal_path)
+            BUS.count("stream.log.compact")
+        except (OSError, TimeoutError):
+            pass  # compaction is best-effort; replay skips covered entries
+
+    def _durable_head(self) -> Optional[Tuple[int, str]]:
+        """``(seq, digest)`` of the durable chain tail — the last WAL
+        append, else the newest loadable snapshot head; ``None`` when
+        neither exists (a bare log). Callers hold the WAL flock; reads
+        here must not re-enter it."""
+        tail = self._tail_entry()
+        if tail is not None:
+            return tail["seq"], tail["digest"]
+        for candidate in (self.snap_path, self.snap_path + ".bak"):
+            try:
+                with np.load(candidate) as data:
+                    return int(data["seq"]), str(data["digest"])
+            except Exception:  # missing/torn: fall through
+                continue
+        return None
+
+    # -- reading -------------------------------------------------------
+    @staticmethod
+    def _parse_line(line: str) -> Optional[dict]:
+        """One WAL line -> entry dict, or ``None`` for anything torn,
+        unparsable, or schema-mismatched."""
+        try:
+            rec = json.loads(line)
+            if rec.get("schema") != WAL_SCHEMA:
+                raise ValueError(f"bad schema {rec.get('schema')!r}")
+            return {
+                "seq": int(rec["seq"]),
+                "prev": rec["prev"],
+                "digest": rec["digest"],
+                "updates": rec["updates"],
+            }
+        except (ValueError, KeyError, TypeError):
+            return None
+
+    def _tail_entry(self) -> Optional[dict]:
+        """Last complete, parsable WAL entry, found by a backwards chunked
+        scan of the file tail. ``append`` calls this under the flock on
+        every publish: compaction is best-effort, so the WAL can grow
+        without bound when snapshots keep failing, and reading the whole
+        file there would make each commit O(total WAL)."""
+        try:
+            size = os.path.getsize(self.wal_path)
+        except OSError:
+            return None
+        buf = b""
+        with open(self.wal_path, "rb") as f:
+            pos = size
+            while pos > 0:
+                step = min(65536, pos)
+                pos -= step
+                f.seek(pos)
+                buf = f.read(step) + buf
+                lines = buf.decode("utf-8", errors="replace").split("\n")
+                # lines[-1] is a torn tail (or empty past the final
+                # newline); lines[0] may be a mid-line fragment unless
+                # the scan reached the start of the file.
+                first = 0 if pos == 0 else 1
+                for line in reversed(lines[first:-1]):
+                    if not line.strip():
+                        continue
+                    entry = self._parse_line(line)
+                    if entry is not None:
+                        return entry
+        return None
+
+    def _read_wal(self, count: bool = True) -> Tuple[List[dict], int]:
+        """Parse the WAL; returns ``(entries, torn_skipped)``. A partial
+        final line (torn append) is skipped; an unparsable line anywhere
+        else is also skipped (a sealed torn record from a retried append
+        sits mid-file) — whether the log is still usable past it is
+        decided by :meth:`load`'s chain validation, which stops at any
+        real gap."""
+        if not os.path.exists(self.wal_path):
+            return [], 0
+        with open(self.wal_path) as f:
+            raw = f.read()
+        entries: List[dict] = []
+        torn = 0
+        lines = raw.split("\n")
+        complete = lines[:-1]  # text after the final newline is a torn tail
+        if lines[-1]:
+            torn += 1
+        for i, line in enumerate(complete):
+            if not line.strip():
+                continue
+            entry = self._parse_line(line)
+            if entry is None:
+                if i == len(complete) - 1:
+                    torn += 1  # torn mid-record on the last complete line
+                elif count:
+                    BUS.count("stream.log.corrupt_line")
+                continue
+            entries.append(entry)
+        if torn and count:
+            BUS.count("stream.log.torn_skipped", torn)
+        return entries, torn
+
+    def load_snapshot(self) -> Tuple[Optional[dict], List[Tuple[str, str]]]:
+        """Newest loadable snapshot generation (primary, else ``.bak``);
+        returns ``(state_or_None, notes)`` in the checkpoint-recovery
+        shape (why each skipped candidate was rejected)."""
+        notes: List[Tuple[str, str]] = []
+        for candidate in (self.snap_path, self.snap_path + ".bak"):
+            if not os.path.exists(candidate):
+                notes.append((candidate, "missing"))
+                continue
+            try:
+                with np.load(candidate) as data:
+                    state = {
+                        "num_nodes": int(data["num_nodes"]),
+                        "u": np.asarray(data["u"]),
+                        "v": np.asarray(data["v"]),
+                        "w": np.asarray(data["w"]),
+                        "in_tree": np.asarray(data["in_tree"], dtype=bool),
+                        "seq": int(data["seq"]),
+                        "digest": str(data["digest"]),
+                        "notifications": (
+                            json.loads(str(data["notifications"]))
+                            if "notifications" in data.files else []
+                        ),
+                    }
+            except Exception as e:  # torn/corrupt: fall to the next generation
+                notes.append((candidate, f"{type(e).__name__}: {e}"))
+                continue
+            if candidate.endswith(".bak"):
+                BUS.count("stream.log.snap_fallback")
+            return state, notes
+        return None, notes
+
+    def load(self) -> Tuple[Optional[dict], List[dict], List[Tuple[str, str]]]:
+        """Replay input: ``(snapshot_state_or_None, chained_entries, notes)``.
+
+        ``chained_entries`` are the WAL windows that verifiably follow the
+        snapshot: contiguous sequence numbers starting at ``seq + 1`` whose
+        ``prev`` digests chain from the snapshot digest. The first entry
+        breaking the chain stops the list (``stream.log.chain_broken``) —
+        the snapshot/log-disagreement degraded path.
+        """
+        state, notes = self.load_snapshot()
+        entries, _torn = self._read_wal()
+        if state is None:
+            return None, [], notes
+        chained: List[dict] = []
+        seq, head = state["seq"], state["digest"]
+        broken = False
+        for entry in entries:
+            if entry["seq"] <= seq:
+                continue  # covered by the snapshot (compaction raced a crash)
+            if entry["seq"] != seq + 1 or entry["prev"] != head:
+                BUS.count("stream.log.chain_broken")
+                notes.append((
+                    self.wal_path,
+                    f"chain break at seq {entry['seq']} "
+                    f"(expected {seq + 1} following {head[:12]}...)",
+                ))
+                broken = True
+                break
+            chained.append(entry)
+            seq, head = entry["seq"], entry["digest"]
+        if broken:
+            self._truncate_to_chain()
+        return state, chained, notes
+
+    def _truncate_to_chain(self) -> None:
+        """Repair a mid-log chain break: rewrite the WAL down to the
+        prefix that chains from the snapshot. Entries past the break are
+        unreachable by replay, but ``append`` validates against the LAST
+        parsable line — leaving them in place refuses every publish from
+        the recovered head forever (the client adopts the dead tail
+        digest, the session keeps recovering to the chained head: a
+        re-sync livelock). The chain is re-derived from the freshest
+        snapshot generation INSIDE the flock, so a concurrent writer that
+        just advanced the snapshot (making the tail chain again) is never
+        clobbered. Best-effort like compaction: a failed rewrite leaves
+        the pre-repair state."""
+        try:
+            with _flocked(self.wal_path):
+                state, _notes = self.load_snapshot()
+                if state is None:
+                    return
+                entries, _torn = self._read_wal(count=False)
+                keep: List[dict] = []
+                seq, head = state["seq"], state["digest"]
+                for entry in entries:
+                    if entry["seq"] <= seq:
+                        continue  # covered: compaction's job either way
+                    if entry["seq"] != seq + 1 or entry["prev"] != head:
+                        break
+                    keep.append(entry)
+                    seq, head = entry["seq"], entry["digest"]
+                if len(keep) == len(entries):
+                    return
+                tmp = self.wal_path + ".tmp"
+                with open(tmp, "w") as f:
+                    for e in keep:
+                        f.write(
+                            json.dumps({"schema": WAL_SCHEMA, **e}) + "\n"
+                        )
+                    f.flush()
+                    os.fsync(f.fileno())
+                os.replace(tmp, self.wal_path)
+            BUS.count("stream.log.chain_truncated")
+        except (OSError, TimeoutError):
+            pass

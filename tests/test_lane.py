@@ -429,14 +429,70 @@ def test_priority_gate_pause_is_bounded():
     from distributed_ghs_implementation_tpu.serve.scheduler import PriorityGate
 
     gate = PriorityGate(max_pause_s=0.2)
-    ctx = gate.interactive()
-    ctx.__enter__()  # a pending interactive solve that never finishes
+    hang = threading.Event()
+
+    def hung_interactive():
+        with gate.interactive():
+            hang.wait(5.0)  # a pending interactive solve that never finishes
+
+    t = threading.Thread(target=hung_interactive)
+    t.start()
+    time.sleep(0.05)
     try:
         t0 = time.monotonic()
         gate.checkpoint()
         assert 0.15 <= time.monotonic() - t0 < 2.0  # bounded, not deadlocked
     finally:
-        ctx.__exit__(None, None, None)
+        hang.set()
+        t.join(5)
+
+
+def test_priority_gate_checkpoint_skips_own_registration():
+    """A bulk solve reached from INSIDE an interactive context (a stream
+    window's resolve escape hatch routing to the sharded lane) must not
+    wait out its own pending registration at every checkpoint — while
+    still yielding to other threads' interactive work."""
+    from distributed_ghs_implementation_tpu.serve.scheduler import PriorityGate
+
+    gate = PriorityGate(max_pause_s=5.0)
+    with gate.interactive():
+        t0 = time.monotonic()
+        gate.checkpoint()  # own registration: must not stall max_pause_s
+        assert time.monotonic() - t0 < 1.0
+    # Another thread's interactive work still pauses a bulk checkpoint —
+    # and its exit releases the checkpoint, not max_pause_s expiry. Runs
+    # OUTSIDE the interactive block above: an open registration on this
+    # thread is not exempt for the bulk thread and would pin the
+    # checkpoint to the full max_pause_s.
+    release = threading.Event()
+    entered = threading.Event()
+
+    def other():
+        with gate.interactive():
+            entered.set()
+            release.wait(5.0)
+
+    t = threading.Thread(target=other)
+    t.start()
+    assert entered.wait(5.0)
+    gate2 = threading.Event()
+
+    def bulk():
+        gate.checkpoint()
+        gate2.set()
+
+    b = threading.Thread(target=bulk)
+    b.start()
+    time.sleep(0.1)
+    assert not gate2.is_set()  # the other thread's pending still gates
+    t_release = time.monotonic()
+    release.set()
+    t.join(5)
+    b.join(5)
+    assert gate2.is_set()
+    # Released by the interactive exit (50ms poll + margin), far below
+    # the 5s max_pause ceiling a vacuous wait-out would take.
+    assert time.monotonic() - t_release < 2.0
 
 
 # ----------------------------------------------------------------------

@@ -290,6 +290,24 @@ def test_committed_load_baseline_is_gateable():
 # ----------------------------------------------------------------------
 # The load drill itself (miniature deck; the full smoke runs in CI)
 # ----------------------------------------------------------------------
+def test_load_drill_window_counter_delta_survives_worker_restart():
+    import load_drill
+
+    pre = {("0", 1): {"serve.scheduler.fresh_solve": 5, "serve.hits": 2}}
+    post = {
+        ("0", 2): {"serve.scheduler.fresh_solve": 3},  # killed + restarted
+        ("1", 1): {"serve.hits": 7},
+    }
+    delta = load_drill._window_counter_delta(pre, post)
+    # The victim's vanished pre-kill counters must NOT cancel the
+    # restarted incarnation's fresh solves — that is the hole that let
+    # the kill drill's "zero fresh solves" gate pass vacuously.
+    assert delta["serve.scheduler.fresh_solve"] == 3
+    assert delta["serve.hits"] == 7  # the dead incarnation's base is gone
+    same = {("1", 1): {"serve.hits": 4}}
+    assert load_drill._window_counter_delta(same, post)["serve.hits"] == 3
+
+
 def test_load_drill_arrival_models_are_seeded_and_bounded():
     import numpy as np
 

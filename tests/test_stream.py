@@ -1,0 +1,1027 @@
+"""The stream/ subsystem: windowed batched maintenance (coalescing +
+edge-for-edge parity vs fresh solves), the durable update log (torn tail,
+``.bak`` fallback, snapshot/WAL disagreement, two-process flock hammer),
+replay recovery that never touches the solver, subscription sessions over
+the serve ops, and the stream.* SLO/warmup plumbing."""
+
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from distributed_ghs_implementation_tpu.api import minimum_spanning_forest
+from distributed_ghs_implementation_tpu.graphs.edgelist import Graph
+from distributed_ghs_implementation_tpu.graphs.generators import (
+    gnm_random_graph,
+)
+from distributed_ghs_implementation_tpu.models.boruvka import solve_graph
+from distributed_ghs_implementation_tpu.obs.events import BUS
+from distributed_ghs_implementation_tpu.serve.dynamic import DynamicMST, Update
+from distributed_ghs_implementation_tpu.stream.log import ChainBreak, UpdateLog
+from distributed_ghs_implementation_tpu.stream.session import (
+    StaleDigest,
+    StreamManager,
+    poll_gap_check,
+)
+from distributed_ghs_implementation_tpu.stream.window import (
+    WindowedMST,
+    coalesce,
+)
+
+
+def _random_graph(rng, n, m, wmax=50):
+    return Graph.from_arrays(
+        n,
+        rng.integers(0, n, m),
+        rng.integers(0, n, m),
+        rng.integers(1, wmax + 1, m),
+    )
+
+
+def _random_update(rng, dyn, n, wmax=50):
+    kind = str(rng.choice(["insert", "delete", "reweight"]))
+    if kind in ("delete", "reweight") and dyn._u.size and rng.random() < 0.7:
+        i = int(rng.integers(0, dyn._u.size))
+        a, b = int(dyn._u[i]), int(dyn._v[i])
+        if kind == "delete":
+            return Update("delete", a, b)
+        return Update("reweight", a, b, int(rng.integers(1, wmax + 1)))
+    a, b = (int(x) for x in rng.integers(0, n, 2))
+    while a == b:
+        a, b = (int(x) for x in rng.integers(0, n, 2))
+    if kind == "delete":
+        return Update("delete", min(a, b), max(a, b))
+    return Update("insert", min(a, b), max(a, b), int(rng.integers(1, wmax + 1)))
+
+
+def _check_exact(result, context=""):
+    ids_ref, frag_ref, _ = solve_graph(result.graph)
+    assert np.array_equal(np.sort(result.edge_ids), np.sort(ids_ref)), context
+    assert result.num_components == int(np.unique(frag_ref).size), context
+
+
+# ----------------------------------------------------------------------
+# Coalescing (the dynamic.py same-edge-pair correctness fix)
+# ----------------------------------------------------------------------
+def test_coalesce_last_write_wins_per_edge():
+    net = coalesce([
+        Update("insert", 0, 1, 5),
+        Update("reweight", 1, 0, 7),   # same edge, either orientation
+        Update("delete", 2, 3),
+        Update("insert", 2, 3, 9),     # delete -> insert nets to a set
+    ])
+    assert [(u.kind, u.u, u.v, u.w) for u in net] == [
+        ("insert", 0, 1, 7),
+        ("insert", 2, 3, 9),
+    ]
+
+
+def test_coalesce_self_cancelling_and_duplicates():
+    # insert -> delete of a never-existing edge vanishes entirely as a
+    # delete (a defined no-op); duplicate deletes collapse.
+    net = coalesce([
+        Update("insert", 4, 5, 3),
+        Update("delete", 4, 5),
+        Update("delete", 4, 5),
+    ])
+    assert [(u.kind, u.u, u.v) for u in net] == [("delete", 4, 5)]
+
+
+def test_coalesce_order_independent():
+    a = coalesce([Update("insert", 0, 1, 5), Update("delete", 2, 3),
+                  Update("reweight", 0, 1, 9)])
+    b = coalesce([Update("delete", 2, 3), Update("insert", 0, 1, 9)])
+    assert [(u.kind, u.u, u.v, u.w) for u in a] == [
+        (u.kind, u.u, u.v, u.w) for u in b
+    ]
+
+
+@pytest.mark.parametrize("seed", [0, 1])
+def test_coalesced_window_matches_arrival_order_per_update(seed):
+    """A window applied per-update in arrival order and the same window
+    coalesced-then-windowed must land on the identical forest — including
+    duplicate, reordered, and self-cancelling same-edge pairs."""
+    rng = np.random.default_rng(300 + seed)
+    n = 60
+    g = _random_graph(rng, n, 180)
+    result = minimum_spanning_forest(g)
+    seq = DynamicMST(result, resolve_threshold=10**9)
+    win = WindowedMST(result, resolve_threshold=10**9)
+    for _ in range(4):
+        raw = []
+        for _ in range(10):
+            upd = _random_update(rng, seq, n)
+            raw.append(upd)
+            if rng.random() < 0.4:  # same-edge churn: dup/reorder/cancel
+                if rng.random() < 0.5:
+                    raw.append(Update("delete", upd.u, upd.v))
+                else:
+                    raw.append(Update("insert", upd.u, upd.v,
+                                      int(rng.integers(1, 51))))
+        for upd in raw:
+            seq.apply([upd])
+        win_result, info = win.apply_window(raw)
+        assert info.coalesced_from == len(raw)
+        seq_result = seq.result()
+        assert np.array_equal(seq_result.graph.u, win_result.graph.u)
+        assert np.array_equal(seq_result.graph.w, win_result.graph.w)
+        assert np.array_equal(
+            np.sort(seq_result.edge_ids), np.sort(win_result.edge_ids)
+        )
+        _check_exact(win_result, seed)
+
+
+# ----------------------------------------------------------------------
+# Windowed batched apply: parity + escape hatches
+# ----------------------------------------------------------------------
+@pytest.mark.parametrize("seed", [0, 1, 2])
+def test_windowed_stream_parity_vs_fresh_solve(seed):
+    rng = np.random.default_rng(100 + seed)
+    n = 80
+    g = _random_graph(rng, n, 240)
+    dyn = WindowedMST(minimum_spanning_forest(g))
+    for step in range(6):
+        window = [
+            _random_update(rng, dyn, n)
+            for _ in range(int(rng.integers(1, 24)))
+        ]
+        result, info = dyn.apply_window(window)
+        assert info.mode in ("batched", "noop"), (seed, step, info.mode)
+        _check_exact(result, (seed, step))
+    assert dyn.last_mode == "window"
+
+
+def test_window_modes_agree_edge_for_edge():
+    rng = np.random.default_rng(7)
+    n = 70
+    g = _random_graph(rng, n, 200)
+    result = minimum_spanning_forest(g)
+    sessions = {
+        mode: WindowedMST(result, window_mode=mode, resolve_threshold=10**9)
+        for mode in ("batched", "sequential", "resolve")
+    }
+    for _ in range(3):
+        window = [
+            _random_update(rng, sessions["batched"], n) for _ in range(8)
+        ]
+        outs = {m: s.apply_window(window) for m, s in sessions.items()}
+        ids = {
+            m: np.sort(r.edge_ids).tolist() for m, (r, _) in outs.items()
+        }
+        assert ids["batched"] == ids["sequential"] == ids["resolve"]
+        assert outs["batched"][1].mode == "batched"
+        assert outs["sequential"][1].mode == "sequential"
+        assert outs["resolve"][1].mode == "resolve"
+
+
+def test_oversized_window_degrades_to_resolve():
+    BUS.enable()
+    BUS.clear()
+    rng = np.random.default_rng(11)
+    g = _random_graph(rng, 50, 150)
+    dyn = WindowedMST(
+        minimum_spanning_forest(g), window_resolve_threshold=3
+    )
+    window = [_random_update(rng, dyn, 50) for _ in range(12)]
+    result, info = dyn.apply_window(window)
+    assert info.mode == "resolve"
+    assert BUS.counters()["stream.window.over_threshold"] == 1
+    _check_exact(result)
+    BUS.clear()
+
+
+def test_window_verify_failure_falls_back_to_resolve(monkeypatch):
+    BUS.enable()
+    BUS.clear()
+    g = Graph.from_edges(4, [(0, 1, 1), (1, 2, 2), (2, 3, 3), (0, 3, 9)])
+    dyn = WindowedMST(minimum_spanning_forest(g))
+    monkeypatch.setattr(dyn, "_forest_ok", lambda: False)
+    result, info = dyn.apply_window([Update("reweight", 0, 1, 2)])
+    assert info.mode == "resolve"
+    assert BUS.counters()["stream.window.verify_failed"] == 1
+    assert result.total_weight == 2 + 2 + 3
+    BUS.clear()
+
+
+def test_noop_window_keeps_digest_and_reports_nothing():
+    g = Graph.from_edges(3, [(0, 1, 5), (1, 2, 6)])
+    dyn = WindowedMST(minimum_spanning_forest(g))
+    before = dyn.result().graph.digest()
+    result, info = dyn.apply_window([
+        Update("insert", 0, 2, 4), Update("delete", 0, 2),  # self-cancel
+        Update("delete", 0, 2),  # absent: no-op
+    ])
+    assert info.mode == "noop" or info.applied <= 1  # net delete is a no-op
+    assert result.graph.digest() == before
+    assert info.entered == [] and info.left == []
+    assert info.weight_delta == 0
+
+
+def test_window_notification_contents():
+    g = Graph.from_edges(4, [(0, 1, 1), (1, 2, 2), (2, 3, 3), (0, 3, 9)])
+    dyn = WindowedMST(minimum_spanning_forest(g))
+    # (0,3,9) is the only non-tree edge; make it cheap and drop (1,2).
+    result, info = dyn.apply_window([
+        Update("reweight", 0, 3, 1), Update("delete", 1, 2),
+    ])
+    assert (0, 3, 1) in info.entered
+    assert (1, 2, 2) in info.left
+    expected_delta = result.graph.w[result.edge_ids].sum() - (1 + 2 + 3)
+    assert info.weight_delta == expected_delta
+
+
+def test_window_validation_rejects_bad_updates_before_mutation():
+    g = Graph.from_edges(3, [(0, 1, 5), (1, 2, 6)])
+    dyn = WindowedMST(minimum_spanning_forest(g))
+    with pytest.raises(ValueError, match="out of range"):
+        dyn.apply_window([Update("insert", 0, 99, 2)])
+    assert not dyn.dirty
+    result, info = dyn.apply_window([Update("insert", 0, 2, 4)])
+    assert result.total_weight == 5 + 4 or result.total_weight == 5 + 6
+
+
+def test_state_arrays_round_trip_without_solving(monkeypatch):
+    rng = np.random.default_rng(5)
+    g = _random_graph(rng, 40, 120)
+    dyn = WindowedMST(minimum_spanning_forest(g))
+    dyn.apply_window([_random_update(rng, dyn, 40) for _ in range(6)])
+    state = dyn.state_arrays()
+    import distributed_ghs_implementation_tpu.serve.dynamic as dyn_mod
+
+    def bomb(*a, **k):
+        raise AssertionError("from_state must not solve")
+
+    monkeypatch.setattr(dyn_mod, "minimum_spanning_forest", bomb)
+    rebuilt = WindowedMST.from_state(state)
+    assert rebuilt.result().graph.digest() == dyn.result().graph.digest()
+    assert np.array_equal(
+        np.sort(rebuilt.result().edge_ids), np.sort(dyn.result().edge_ids)
+    )
+
+
+# ----------------------------------------------------------------------
+# Durable log: torn tail, .bak fallback, chain breaks, compaction
+# ----------------------------------------------------------------------
+def _seed_log(tmp_path, windows=3):
+    log = UpdateLog(str(tmp_path), "s1")
+    log.snapshot(
+        {"num_nodes": np.asarray(4), "u": np.arange(3), "v": np.arange(1, 4),
+         "w": np.ones(3, dtype=np.int64), "in_tree": np.ones(3, dtype=bool)},
+        seq=0, digest="d0",
+    )
+    for i in range(1, windows + 1):
+        log.append(seq=i, prev_digest=f"d{i-1}", digest=f"d{i}",
+                   updates=[{"kind": "insert", "u": 0, "v": i, "w": i}])
+    return log
+
+
+def test_log_round_trip_and_chaining(tmp_path):
+    log = _seed_log(tmp_path, windows=3)
+    state, entries, notes = log.load()
+    assert state is not None and state["seq"] == 0 and state["digest"] == "d0"
+    assert [e["seq"] for e in entries] == [1, 2, 3]
+    assert entries[-1]["digest"] == "d3"
+
+
+def test_log_torn_tail_is_skipped_not_fatal(tmp_path):
+    BUS.enable()
+    BUS.clear()
+    log = _seed_log(tmp_path, windows=3)
+    with open(log.wal_path, "rb+") as f:
+        f.seek(-9, os.SEEK_END)
+        f.truncate()  # tear mid-record, no trailing newline
+    state, entries, _notes = log.load()
+    assert [e["seq"] for e in entries] == [1, 2]  # the torn third is gone
+    assert BUS.counters()["stream.log.torn_skipped"] >= 1
+    BUS.clear()
+
+
+def test_log_append_seals_torn_tail_keeping_both_parseable(tmp_path):
+    """A retried append after a torn tail must not fuse the new record
+    onto the partial line: the garbage is sealed onto its own line (and
+    skipped on read) so the committed retry replays."""
+    BUS.enable()
+    BUS.clear()
+    log = UpdateLog(str(tmp_path), "s")
+    log.append(seq=1, prev_digest="a", digest="b", updates=[])
+    with open(log.wal_path, "a") as f:
+        f.write('{"schema": "ghs-stream-wal-v1", "seq": 2, "pre')  # torn
+    log.append(seq=2, prev_digest="b", digest="c", updates=[])
+    entries, _torn = log._read_wal()
+    assert [e["seq"] for e in entries] == [1, 2]
+    counters = BUS.counters()
+    assert counters["stream.log.sealed_torn"] == 1
+    assert counters["stream.log.corrupt_line"] == 1  # the sealed garbage
+    BUS.clear()
+
+
+def test_log_snapshot_bak_fallback(tmp_path):
+    BUS.enable()
+    BUS.clear()
+    log = _seed_log(tmp_path, windows=1)
+    # A second snapshot rotates the first to .bak; then tear the primary.
+    log.snapshot(
+        {"num_nodes": np.asarray(4), "u": np.arange(3), "v": np.arange(1, 4),
+         "w": np.ones(3, dtype=np.int64), "in_tree": np.ones(3, dtype=bool)},
+        seq=1, digest="d1",
+    )
+    with open(log.snap_path, "wb") as f:
+        f.write(b"torn")
+    state, notes = log.load_snapshot()
+    assert state is not None and state["seq"] == 0  # the .bak generation
+    assert BUS.counters()["stream.log.snap_fallback"] == 1
+    assert any("torn" not in p and why != "missing" for p, why in notes)
+    BUS.clear()
+
+
+def test_log_chain_break_stops_replay_at_disagreement(tmp_path):
+    """Snapshot/log disagreement: a WAL whose entries do not follow from
+    the snapshot replays only the verifiable prefix."""
+    BUS.enable()
+    BUS.clear()
+    log = _seed_log(tmp_path, windows=3)
+    # Corrupt entry 2's chain: its prev no longer matches entry 1's digest.
+    with open(log.wal_path) as f:
+        lines = [json.loads(line) for line in f if line.strip()]
+    lines[1]["prev"] = "divergent"
+    with open(log.wal_path, "w") as f:
+        for rec in lines:
+            f.write(json.dumps(rec) + "\n")
+    state, entries, notes = log.load()
+    assert [e["seq"] for e in entries] == [1]
+    assert BUS.counters()["stream.log.chain_broken"] == 1
+    assert any("chain break" in why for _p, why in notes)
+    BUS.clear()
+
+
+def test_log_chain_break_repair_lets_append_extend_recovered_head(tmp_path):
+    """load() truncates the WAL past a chain break: append validates
+    against the LAST parsable line, so leaving the unreachable tail in
+    place would refuse every publish from the recovered head forever
+    (ChainBreak -> StaleDigest with the dead tail digest -> the client
+    adopts it -> the session recovers back to the chained head: a re-sync
+    livelock)."""
+    BUS.enable()
+    BUS.clear()
+    log = _seed_log(tmp_path, windows=3)
+    with open(log.wal_path) as f:
+        lines = [json.loads(line) for line in f if line.strip()]
+    lines[1]["prev"] = "divergent"
+    with open(log.wal_path, "w") as f:
+        for rec in lines:
+            f.write(json.dumps(rec) + "\n")
+    state, entries, _notes = log.load()
+    assert [e["seq"] for e in entries] == [1]
+    assert BUS.counters()["stream.log.chain_truncated"] == 1
+    # The durable tail now IS the recovered head, so extending it works.
+    assert log._durable_head() == (1, "d1")
+    log.append(seq=2, prev_digest="d1", digest="d2-repaired", updates=[])
+    state, entries, _notes = log.load()
+    assert [(e["seq"], e["digest"]) for e in entries] == [
+        (1, "d1"), (2, "d2-repaired"),
+    ]
+    BUS.clear()
+
+
+def test_log_compaction_drops_covered_entries(tmp_path):
+    log = _seed_log(tmp_path, windows=4)
+    log.snapshot(
+        {"num_nodes": np.asarray(4), "u": np.arange(3), "v": np.arange(1, 4),
+         "w": np.ones(3, dtype=np.int64), "in_tree": np.ones(3, dtype=bool)},
+        seq=3, digest="d3",
+    )
+    entries, _ = log._read_wal()
+    assert [e["seq"] for e in entries] == [4]  # 1..3 compacted away
+    state, chained, _ = log.load()
+    assert state["seq"] == 3 and [e["seq"] for e in chained] == [4]
+
+
+def test_log_two_process_flock_hammer(tmp_path):
+    """Two real processes appending to one stream WAL concurrently must
+    interleave cleanly — every line whole, parseable, and accounted for
+    (mirrors the round-12 store hammer) — AND come out as ONE chain: an
+    append that lost the race gets ChainBreak (the fork guard) instead of
+    forking the log, so each writer re-reads the durable tail and
+    retries."""
+    wal_dir = str(tmp_path / "shared")
+    child = (
+        "import sys\n"
+        "from distributed_ghs_implementation_tpu.stream.log import (\n"
+        "    ChainBreak, UpdateLog)\n"
+        "log = UpdateLog(sys.argv[1], 'hammer')\n"
+        "who = sys.argv[2]\n"
+        "done = 0\n"
+        "while done < 25:\n"
+        "    tail = log._durable_head()  # racy peek; append re-validates\n"
+        "    seq = (tail[0] if tail else 0) + 1\n"
+        "    prev = tail[1] if tail else 'seed'\n"
+        "    try:\n"
+        "        log.append(seq=seq, prev_digest=prev,\n"
+        "                   digest=f'{who}-{seq}',\n"
+        "                   updates=[{'kind': 'insert', 'u': 0, 'v': 1,\n"
+        "                             'w': seq}])\n"
+        "    except ChainBreak:\n"
+        "        continue  # the other writer committed first\n"
+        "    done += 1\n"
+    )
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = {**os.environ, "JAX_PLATFORMS": "cpu",
+           "PYTHONPATH": repo + os.pathsep + os.environ.get("PYTHONPATH", "")}
+    procs = [
+        subprocess.Popen([sys.executable, "-c", child, wal_dir, who],
+                         env=env, stdout=subprocess.PIPE,
+                         stderr=subprocess.PIPE)
+        for who in ("a", "b")
+    ]
+    for p in procs:
+        _, err = p.communicate(timeout=120)
+        assert p.returncode == 0, err.decode()
+    log = UpdateLog(wal_dir, "hammer")
+    with open(log.wal_path) as f:
+        lines = [line for line in f.read().split("\n") if line]
+    records = [json.loads(line) for line in lines]  # every line parses
+    assert len(records) == 50
+    assert [rec["seq"] for rec in records] == list(range(1, 51))
+    prev = "seed"
+    for rec in records:  # one unforked chain across both writers
+        assert rec["prev"] == prev
+        prev = rec["digest"]
+    writers = [rec["digest"].split("-")[0] for rec in records]
+    assert writers.count("a") == 25 and writers.count("b") == 25
+
+
+# ----------------------------------------------------------------------
+# Replay: recovery without a single fresh solve
+# ----------------------------------------------------------------------
+def _drive_stream(root, *, windows=5, snapshot_every=2, seed=9):
+    rng = np.random.default_rng(seed)
+    g = gnm_random_graph(60, 180, seed=seed)
+    result = minimum_spanning_forest(g)
+    mgr = StreamManager(root=root, snapshot_every=snapshot_every)
+    session = mgr.subscribe(digest=g.digest(), result=result)
+    head = session.head
+    for _ in range(windows):
+        window = [
+            upd.__dict__
+            for upd in (
+                _random_update(rng, session.mst, 60) for _ in range(4)
+            )
+        ]
+        head = mgr.publish(session.id, head, window)["digest"]
+    return mgr, session, head
+
+
+def test_replay_recovers_head_and_notifications_without_solving(
+    tmp_path, monkeypatch
+):
+    BUS.enable()
+    root = str(tmp_path)
+    _mgr, session, head = _drive_stream(root, windows=5, snapshot_every=2)
+    import distributed_ghs_implementation_tpu.serve.dynamic as dyn_mod
+
+    def bomb(*a, **k):
+        raise AssertionError("replay must never solve")
+
+    monkeypatch.setattr(dyn_mod, "minimum_spanning_forest", bomb)
+    BUS.clear()
+    fresh = StreamManager(root=root, snapshot_every=2)
+    recovered = fresh.recover(session.id)
+    assert recovered is not None
+    assert recovered.head == head
+    assert recovered.seq == 5
+    # The full notification ring is available again: gap/dup-free 1..5.
+    poll = fresh.poll(session.id, after_seq=0)
+    seqs = [n["seq"] for n in poll["notifications"]]
+    assert poll_gap_check(seqs, poll["seq"]) == {"gaps": 0, "dups": 0}
+    counters = BUS.counters()
+    assert counters["stream.replay.streams"] == 1
+    assert counters["stream.replay.windows"] >= 1
+    BUS.clear()
+
+
+def test_subscribe_by_seed_digest_recovers_after_restart(tmp_path):
+    """A restarted process that never solved the seed can still subscribe
+    by the SEED digest: the stream id derives from it, so recovery finds
+    the on-disk log even though the head has long moved on."""
+    root = str(tmp_path)
+    _mgr, session, head = _drive_stream(root, windows=3)
+    seed_digest = gnm_random_graph(60, 180, seed=9).digest()
+    fresh = StreamManager(root=root)
+    recovered = fresh.subscribe(digest=seed_digest)
+    assert recovered.id == session.id
+    assert recovered.head == head
+
+
+def test_publish_against_stale_head_raises_with_current_head(tmp_path):
+    _mgr, session, head = _drive_stream(str(tmp_path), windows=2)
+    with pytest.raises(StaleDigest) as exc:
+        _mgr.publish(session.id, "not-the-head", [])
+    assert exc.value.head == head
+    assert exc.value.seq == 2
+
+
+def test_poll_gap_check():
+    assert poll_gap_check([1, 2, 3], 3) == {"gaps": 0, "dups": 0}
+    assert poll_gap_check([1, 3], 3) == {"gaps": 1, "dups": 0}
+    assert poll_gap_check([1, 2, 2, 3], 3) == {"gaps": 0, "dups": 1}
+    # A mid-chain joiner (subscribe returned seq=40) only owes 41+.
+    assert poll_gap_check([41, 42], 42, start_seq=40) == {"gaps": 0, "dups": 0}
+    assert poll_gap_check([42], 42, start_seq=40) == {"gaps": 1, "dups": 0}
+
+
+# ----------------------------------------------------------------------
+# Service-level verbs + store chain eviction
+# ----------------------------------------------------------------------
+@pytest.fixture
+def stream_service(tmp_path):
+    from distributed_ghs_implementation_tpu.serve.service import MSTService
+
+    BUS.enable()
+    BUS.clear()
+    yield MSTService(
+        stream_dir=str(tmp_path / "streams"), stream_snapshot_every=2
+    )
+    BUS.clear()
+
+
+def _solve_request(g, **extra):
+    return {
+        "op": "solve",
+        "num_nodes": g.num_nodes,
+        "edges": [[int(a), int(b), int(c)] for a, b, c in zip(g.u, g.v, g.w)],
+        **extra,
+    }
+
+
+def test_service_subscribe_publish_poll_flow(stream_service):
+    g = gnm_random_graph(50, 150, seed=21)
+    solved = stream_service.handle(_solve_request(g))
+    assert solved["ok"]
+    sub = stream_service.handle({"op": "subscribe", "digest": solved["digest"]})
+    assert sub["ok"] and sub["seq"] == 0
+    head = sub["digest"]
+    for i in range(3):
+        pub = stream_service.handle({
+            "op": "publish", "stream": sub["stream"], "digest": head,
+            "updates": [{"kind": "insert", "u": 0, "v": 10 + i, "w": 1}],
+        })
+        assert pub["ok"], pub
+        assert pub["prev_digest"] == head
+        assert pub["seq"] == i + 1
+        head = pub["digest"]
+    assert pub["notification"]["entered"]
+    poll = stream_service.handle({
+        "op": "poll", "stream": sub["stream"], "after_seq": 0,
+    })
+    assert [n["seq"] for n in poll["notifications"]] == [1, 2, 3]
+    assert poll["digest"] == head
+    # Stale publish: structured re-sync response, not a generic error.
+    stale = stream_service.handle({
+        "op": "publish", "stream": sub["stream"], "digest": sub["digest"],
+        "updates": [],
+    })
+    assert stale["ok"] is False and stale["stale"] is True
+    assert stale["digest"] == head and stale["seq"] == 3
+    stats = stream_service.handle({"op": "stats"})
+    assert stats["streams"] == 1
+    # snapshot_every=2 and 3 commits → a durable snapshot exists, so the
+    # stream also counts as recoverable-from-disk.
+    assert stats["streams_recoverable"] == 1
+    assert stats["counters"]["stream.window.committed"] == 3
+
+
+def test_service_publish_evicts_chain_ancestor_from_lru(stream_service):
+    from distributed_ghs_implementation_tpu.serve.store import (
+        cache_key_for_digest,
+    )
+
+    g = gnm_random_graph(50, 150, seed=22)
+    solved = stream_service.handle(_solve_request(g))
+    sub = stream_service.handle({"op": "subscribe", "digest": solved["digest"]})
+    pub = stream_service.handle({
+        "op": "publish", "stream": sub["stream"], "digest": sub["digest"],
+        "updates": [{"kind": "insert", "u": 1, "v": 7, "w": 2}],
+    })
+    assert pub["ok"]
+    store = stream_service.store
+    assert store.get(
+        cache_key_for_digest(sub["digest"]), record_miss=False
+    ) is None  # the superseded ancestor left the LRU
+    assert store.get(
+        cache_key_for_digest(pub["digest"]), record_miss=False
+    ) is not None  # the new head is cached
+    assert BUS.counters()["serve.store.chain_evicted"] >= 1
+
+
+def test_service_noop_publish_keeps_head_cached(stream_service):
+    """A window with no net effect (prev == new digest) must not evict
+    the result it just cached — the chain did not move."""
+    from distributed_ghs_implementation_tpu.serve.store import (
+        cache_key_for_digest,
+    )
+
+    g = gnm_random_graph(50, 150, seed=23)
+    solved = stream_service.handle(_solve_request(g))
+    sub = stream_service.handle({"op": "subscribe", "digest": solved["digest"]})
+    before = BUS.counters().get("serve.store.chain_evicted", 0)
+    pub = stream_service.handle({
+        "op": "publish", "stream": sub["stream"], "digest": sub["digest"],
+        "updates": [],
+    })
+    assert pub["ok"] and pub["mode"] == "noop"
+    assert pub["digest"] == pub["prev_digest"] == sub["digest"]
+    assert stream_service.store.get(
+        cache_key_for_digest(sub["digest"]), record_miss=False
+    ) is not None  # the head survived its own commit
+    assert BUS.counters().get("serve.store.chain_evicted", 0) == before
+
+
+def test_service_subscribe_falls_back_to_store_after_session_eviction(
+    tmp_path,
+):
+    """The parked update-session seed is LRU-bounded; subscribe-by-digest
+    must fall back to the result store so the advertised
+    recover-by-resubscribe path survives session churn without a solve."""
+    from distributed_ghs_implementation_tpu.serve.service import MSTService
+
+    BUS.enable()
+    BUS.clear()
+    service = MSTService(
+        stream_dir=str(tmp_path / "streams"),
+        max_sessions=1,  # the next solve evicts the parked seed
+    )
+    g = gnm_random_graph(40, 120, seed=31)
+    solved = service.handle(_solve_request(g))
+    assert solved["ok"]
+    other = gnm_random_graph(40, 120, seed=32)
+    assert service.handle(_solve_request(other))["ok"]
+    assert solved["digest"] not in service._sessions  # seed evicted
+    sub = service.handle({"op": "subscribe", "digest": solved["digest"]})
+    assert sub["ok"], sub  # seeded from the store's memory LRU
+    assert sub["digest"] == solved["digest"] and sub["seq"] == 0
+    BUS.clear()
+
+
+def test_service_subscribe_unknown_digest_errors(stream_service):
+    out = stream_service.handle({"op": "subscribe", "digest": "nope"})
+    assert out["ok"] is False
+    assert "solve the graph first" in out["error"]
+
+
+def test_store_evict_chain_unit():
+    from distributed_ghs_implementation_tpu.serve.store import ResultStore
+
+    store = ResultStore(capacity=4)
+    g = gnm_random_graph(20, 40, seed=1)
+    res = minimum_spanning_forest(g)
+    store.put("k1:device", res)
+    assert store.evict_chain("k1:device") is True
+    assert store.evict_chain("k1:device") is False  # already gone
+    assert len(store) == 0
+
+
+# ----------------------------------------------------------------------
+# SLO taxonomy + warmup plumbing
+# ----------------------------------------------------------------------
+def test_slo_joins_stream_window_spans_per_class():
+    from distributed_ghs_implementation_tpu.obs import slo
+
+    bus_events = [
+        ("X", "serve.request", "serve", 0, 2_000_000, 0,
+         {"cls": "publish", "ok": True}),
+        ("X", "stream.window", "stream", 0, 1_000_000, 0,
+         {"cls": "publish", "mode": "batched"}),
+    ]
+    stats = slo.ClassStats()
+    slo.ingest_bus_events(stats, bus_events)
+    summary = slo.assemble(stats, wall_s=1.0)
+    cls = summary["classes"]["publish"]
+    assert cls["window_s"]["count"] == 1
+    assert abs(cls["window_s"]["p50"] - 0.001) < 1e-9
+
+
+def test_warmup_plan_carries_stream_buckets():
+    from distributed_ghs_implementation_tpu.batch.warmup import (
+        plan_from_flags,
+        run_warmup,
+    )
+
+    plan = plan_from_flags(stream_buckets="64x128")
+    assert plan.stream_buckets == ((64, 128),)
+    report = run_warmup(plan)
+    assert report["stream_warmed"] >= 1
+
+
+# ----------------------------------------------------------------------
+# Fleet failover (slow: spawns real jax workers; CI's stream kill drill
+# covers the same path end-to-end)
+# ----------------------------------------------------------------------
+@pytest.mark.slow
+def test_fleet_stream_failover_replays_on_survivor(tmp_path):
+    from distributed_ghs_implementation_tpu.fleet.router import (
+        FleetConfig,
+        FleetRouter,
+    )
+
+    config = FleetConfig(
+        workers=2,
+        disk_dir=str(tmp_path / "store"),
+        stream_dir=str(tmp_path / "streams"),
+        stream_snapshot_every=2,
+        ready_timeout_s=180.0,
+    )
+    g = gnm_random_graph(50, 150, seed=31)
+    with FleetRouter(config) as router:
+        solved = router.handle(_solve_request(g))
+        assert solved["ok"]
+        sub = router.handle({"op": "subscribe", "digest": solved["digest"]})
+        assert sub["ok"]
+        head = sub["digest"]
+        for i in range(3):
+            pub = router.handle({
+                "op": "publish", "stream": sub["stream"], "digest": head,
+                "updates": [{"kind": "insert", "u": 0, "v": 9 + i, "w": 1}],
+            })
+            assert pub["ok"], pub
+            head = pub["digest"]
+        owner = pub["worker"]
+        router.kill_worker(owner)
+        # The next publish lands on the survivor (or the restarted
+        # incarnation), which must recover the stream from the shared
+        # snapshot+WAL — same head, same sequence, no gap.
+        pub = router.handle({
+            "op": "publish", "stream": sub["stream"], "digest": head,
+            "updates": [{"kind": "insert", "u": 1, "v": 20, "w": 1}],
+        })
+        assert pub["ok"], pub
+        assert pub["seq"] == 4
+        poll = router.handle({
+            "op": "poll", "stream": sub["stream"], "digest": pub["digest"],
+            "after_seq": 0,
+        })
+        assert poll["ok"]
+        seqs = [n["seq"] for n in poll["notifications"]]
+        assert poll_gap_check(seqs, poll["seq"]) == {"gaps": 0, "dups": 0}
+
+
+# ----------------------------------------------------------------------
+# Failure paths: poisoning, commit ordering, replay chaining, LRU bound
+# ----------------------------------------------------------------------
+def test_publish_poisoned_on_mid_window_failure(tmp_path):
+    """An apply that dies mid-mutation leaves a forest no client has seen:
+    the session must be dropped (stream.poisoned) and the next publish
+    must recover the clean pre-window state from the durable log."""
+    BUS.enable()
+    BUS.clear()
+    root = str(tmp_path)
+    mgr, session, head = _drive_stream(root, windows=2, snapshot_every=10)
+
+    real_apply = WindowedMST.apply_window
+
+    def dies_dirty(self, updates):
+        self._dirty = True
+        raise RuntimeError("boom mid-window")
+
+    session.mst.apply_window = dies_dirty.__get__(session.mst)
+    with pytest.raises(RuntimeError, match="boom"):
+        mgr.publish(session.id, head, [{"kind": "insert", "u": 0, "v": 1, "w": 1}])
+    assert BUS.counters()["stream.poisoned"] == 1
+    assert len(mgr) == 0  # dropped, not retained dirty
+    # The retry recovers seq 2 from snapshot+WAL and commits seq 3 cleanly.
+    out = mgr.publish(
+        session.id, head, [{"kind": "insert", "u": 0, "v": 1, "w": 1}]
+    )
+    assert out["seq"] == 3
+    poll = mgr.poll(session.id, after_seq=0)
+    seqs = [n["seq"] for n in poll["notifications"]]
+    assert poll_gap_check(seqs, poll["seq"]) == {"gaps": 0, "dups": 0}
+    assert WindowedMST.apply_window is real_apply  # class left untouched
+    BUS.clear()
+
+
+def test_publish_wal_failure_yields_no_duplicate_notification(tmp_path):
+    """The WAL append is the commit point: a failed append must not leave
+    a notification in the ring, so the client's retry cannot produce two
+    notifications for one sequence number."""
+    BUS.enable()
+    BUS.clear()
+    root = str(tmp_path)
+    mgr, session, head = _drive_stream(root, windows=2, snapshot_every=10)
+
+    def refuses(**kwargs):
+        raise OSError("disk full")
+
+    session.log.append = refuses
+    with pytest.raises(OSError):
+        mgr.publish(session.id, head, [{"kind": "insert", "u": 0, "v": 1, "w": 1}])
+    assert BUS.counters()["stream.poisoned"] == 1
+    # Recovery rebuilt the pre-failure state; the retry commits ONE seq 3.
+    out = mgr.publish(
+        session.id, head, [{"kind": "insert", "u": 0, "v": 1, "w": 1}]
+    )
+    assert out["seq"] == 3
+    poll = mgr.poll(session.id, after_seq=0)
+    seqs = [n["seq"] for n in poll["notifications"]]
+    assert seqs.count(3) == 1
+    assert poll_gap_check(seqs, poll["seq"]) == {"gaps": 0, "dups": 0}
+    BUS.clear()
+
+
+def test_recover_chains_wal_on_stored_snapshot_digest(tmp_path):
+    """When the snapshot's stored digest disagrees with the digest
+    recomputed from its arrays (the digest_mismatch path), the WAL still
+    chains from the STORED digest — replay must follow it rather than
+    silently dropping every post-snapshot window."""
+    BUS.enable()
+    root = str(tmp_path)
+    _mgr, session, head = _drive_stream(root, windows=1, snapshot_every=10)
+    log = UpdateLog(root, session.id)
+    # Rewrite the stored seed digest (snapshot + the entry chained from
+    # it) to a value the arrays can no longer re-derive.
+    with np.load(log.snap_path) as data:
+        arrays = {k: np.asarray(data[k]) for k in data.files}
+    arrays["digest"] = np.asarray("tampered-stored-digest")
+    np.savez(log.snap_path, **arrays)
+    with open(log.wal_path) as f:
+        entries = [json.loads(line) for line in f.read().splitlines() if line]
+    entries[0]["prev"] = "tampered-stored-digest"
+    with open(log.wal_path, "w") as f:
+        for e in entries:
+            f.write(json.dumps(e) + "\n")
+    BUS.clear()
+    fresh = StreamManager(root=root, snapshot_every=10)
+    recovered = fresh.recover(session.id)
+    counters = BUS.counters()
+    assert counters["stream.replay.digest_mismatch"] == 1
+    assert counters.get("stream.replay.diverged", 0) == 0
+    assert recovered.seq == 1  # the post-snapshot window was NOT dropped
+    assert recovered.head == head
+    BUS.clear()
+
+
+def test_append_refuses_fork_from_stale_tail(tmp_path):
+    """An append that does not extend the durable tail raises ChainBreak
+    (carrying the durable head) instead of writing a forked entry — for
+    both tail sources: the last WAL entry, and the snapshot head after
+    compaction emptied the WAL."""
+    BUS.enable()
+    BUS.clear()
+    log = _seed_log(tmp_path, windows=2)
+    with pytest.raises(ChainBreak) as exc:
+        log.append(seq=2, prev_digest="d1", digest="fork",
+                   updates=[])  # duplicate seq: tail is (2, d2)
+    assert (exc.value.seq, exc.value.digest) == (2, "d2")
+    entries, _ = log._read_wal()
+    assert [e["digest"] for e in entries] == ["d1", "d2"]  # no fork landed
+    # Compact the WAL to empty: the snapshot head still guards the chain.
+    log.snapshot(
+        {"num_nodes": np.asarray(4), "u": np.arange(3), "v": np.arange(1, 4),
+         "w": np.ones(3, dtype=np.int64), "in_tree": np.ones(3, dtype=bool)},
+        seq=2, digest="d2",
+    )
+    assert log._read_wal()[0] == []
+    with pytest.raises(ChainBreak):
+        log.append(seq=2, prev_digest="d1", digest="fork", updates=[])
+    log.append(seq=3, prev_digest="d2", digest="d3", updates=[])  # extends
+    assert BUS.counters()["stream.log.fork_refused"] == 2
+    BUS.clear()
+
+
+def test_publish_fork_refused_across_sharing_processes(tmp_path):
+    """Two managers sharing one stream root (fleet workers after a
+    re-pin): the one holding a stale resident copy passes its in-memory
+    staleness check, but the WAL fork guard bounces its publish as
+    StaleDigest carrying the DURABLE head — and no second entry for the
+    contested sequence number reaches the shared log."""
+    BUS.enable()
+    BUS.clear()
+    root = str(tmp_path)
+    mgr_a, session, head2 = _drive_stream(root, windows=2)
+    mgr_b = StreamManager(root=root)
+    stale = mgr_b.subscribe(stream=session.id)  # resident at seq 2
+    assert stale.head == head2
+    out = mgr_a.publish(
+        session.id, head2, [{"kind": "insert", "u": 0, "v": 9, "w": 7}]
+    )  # the pinned worker commits seq 3
+    with pytest.raises(StaleDigest) as exc:
+        mgr_b.publish(
+            stale.id, head2, [{"kind": "insert", "u": 1, "v": 8, "w": 5}]
+        )
+    assert exc.value.head == out["digest"]
+    assert exc.value.seq == 3
+    counters = BUS.counters()
+    assert counters["stream.log.fork_refused"] == 1
+    assert counters["stream.publish.stale"] == 1
+    assert counters.get("stream.poisoned", 0) == 0  # a re-sync, not poison
+    # The shared WAL holds exactly one seq-3 entry: the pinned worker's.
+    entries, _ = UpdateLog(root, session.id)._read_wal()
+    assert [e["seq"] for e in entries].count(3) == 1
+    assert entries[-1]["digest"] == out["digest"]
+    # The stale manager recovers the durable head on its next verb (its
+    # forked resident copy was dropped by the refusal).
+    poll = mgr_b.poll(session.id, after_seq=0)
+    assert poll["digest"] == out["digest"] and poll["seq"] == 3
+    seqs = [n["seq"] for n in poll["notifications"]]
+    assert poll_gap_check(seqs, poll["seq"]) == {"gaps": 0, "dups": 0}
+    BUS.clear()
+
+
+def test_move_head_never_maps_evicted_session(tmp_path):
+    """A publish whose session lost the LRU race must not re-insert its
+    new head into the digest index: every _by_head entry always points at
+    a resident stream (the dangling-mapping leak)."""
+    root = str(tmp_path)
+    mgr = StreamManager(root=root, max_streams=1)
+    g1 = gnm_random_graph(40, 120, seed=21)
+    s1 = mgr.subscribe(digest=g1.digest(), result=minimum_spanning_forest(g1))
+    g2 = gnm_random_graph(40, 120, seed=22)
+    mgr.subscribe(digest=g2.digest(), result=minimum_spanning_forest(g2))
+    assert s1.id not in mgr.heads()  # s1 was evicted by s2
+    # Simulate s1's in-flight publish completing after the eviction.
+    prev = s1.head
+    s1.head = "post-eviction-head"
+    mgr._move_head(s1, prev)
+    with mgr._lock:
+        assert "post-eviction-head" not in mgr._by_head
+        assert all(sid in mgr._streams for sid in mgr._by_head.values())
+
+
+def test_stream_manager_lru_bound_evicts_and_recovers(tmp_path):
+    """Streams are bounded like update sessions: past max_streams the
+    least-recently-used stream leaves memory (stream.evicted) but stays
+    reachable — its next verb replays it from the durable log."""
+    BUS.enable()
+    BUS.clear()
+    root = str(tmp_path)
+    mgr = StreamManager(root=root, max_streams=2)
+    sessions = []
+    for seed in (1, 2, 3):
+        g = gnm_random_graph(40, 120, seed=seed)
+        result = minimum_spanning_forest(g)
+        sessions.append(mgr.subscribe(digest=g.digest(), result=result))
+    assert len(mgr) == 2
+    counters = BUS.counters()
+    assert counters["stream.evicted"] == 1
+    first = sessions[0]
+    assert first.id not in mgr.heads()
+    # The evicted stream recovers transparently on its next verb.
+    poll = mgr.poll(first.id, after_seq=0)
+    assert poll["digest"] == first.head
+    assert BUS.counters()["stream.replay.streams"] == 1
+    assert len(mgr) == 2  # recovery itself respects the bound
+    BUS.clear()
+
+
+def test_subscribe_by_mid_chain_head_recovers_evicted_stream(tmp_path):
+    """Log dirs are keyed by the SEED digest, so an evicted stream
+    addressed by its current head must be found by scanning durable
+    heads — silently creating a fresh seq-0 stream instead would leave
+    re-subscribing pollers (cursors at the old sequence) waiting
+    forever."""
+    mgr = StreamManager(root=str(tmp_path), max_streams=1)
+    g1 = gnm_random_graph(40, 120, seed=41)
+    r1 = minimum_spanning_forest(g1)
+    s1 = mgr.subscribe(digest=g1.digest(), result=r1)
+    out = mgr.publish(
+        s1.id, s1.head, [{"kind": "insert", "u": 0, "v": 1, "w": 1}]
+    )
+    head = out["digest"]
+    g2 = gnm_random_graph(40, 120, seed=42)
+    mgr.subscribe(digest=g2.digest(), result=minimum_spanning_forest(g2))
+    assert s1.id not in mgr.heads()  # evicted
+    # Re-subscribe by the CURRENT head (not the seed): even with a seed
+    # result in hand, this must recover the existing stream, not fork.
+    again = mgr.subscribe(digest=head, result=r1)
+    assert again.id == s1.id
+    assert again.seq == 1 and again.head == head
+
+
+def test_publish_on_commit_runs_under_session_lock_with_chain_args(tmp_path):
+    """The on_commit hook (the service's cache/residency maintenance)
+    must run INSIDE the session lock so concurrent publishes keep per-head
+    bookkeeping in seq order — after publish returns, a later window's
+    chain eviction could land before an earlier window's insert."""
+    mgr = StreamManager(root=str(tmp_path))
+    g = gnm_random_graph(40, 120, seed=43)
+    session = mgr.subscribe(digest=g.digest(), result=minimum_spanning_forest(g))
+    seed_head = session.head
+    calls = []
+
+    def on_commit(result, prev_digest, new_digest):
+        # Non-blocking acquire fails iff the session lock is held.
+        assert not session.lock.acquire(blocking=False)
+        calls.append((result, prev_digest, new_digest))
+
+    out = mgr.publish(
+        session.id, seed_head,
+        [{"kind": "insert", "u": 0, "v": 1, "w": 1}],
+        on_commit=on_commit,
+    )
+    assert len(calls) == 1
+    result, prev_digest, new_digest = calls[0]
+    assert prev_digest == seed_head
+    assert new_digest == out["digest"]
+    assert result.graph.digest() == new_digest

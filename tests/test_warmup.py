@@ -146,7 +146,7 @@ def test_run_warmup_reports_compiled_vs_cached():
     assert run_warmup(WarmupPlan()) == {
         "buckets": 0, "compiled": 0, "cached": 0, "skipped": 0,
         "single_warmed": 0, "mesh_warmed": 0, "mesh_skipped": 0,
-        "wall_s": 0.0,
+        "stream_warmed": 0, "wall_s": 0.0,
     }
 
 

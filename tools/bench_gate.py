@@ -95,6 +95,18 @@ KINDS = {
     "session_resets": "exact",
     "worker_restarts": "exact",
     "requeued": "exact",
+    # gate-stream-v1 (tools/load_drill.py --update-heavy): the
+    # subscription contract is exact — a notification gap or duplicate, a
+    # stream forced to re-sync, or ANY fresh solve while streams are live
+    # is a correctness failure, never a tolerance question.
+    "notify_gaps": "exact",
+    "notify_dups": "exact",
+    "drain_errors": "exact",
+    "stream_resets": "exact",
+    "fresh_solves": "exact",
+    # gate-stream-bench-v1 (bench.py --update-stream): the windowed-vs-
+    # sequential ratio is a wall-clock pair — gate as a throughput floor.
+    "window_speedup": "throughput",
 }
 
 

@@ -72,6 +72,9 @@ WORKLOAD = "gate-load-v1"
 WORKLOAD_FLEET = "gate-fleet-v1"
 WORKLOAD_FLEET_KILL = "gate-fleet-kill-v1"
 WORKLOAD_OVERSIZE = "gate-oversize-v1"
+WORKLOAD_STREAM = "gate-stream-v1"
+WORKLOAD_STREAM_FLEET = "gate-stream-fleet-v1"
+WORKLOAD_STREAM_KILL = "gate-stream-kill-v1"
 DEFAULT_BASELINE = os.path.join(
     os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
     "docs",
@@ -85,6 +88,8 @@ BATCH_SHAPE = (128, 400)
 HIT_SHAPE = (64, 180)
 UPDATE_SHAPE = (80, 240)
 OVERSIZE_SHAPE = (70_000, 140_000)
+STREAM_SHAPE = (128, 384)  # subscribed graphs (--update-heavy)
+STREAM_WINDOW_UPDATES = 6  # edge mutations per published window
 
 
 @dataclasses.dataclass
@@ -260,9 +265,94 @@ def build_deck(args, rng: np.random.Generator):
     return schedule, warm_graphs, stream_seeds, counts
 
 
+def _stream_window(rng: np.random.Generator, seed_graph, size: int) -> list:
+    """One published window, as JSON-ready dicts: the shared seeded
+    generator (:func:`stream.window.random_update_stream` — also the
+    ``bench.py --update-stream`` workload) with an insert-heavy mix."""
+    from distributed_ghs_implementation_tpu.stream.window import (
+        random_update_stream,
+    )
+
+    window = []
+    for upd in random_update_stream(
+        rng, seed_graph, size,
+        kinds=("insert", "insert", "delete", "reweight"), max_w=200,
+    ):
+        d = {"kind": upd.kind, "u": upd.u, "v": upd.v}
+        if upd.w is not None:
+            d["w"] = upd.w
+        window.append(d)
+    return window
+
+
+def build_stream_deck(args, rng: np.random.Generator):
+    """The ``--update-heavy`` deck: a sustained Poisson stream of window
+    publishes against long-lived subscribed graphs, each publish chased by
+    a notification poll, over a thin background of cache hits. Returns the
+    same ``(schedule, warm_graphs, stream_seeds, counts)`` shape as
+    :func:`build_deck`."""
+    from distributed_ghs_implementation_tpu.graphs.generators import (
+        gnm_random_graph,
+    )
+
+    D = args.duration
+    scale = args.rate / 10.0
+    counts = {
+        "publish": max(9, int(45 * scale)),
+        "notify": 0,  # one poll rides along with every publish
+        "hit": max(4, int(10 * scale)),
+    }
+    counts["notify"] = counts["publish"]
+    schedule: List[Arrival] = []
+
+    n_streams = args.streams
+    stream_seeds = [
+        gnm_random_graph(*STREAM_SHAPE, seed=args.seed + 6000 + s)
+        for s in range(n_streams)
+    ]
+    for i, t in enumerate(
+        arrival_times(counts["publish"], D, args.arrival, rng)
+    ):
+        s = i % n_streams
+        schedule.append(Arrival(
+            float(t), "publish", stream=s,
+            updates=_stream_window(rng, stream_seeds[s],
+                                   STREAM_WINDOW_UPDATES),
+        ))
+
+    hit_pool = [
+        gnm_random_graph(*HIT_SHAPE, seed=args.seed + 100 + i) for i in range(4)
+    ]
+    for i, t in enumerate(arrival_times(counts["hit"], D, args.arrival, rng)):
+        schedule.append(
+            Arrival(float(t), "hit", _graph_request(hit_pool[i % 4], "hit"))
+        )
+
+    schedule.sort(key=lambda a: a.at_s)
+    return schedule, hit_pool, stream_seeds, counts
+
+
 # ----------------------------------------------------------------------
 # Execution
 # ----------------------------------------------------------------------
+class _SubState:
+    """One subscribed stream, client side: the digest-chain head plus the
+    subscriber's notification cursor and integrity ledger (every sequence
+    number observed via poll — the gap/duplicate check's input)."""
+
+    __slots__ = ("stream", "digest", "lock", "after_seq", "seen", "resets",
+                 "head_seq")
+
+    def __init__(self, stream: str, digest: str, seq: int):
+        self.stream = stream
+        self.digest = digest
+        self.lock = threading.Lock()
+        self.after_seq = seq
+        self.seen: List[int] = []
+        self.resets = 0
+        self.head_seq = seq
+
+
 class _StreamState:
     __slots__ = ("digest", "lock", "seed_request")
 
@@ -292,6 +382,66 @@ def run_window(service, schedule, streams, args, chaos_plan, arm_chaos):
         scheduled = t0 + arrival.at_s
         reset = False
         try:
+            if arrival.stream is not None and isinstance(
+                streams[arrival.stream], _SubState
+            ):
+                # --update-heavy: publish one window against the stream
+                # head, then poll for its notification — the poll is the
+                # subscriber-visible event whose latency the report's
+                # "notify" class measures (scheduled arrival -> poll
+                # answered), and whose sequence numbers feed the
+                # gap/duplicate ledger.
+                state = streams[arrival.stream]
+                with state.lock:
+                    response = service.handle({
+                        "op": "publish",
+                        "stream": state.stream,
+                        "digest": state.digest,
+                        "updates": arrival.updates,
+                        "slo_class": arrival.cls,
+                    })
+                    if response.get("ok"):
+                        state.digest = response["digest"]
+                    elif response.get("stale") and response.get("digest"):
+                        # The chain moved under us (a failover replayed
+                        # past our head): adopt the reported head. The
+                        # window itself may or may not have committed —
+                        # the poll below reconciles via sequence numbers.
+                        state.digest = response["digest"]
+                        state.resets += 1
+                        reset = True
+                    ok = bool(response.get("ok"))
+                    err = response.get("error")
+                    publish_done = time.perf_counter()
+                    poll = service.handle({
+                        "op": "poll",
+                        "stream": state.stream,
+                        "digest": state.digest,
+                        "after_seq": state.after_seq,
+                        "slo_class": "notify",
+                    })
+                    poll_ok = bool(poll.get("ok"))
+                    if poll_ok:
+                        for note in poll.get("notifications", []):
+                            state.seen.append(int(note["seq"]))
+                            state.after_seq = max(state.after_seq,
+                                                  int(note["seq"]))
+                        state.head_seq = max(state.head_seq,
+                                             int(poll.get("seq", 0)))
+                now = time.perf_counter()
+                with records_lock:
+                    records.append(
+                        {"cls": arrival.cls, "ok": ok, "lost": False,
+                         "reset": reset, "error": err,
+                         "latency_s": publish_done - scheduled}
+                    )
+                    records.append(
+                        {"cls": "notify", "ok": poll_ok, "lost": False,
+                         "reset": False, "extra": True,
+                         "error": poll.get("error"),
+                         "latency_s": now - scheduled}
+                    )
+                return
             if arrival.stream is not None:
                 state = streams[arrival.stream]
                 with state.lock:
@@ -372,12 +522,42 @@ def client_summary(records, wall_s) -> dict:
 # ----------------------------------------------------------------------
 # The drill
 # ----------------------------------------------------------------------
-def _fleet_worker_counters(router) -> dict:
-    """Summed ``serve.*``/``batch.*``/``compile.*`` counters across the
+def _fleet_worker_counters(router) -> "tuple[dict, List[str]]":
+    """Per-``(worker_id, incarnation)`` counter snapshots across the
     fleet's live workers (each worker has its own bus; the router's stats
-    op fans out and sums)."""
+    op fans out with the incarnation alongside). Also returns the ids of
+    live workers that did NOT answer the fan-out — a wedged worker's
+    counters silently reading as zero would let the exact-gated checks
+    (fresh solves, chain evictions) pass vacuously, so the caller must
+    surface a miss as a failed check, never as zeros.
+
+    Keyed by incarnation so window deltas stay honest across a kill: a
+    restarted worker is a *new* key with no pre-window baseline, and
+    every counter it accumulates — fresh solves included — lands in the
+    window delta in full. Subtracting summed totals instead would let
+    the victim's vanished pre-kill counters cancel real post-restart
+    activity (the drill's "zero fresh solves" gate could pass vacuously)."""
     stats = router.handle({"op": "stats"})
-    return dict(stats.get("counters", {}))
+    out, missing = {}, []
+    for wid, info in (stats.get("workers") or {}).items():
+        wstats = info.get("stats")
+        if not wstats:
+            missing.append(wid)
+            continue
+        out[(wid, info.get("incarnation"))] = dict(
+            wstats.get("counters") or {}
+        )
+    return out, missing
+
+
+def _window_counter_delta(pre: dict, post: dict) -> dict:
+    """Summed per-incarnation counter deltas for the measured window."""
+    window: dict = {}
+    for key, counters in post.items():
+        base = pre.get(key, {})
+        for name, value in counters.items():
+            window[name] = window.get(name, 0) + value - base.get(name, 0)
+    return window
 
 
 def run_drill(args) -> dict:
@@ -393,9 +573,10 @@ def run_drill(args) -> dict:
         router = resources.get("router")
         if router is not None:
             router.shutdown()
-        disk_tmp = resources.get("disk_tmp")
-        if disk_tmp:
-            shutil.rmtree(disk_tmp, ignore_errors=True)
+        for key in ("disk_tmp", "stream_tmp"):
+            tmp = resources.get(key)
+            if tmp:
+                shutil.rmtree(tmp, ignore_errors=True)
 
 
 def _run_drill(args, resources: dict) -> dict:
@@ -408,7 +589,15 @@ def _run_drill(args, resources: dict) -> dict:
 
     BUS.enable()
     rng = np.random.default_rng(args.seed)
-    schedule, warm_graphs, stream_seeds, counts = build_deck(args, rng)
+    deck = build_stream_deck if args.update_heavy else build_deck
+    schedule, warm_graphs, stream_seeds, counts = deck(args, rng)
+    stream_tmp = None
+    if args.update_heavy:
+        # The durable stream layer under test: shared across fleet workers
+        # so failover recovery is snapshot+WAL replay, never a re-solve.
+        stream_tmp = resources["stream_tmp"] = tempfile.mkdtemp(
+            prefix="ghs-stream-log-"
+        )
 
     fleet_router = None
     if args.fleet:
@@ -432,6 +621,16 @@ def _run_drill(args, resources: dict) -> dict:
             disk_dir=resources["disk_tmp"],
             obs_dir=args.obs_dir,
             request_timeout_s=max(120.0, 12 * args.duration),
+            stream_dir=stream_tmp,
+            stream_snapshot_every=4,
+            # Workers AOT-warm the window kernels for the subscribed shape
+            # (and the next edge bucket up, where inserts land) so the
+            # first committed window pays no jit tracing.
+            warmup_stream_buckets=(
+                f"{STREAM_SHAPE[0]}x{STREAM_SHAPE[1]},"
+                f"{STREAM_SHAPE[0]}x{2 * STREAM_SHAPE[1]}"
+                if args.update_heavy else None
+            ),
         )
         service = fleet_router = FleetRouter(config).start()
         resources["router"] = fleet_router
@@ -445,6 +644,8 @@ def _run_drill(args, resources: dict) -> dict:
             store_capacity=max(256, len(schedule)),
             sharded_lane=(True if args.sharded_lane == -1
                           else max(0, args.sharded_lane)),
+            stream_dir=stream_tmp,
+            stream_snapshot_every=4,
         )
 
     # Warm phase: prime every bucket the deck touches (compiles, rank
@@ -453,6 +654,13 @@ def _run_drill(args, resources: dict) -> dict:
     # compile.* counters inside the window then expose any request-time
     # compile as the anomaly it is.
     t_warm = time.perf_counter()
+    if args.update_heavy and fleet_router is None:
+        from distributed_ghs_implementation_tpu.stream.window import (
+            warm_window_kernels,
+        )
+
+        warm_window_kernels(STREAM_SHAPE[0], STREAM_SHAPE[1])
+        warm_window_kernels(STREAM_SHAPE[0], 2 * STREAM_SHAPE[1])
     for g in warm_graphs:
         service.handle(_graph_request(g, "warm"))
     stream_digests = []
@@ -461,17 +669,32 @@ def _run_drill(args, resources: dict) -> dict:
         if not response.get("ok"):
             raise RuntimeError(f"warm solve failed: {response.get('error')}")
         stream_digests.append(response["digest"])
+    if args.update_heavy:
+        # Subscribe each stream (still inside the warm phase): the seed
+        # snapshot lands on disk and the subscriber cursor starts at the
+        # returned head sequence.
+        streams = []
+        for d in stream_digests:
+            sub = service.handle(
+                {"op": "subscribe", "digest": d, "slo_class": "warm"}
+            )
+            if not sub.get("ok"):
+                raise RuntimeError(f"subscribe failed: {sub.get('error')}")
+            streams.append(
+                _SubState(sub["stream"], sub["digest"], int(sub["seq"]))
+            )
+    else:
+        streams = [
+            _StreamState(
+                d,
+                seed_request=(
+                    _graph_request(g, "update") if fleet_router is not None
+                    else None
+                ),
+            )
+            for d, g in zip(stream_digests, stream_seeds)
+        ]
     warm_s = time.perf_counter() - t_warm
-    streams = [
-        _StreamState(
-            d,
-            seed_request=(
-                _graph_request(g, "update") if fleet_router is not None
-                else None
-            ),
-        )
-        for d, g in zip(stream_digests, stream_seeds)
-    ]
 
     # Chaos plan: transient faults armed mid-flight (seeded offsets). The
     # supervisor ladder + batch retry must absorb them — degraded latency
@@ -513,8 +736,10 @@ def _run_drill(args, resources: dict) -> dict:
             for site, times in plan.get("sites", {}).items():
                 FAULTS.arm(site, times=times)
 
+    # A pre-window stats miss is the SAFE direction (the delta over-counts
+    # that worker), so it doesn't gate; a post-window miss does.
     pre_window = (
-        _fleet_worker_counters(fleet_router) if fleet_router is not None
+        _fleet_worker_counters(fleet_router)[0] if fleet_router is not None
         else {}
     )
     BUS.clear()  # the measured window starts here
@@ -539,7 +764,7 @@ def _run_drill(args, resources: dict) -> dict:
                 rejoined = True
                 break
             time.sleep(0.25)
-        if rejoined:
+        if rejoined and not args.update_heavy:
             from distributed_ghs_implementation_tpu.fleet.hashing import (
                 HashRing,
             )
@@ -561,6 +786,81 @@ def _run_drill(args, resources: dict) -> dict:
             probe_req["digest"] = hint  # route straight at the rejoiner
             probe = service.handle(probe_req)
 
+    # Stream recovery + drain (--update-heavy): after a kill, one more
+    # published window per stream proves the restarted fleet serves the
+    # chain (recovery = snapshot+WAL replay, asserted below via the
+    # stream.replay.* / fresh-solve counters); then a final poll per
+    # stream drains remaining notifications so the gap/duplicate ledger
+    # is complete through the head sequence.
+    recovery = None
+    stream_drain = 0
+    notify_gaps = notify_dups = drain_errors = 0
+    if args.update_heavy:
+        from distributed_ghs_implementation_tpu.stream.session import (
+            poll_gap_check,
+        )
+
+        if fleet_router is not None and args.kill_worker is not None:
+            recovery = []
+            for s, state in enumerate(streams):
+                t_r = time.perf_counter()
+                attempts = 0
+                with state.lock:
+                    for _attempt in range(2):
+                        attempts += 1
+                        resp = service.handle({
+                            "op": "publish",
+                            "stream": state.stream,
+                            "digest": state.digest,
+                            "updates": _stream_window(
+                                rng, stream_seeds[s], STREAM_WINDOW_UPDATES
+                            ),
+                            "slo_class": "publish",
+                        })
+                        if resp.get("stale") and resp.get("digest"):
+                            # The crash landed between WAL append and the
+                            # response: the window IS committed and replay
+                            # moved the head past ours. Adopt and retry.
+                            state.digest = resp["digest"]
+                            continue
+                        if resp.get("ok"):
+                            state.digest = resp["digest"]
+                        break
+                recovery.append({
+                    "ok": bool(resp.get("ok")),
+                    "recover_s": time.perf_counter() - t_r,
+                    "worker": resp.get("worker"),
+                    "requests": attempts,
+                })
+        for state in streams:
+            with state.lock:
+                poll = service.handle({
+                    "op": "poll",
+                    "stream": state.stream,
+                    "digest": state.digest,
+                    "after_seq": state.after_seq,
+                    "slo_class": "notify",
+                })
+                stream_drain += 1
+                if poll.get("ok"):
+                    for note in poll.get("notifications", []):
+                        state.seen.append(int(note["seq"]))
+                        state.after_seq = max(
+                            state.after_seq, int(note["seq"])
+                        )
+                    state.head_seq = max(
+                        state.head_seq, int(poll.get("seq", 0))
+                    )
+                else:
+                    # A failed drain leaves state.head_seq stale, which
+                    # would let poll_gap_check pass vacuously — count it
+                    # so the gap/duplicate verdict can't silently rest on
+                    # an incomplete ledger.
+                    drain_errors += 1
+            check = poll_gap_check(state.seen, state.head_seq)
+            notify_gaps += check["gaps"]
+            notify_dups += check["dups"]
+
     # Server-side accounting: the per-class join over real bus events (the
     # router's fleet.request spans in fleet mode — which then carry the
     # per-worker breakdown).
@@ -568,44 +868,58 @@ def _run_drill(args, resources: dict) -> dict:
     client = client_summary(records, wall_s)
     if fleet_router is not None:
         # Worker counters live in the worker processes; the window's share
-        # is the post-minus-pre delta, summed over live workers. A killed
-        # worker's pre-restart counters die with it, so clamp at zero.
-        post_window = _fleet_worker_counters(fleet_router)
-        window_counters = {
-            k: max(0, v - pre_window.get(k, 0))
-            for k, v in post_window.items()
-        }
+        # is the post-minus-pre delta per (worker, incarnation). A killed
+        # worker's pre-kill counters die with it (unobservable), but its
+        # restarted incarnation starts from a zero baseline, so anything
+        # it does during the window — a fresh solve where replay was
+        # promised — shows up undiminished.
+        post_window, stats_missing = _fleet_worker_counters(fleet_router)
+        window_counters = _window_counter_delta(pre_window, post_window)
         fleet_counters = {
             k: v for k, v in BUS.counters().items() if k.startswith("fleet.")
         }
     else:
         window_counters = dict(BUS.counters())
         fleet_counters = {}
+        stats_missing = []
     compile_counters = {
         k: v for k, v in window_counters.items() if k.startswith("compile.")
     }
     serve_counters = {
         k: v
         for k, v in window_counters.items()
-        if k.startswith(("serve.", "batch."))
+        if k.startswith(("serve.", "batch.", "stream."))
     }
     if args.jsonl:
         write_events_jsonl(BUS, args.jsonl)
 
+    # "extra" records are the chase polls riding publish arrivals — they
+    # count toward latency/error accounting but not toward the
+    # one-record-per-scheduled-arrival invariant.
+    base_records = [rec for rec in records if not rec.get("extra")]
     lost = sum(1 for rec in records if rec["lost"])
-    answered = len(records)
+    answered = len(base_records)
     resets = sum(1 for rec in records if rec.get("reset"))
     errors = sum(
         1 for rec in records
         if not rec["ok"] and not rec["lost"] and not rec.get("reset")
     )
+    fresh_solves = window_counters.get("serve.scheduler.fresh_solve", 0)
     expected_classes = [c for c, n in counts.items() if n > 0]
     bus_classes = summary["classes"]
 
     # Every scheduled arrival, plus the out-of-schedule requests the drill
-    # itself makes in fleet mode (session re-subscribe solves, the
-    # post-kill recovery probe), must appear as exactly one request span.
+    # itself makes (chase polls, session re-subscribe solves, the
+    # post-kill recovery probes, final drain polls), must appear as
+    # exactly one request span.
     expected_spans = len(schedule) + resets + (1 if probe is not None else 0)
+    if args.update_heavy:
+        expected_spans = (
+            len(schedule)
+            + sum(1 for rec in records if rec.get("extra"))  # chase polls
+            + stream_drain
+            + (sum(r["requests"] for r in recovery) if recovery else 0)
+        )
     checks = [
         ("every accepted query answered",
          answered == len(schedule) and lost == 0),
@@ -617,7 +931,51 @@ def _run_drill(args, resources: dict) -> dict:
          not summary["dropped_warning"]),
         ("chaos armed mid-flight", len(chaos_armed) == len(chaos_plan)),
     ]
-    if fleet_router is None:
+    if fleet_router is not None:
+        # A live worker whose stats fan-out failed contributes ZERO to
+        # the window delta — every exact-gated counter check below would
+        # pass vacuously, so a miss is a drill failure, not a zero.
+        checks.append((
+            "post-window stats from every live worker (counter gates "
+            "trustworthy)", not stats_missing,
+        ))
+    if args.update_heavy:
+        checks += [
+            ("zero errors (stale head re-syncs excluded)", errors == 0),
+            ("p99 bounded under sustained update load",
+             client["totals"]["latency_s"].get("p99", float("inf"))
+             <= args.p99_bound),
+            ("no lost or duplicated window notifications",
+             notify_gaps == 0 and notify_dups == 0),
+            ("final drain polls all answered (gap ledger complete)",
+             drain_errors == 0),
+            ("windows applied batched, never degraded to resolve",
+             window_counters.get("stream.window.batched", 0) >= 1
+             and window_counters.get("stream.window.resolve", 0) == 0),
+            ("superseded chain ancestors evicted from the LRU",
+             window_counters.get("serve.store.chain_evicted", 0) >= 1),
+            ("zero fresh solves while streams were live",
+             fresh_solves == 0),
+        ]
+        if fleet_router is not None and args.kill_worker is not None:
+            checks += [
+                ("worker killed mid-stream",
+                 fleet_counters.get("fleet.worker.dead", 0) >= 1),
+                ("dead worker restarted with backoff",
+                 fleet_counters.get("fleet.worker.restart", 0) >= 1),
+                ("fleet healed: full ring after the drill", bool(rejoined)),
+                ("streams recovered by snapshot+WAL replay (no re-solve)",
+                 window_counters.get("stream.replay.streams", 0) >= 1),
+                ("post-recovery window publishes served",
+                 recovery is not None
+                 and all(r["ok"] for r in recovery)),
+            ]
+        elif fleet_router is not None:
+            checks += [
+                ("no unplanned worker deaths",
+                 fleet_counters.get("fleet.worker.dead", 0) == 0),
+            ]
+    elif fleet_router is None:
         checks += [
             ("zero errors (chaos absorbed by the supervisor)", errors == 0),
             ("p99 bounded under chaos",
@@ -677,7 +1035,14 @@ def _run_drill(args, resources: dict) -> dict:
             ]
     ok = all(passed for _, passed in checks)
 
-    if fleet_router is None:
+    if args.update_heavy:
+        if fleet_router is None:
+            workload = WORKLOAD_STREAM
+        elif args.kill_worker is not None:
+            workload = WORKLOAD_STREAM_KILL
+        else:
+            workload = WORKLOAD_STREAM_FLEET
+    elif fleet_router is None:
         workload = WORKLOAD_OVERSIZE if args.oversize_heavy else WORKLOAD
     elif args.kill_worker is not None:
         workload = WORKLOAD_FLEET_KILL
@@ -697,12 +1062,27 @@ def _run_drill(args, resources: dict) -> dict:
     if args.oversize_heavy:
         config["oversize_heavy"] = True
         config["sharded_lane"] = bool(args.sharded_lane)
+    if args.update_heavy:
+        config["update_heavy"] = True
+        config["streams"] = args.streams
+        config["window_updates"] = STREAM_WINDOW_UPDATES
     if args.fleet:
         config["fleet"] = args.fleet
         config["kill_worker"] = args.kill_worker
     extra_metrics = {"lost_accepted": lost, "answered": answered}
+    if args.update_heavy:
+        extra_metrics["notify_gaps"] = notify_gaps
+        extra_metrics["notify_dups"] = notify_dups
+        extra_metrics["drain_errors"] = drain_errors
+        extra_metrics["stream_resets"] = sum(s.resets for s in streams)
+        extra_metrics["fresh_solves"] = fresh_solves
+        if recovery:
+            extra_metrics["replay_recovery_s"] = max(
+                r["recover_s"] for r in recovery
+            )
     if fleet_router is not None:
-        extra_metrics["session_resets"] = resets
+        if not args.update_heavy:
+            extra_metrics["session_resets"] = resets
         extra_metrics["worker_restarts"] = fleet_counters.get(
             "fleet.worker.restart", 0
         )
@@ -733,6 +1113,17 @@ def _run_drill(args, resources: dict) -> dict:
         "ok": ok,
         "gate_metrics": gate,
     }
+    if args.update_heavy:
+        report["stream"] = {
+            "streams": args.streams,
+            "notify_gaps": notify_gaps,
+            "notify_dups": notify_dups,
+            "drain_errors": drain_errors,
+            "stream_resets": sum(s.resets for s in streams),
+            "fresh_solves": fresh_solves,
+            "head_seqs": {s.stream: s.head_seq for s in streams},
+            "recovery": recovery,
+        }
     if fleet_router is not None:
         report["fleet"] = {
             "workers": args.fleet,
@@ -786,6 +1177,15 @@ def main(argv=None) -> int:
                    "more oversize solves running concurrently with the "
                    "interactive classes; checks interactive p99 stays "
                    "within --interactive-p99-bound while bulk is in flight")
+    p.add_argument("--update-heavy", action="store_true",
+                   help="streaming scenario (gate-stream-v1): a sustained "
+                   "Poisson stream of published update windows against "
+                   "subscribed graphs with a durable log, notification "
+                   "latency per poll, and (with --fleet --kill-worker) a "
+                   "mid-stream kill recovered by snapshot+WAL replay with "
+                   "zero fresh solves and no notification gap/duplicate")
+    p.add_argument("--streams", type=int, default=3,
+                   help="with --update-heavy: subscribed streams in the deck")
     p.add_argument("--sharded-lane", type=int, nargs="?", const=-1, default=0,
                    metavar="N",
                    help="attach a mesh-sharded oversize lane to the service "
@@ -864,7 +1264,8 @@ def main(argv=None) -> int:
         )
         for line in lines:
             print(line)
-        print(f"load gate ({WORKLOAD}): {'PASS' if gate_ok else 'FAIL'}")
+        workload = report["config"]["workload"]
+        print(f"load gate ({workload}): {'PASS' if gate_ok else 'FAIL'}")
 
     print(f"load drill: {'PASS' if report['ok'] and gate_ok else 'FAIL'}")
     return 0 if report["ok"] and gate_ok else 1
