@@ -59,11 +59,19 @@ from distributed_ghs_implementation_tpu.models.boruvka import (
     _solve_from_iota,
 )
 from distributed_ghs_implementation_tpu.obs.events import BUS
+from distributed_ghs_implementation_tpu.ops import pallas_kernels as _pk
 
 _INT32_MAX = np.iinfo(np.int32).max
 
 BucketKey = Tuple[int, int]  # (n_pad, m_pad)
 SolverKey = Tuple[int, int, int, str]  # (n_pad, m_pad, lanes, mode)
+# The cache key internally carries a fifth dimension — the level-kernel
+# variant ("xla" | "pallas", docs/KERNELS.md) — so both variants of a
+# bucket can be warm at once. The public SolverKey surface (records,
+# replay files, compiled_bucket_keys) stays 4-wide: which kernel a process
+# runs is a property of the process (backend probe, GHS_KERNEL, serve
+# --kernel), not of the recorded traffic.
+_CacheKey = Tuple[int, int, int, str, str]
 
 
 def bucket_of(num_nodes: int, num_edges: int) -> BucketKey:
@@ -93,8 +101,8 @@ def bucket_key(graph: Graph) -> BucketKey:
 # compile — and two threads racing the same cold bucket still compile it
 # exactly once.
 # ----------------------------------------------------------------------
-_SOLVER_CACHE: Dict[SolverKey, object] = {}
-_PENDING_COMPILES: Dict[SolverKey, threading.Event] = {}
+_SOLVER_CACHE: Dict[_CacheKey, object] = {}
+_PENDING_COMPILES: Dict[_CacheKey, threading.Event] = {}
 _CACHE_LOCK = threading.Lock()
 
 
@@ -107,9 +115,14 @@ def lane_compile_stats() -> dict:
 
 
 def compiled_bucket_keys() -> List[SolverKey]:
-    """The solver keys compiled so far — the record warmup replay persists."""
+    """The solver keys compiled so far — the record warmup replay persists.
+
+    Kernel variants collapse: a record replayed on a different backend (or
+    under a different ``GHS_KERNEL``) warms the variant THAT process will
+    actually serve with, which is the point of replay.
+    """
     with _CACHE_LOCK:
-        return sorted(_SOLVER_CACHE)
+        return sorted({k[:4] for k in _SOLVER_CACHE})
 
 
 def clear_solver_cache() -> None:
@@ -136,33 +149,50 @@ def _donate_inputs() -> bool:
     return jax.default_backend() in ("tpu", "gpu")
 
 
-def _compile_bucket(n_pad: int, m_pad: int, lanes: int, mode: str):
+def _compile_bucket(n_pad: int, m_pad: int, lanes: int, mode: str, kernel: str):
     """AOT-compile one bucket's solver: trace+lower+compile now, so the
-    executable is ready before (or instead of) the first request."""
+    executable is ready before (or instead of) the first request.
+    ``kernel`` is the static level-kernel variant (docs/KERNELS.md)."""
     shapes = _lane_input_shapes(n_pad, m_pad, lanes, mode)
     if mode == "fused":
-        fn = functools.partial(_solve_from_iota, num_nodes=lanes * n_pad)
+        fn = functools.partial(
+            _solve_from_iota, num_nodes=lanes * n_pad, kernel=kernel
+        )
         if _donate_inputs():
             fn = jax.jit(fn, donate_argnums=(0, 1, 2, 3, 4))
         else:
             fn = jax.jit(fn)
     elif mode == "vmap":
-        fn = jax.jit(jax.vmap(functools.partial(_solve_from_iota, num_nodes=n_pad)))
+        fn = jax.jit(
+            jax.vmap(
+                functools.partial(
+                    _solve_from_iota, num_nodes=n_pad, kernel=kernel
+                )
+            )
+        )
     else:
         raise ValueError(f"unknown lane mode {mode!r}; expected fused|vmap")
     return fn.lower(*shapes).compile()
 
 
-def _get_solver(n_pad: int, m_pad: int, lanes: int, mode: str, *, phase: str = "request"):
+def _get_solver(
+    n_pad: int, m_pad: int, lanes: int, mode: str, *,
+    phase: str = "request", kernel: str | None = None,
+):
     """The bucket's compiled executable, building it on first need.
 
     ``phase`` labels who paid for a compile: ``"request"`` (a live solve
     stalled on it — the cold-start spike warmup exists to remove) or
     ``"warmup"`` (precompiled ahead of traffic). Cache hits always count
     as ``compile.hit`` — a warmup-precompiled bucket is a *hit* at request
-    time, never a fresh compile.
+    time, never a fresh compile. ``kernel`` (resolved via
+    ``pallas_kernels.kernel_choice`` when ``None``) is part of the cache
+    key, and every compile event carries it — the ``compile.*`` taxonomy
+    distinguishes kernel variants (``compile.kernel.pallas`` /
+    ``compile.kernel.xla``).
     """
-    key = (n_pad, m_pad, lanes, mode)
+    kernel = _pk.kernel_choice(kernel)
+    key = (n_pad, m_pad, lanes, mode, kernel)
     while True:
         with _CACHE_LOCK:
             fn = _SOLVER_CACHE.get(key)
@@ -175,6 +205,7 @@ def _get_solver(n_pad: int, m_pad: int, lanes: int, mode: str, *, phase: str = "
                 pending = _PENDING_COMPILES[key] = threading.Event()
                 BUS.count("batch.compile.miss")
                 BUS.count(f"compile.{'warmup' if phase == 'warmup' else 'miss'}")
+                BUS.count(f"compile.kernel.{kernel}")
                 break  # this thread leads the compile, outside the lock
         # Another thread is compiling this key: wait, then re-read the
         # cache (on the leader's failure the loop elects a new leader).
@@ -184,8 +215,9 @@ def _get_solver(n_pad: int, m_pad: int, lanes: int, mode: str, *, phase: str = "
         with BUS.span(
             "compile.bucket", cat="compile",
             n_pad=n_pad, m_pad=m_pad, lanes=lanes, mode=mode, phase=phase,
+            kernel=kernel,
         ):
-            fn = _compile_bucket(n_pad, m_pad, lanes, mode)
+            fn = _compile_bucket(n_pad, m_pad, lanes, mode, kernel)
         BUS.record("compile.time_s", time.perf_counter() - t0)
         with _CACHE_LOCK:
             _SOLVER_CACHE[key] = fn
@@ -197,7 +229,8 @@ def _get_solver(n_pad: int, m_pad: int, lanes: int, mode: str, *, phase: str = "
 
 
 def precompile_bucket(
-    n_pad: int, m_pad: int, lanes: int, mode: str = "fused"
+    n_pad: int, m_pad: int, lanes: int, mode: str = "fused",
+    kernel: str | None = None,
 ) -> bool:
     """Compile a bucket's lane solver ahead of serving (idempotent).
 
@@ -206,7 +239,10 @@ def precompile_bucket(
     (plus ``batch.compile.miss`` — it *is* a lane-solver compilation, just
     not one a request waited on). Rejects geometries the request path
     itself rejects (int32 id-space overflow in ``stack_lanes``) — a
-    warmup must never compile a solver no request can reach.
+    warmup must never compile a solver no request can reach. ``kernel``
+    (default: the process's resolved choice) picks the level-kernel
+    variant to warm — warming and serving resolve identically, so a
+    warmed bucket is a request-time hit under either variant.
     """
     if lanes < 1:
         raise ValueError(f"lanes must be >= 1, got {lanes}")
@@ -215,11 +251,12 @@ def precompile_bucket(
             f"bucket ({n_pad}, {m_pad}) x {lanes} lanes exceeds int32 id "
             "space; no request-path stack can ever use this solver"
         )
+    kernel = _pk.kernel_choice(kernel)
     with _CACHE_LOCK:
-        cached = (n_pad, m_pad, lanes, mode) in _SOLVER_CACHE
+        cached = (n_pad, m_pad, lanes, mode, kernel) in _SOLVER_CACHE
     if cached:
         return False
-    _get_solver(n_pad, m_pad, lanes, mode, phase="warmup")
+    _get_solver(n_pad, m_pad, lanes, mode, phase="warmup", kernel=kernel)
     return True
 
 
@@ -335,12 +372,40 @@ def stack_lanes(
     )
 
 
-def execute_stacked(stacked: StackedBatch) -> List[Tuple[np.ndarray, np.ndarray, int]]:
-    """The device half: one dispatch of a stacked batch + per-lane unpack."""
-    solver = _get_solver(
-        stacked.n_pad, stacked.m_pad, stacked.lanes, stacked.mode
-    )
-    mst_ranks, fragment, levels = jax.device_get(solver(*stacked.arrays))
+def execute_stacked(
+    stacked: StackedBatch, *, kernel: str | None = None
+) -> List[Tuple[np.ndarray, np.ndarray, int]]:
+    """The device half: one dispatch of a stacked batch + per-lane unpack.
+
+    ``kernel`` picks the level-kernel variant (``None`` = process default).
+    A Pallas solver failing at compile (a Mosaic lowering regression) or
+    dispatch trips the sticky process-wide fallback
+    (``pallas_kernels.disable_pallas``) and the SAME stack re-dispatches
+    on the XLA variant — the stack's host arrays are intact (donation
+    only consumes per-call device buffers), so the retry is exact and
+    the request never sees the failure.
+    """
+    kernel = _pk.kernel_choice(kernel)
+    try:
+        solver = _get_solver(
+            stacked.n_pad, stacked.m_pad, stacked.lanes, stacked.mode,
+            kernel=kernel,
+        )
+        # The device_get stays INSIDE the try: dispatch is async, so a
+        # compiled Pallas program that faults at execution raises at the
+        # first host sync, not at the call above.
+        mst_ranks, fragment, levels = jax.device_get(solver(*stacked.arrays))
+    except ValueError:
+        raise  # caller/geometry errors are never kernel faults
+    except Exception as ex:  # noqa: BLE001 — speculative-kernel fallback
+        if kernel != "pallas":
+            raise
+        _pk.disable_pallas(f"lane dispatch: {type(ex).__name__}: {ex}")
+        solver = _get_solver(
+            stacked.n_pad, stacked.m_pad, stacked.lanes, stacked.mode,
+            kernel="xla",
+        )
+        mst_ranks, fragment, levels = jax.device_get(solver(*stacked.arrays))
 
     graphs, lanes, n_pad, m_pad = (
         stacked.graphs, stacked.lanes, stacked.n_pad, stacked.m_pad
@@ -371,6 +436,7 @@ def solve_lanes(
     *,
     lanes: int | None = None,
     mode: str = "fused",
+    kernel: str | None = None,
 ) -> List[Tuple[np.ndarray, np.ndarray, int]]:
     """Solve K same-bucket graphs in one dispatch.
 
@@ -381,8 +447,11 @@ def solve_lanes(
     inert padding, so a policy can pin ``lanes = max_lanes`` and keep ONE
     compiled shape per bucket regardless of fill. In ``"fused"`` mode
     ``levels`` is the shared batch level count (the slowest lane's); in
-    ``"vmap"`` mode it is per-lane.
+    ``"vmap"`` mode it is per-lane. ``kernel`` picks the level-kernel
+    variant (``None`` = process default; docs/KERNELS.md).
     """
     if not graphs:
         return []
-    return execute_stacked(stack_lanes(graphs, lanes=lanes, mode=mode))
+    return execute_stacked(
+        stack_lanes(graphs, lanes=lanes, mode=mode), kernel=kernel
+    )

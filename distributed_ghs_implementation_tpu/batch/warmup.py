@@ -38,6 +38,7 @@ import json
 import time
 from typing import List, Optional, Sequence, Tuple
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 
@@ -95,6 +96,14 @@ class WarmupPlan:
     (``stream/window.py``) at the padded edge buckets a stream of that
     size dispatches, so the first committed window — and a failover
     replay — pays no jit tracing either.
+
+    ``kernel`` picks the level-kernel variant to warm (``"pallas"`` /
+    ``"xla"``; ``None`` = the process's resolved choice,
+    ``pallas_kernels.kernel_choice``). Warmup and request-time solving
+    resolve through the same function, so a warmed bucket stays a
+    request-time ``compile.hit`` whichever variant the process serves
+    with — the zero-request-time-compiles property covers kernel
+    variants (docs/KERNELS.md).
     """
 
     buckets: Tuple[Tuple[int, int], ...] = ()
@@ -104,6 +113,7 @@ class WarmupPlan:
     warm_single: bool = True
     mesh_buckets: Tuple[Tuple[int, int], ...] = ()
     stream_buckets: Tuple[Tuple[int, int], ...] = ()
+    kernel: Optional[str] = None
 
     def is_empty(self) -> bool:
         return (
@@ -260,6 +270,7 @@ def plan_from_flags(
     lanes: int = 0,
     mesh_buckets: Optional[str] = None,
     stream_buckets: Optional[str] = None,
+    kernel: Optional[str] = None,
 ) -> Optional[WarmupPlan]:
     """A :class:`WarmupPlan` from the serve-CLI flag surface, or ``None``.
 
@@ -292,7 +303,10 @@ def plan_from_flags(
         )
     if not plans:
         return None
-    return merge_plans(*plans)
+    merged = merge_plans(*plans)
+    if kernel and kernel != "auto":
+        merged = dataclasses.replace(merged, kernel=kernel)
+    return merged
 
 
 def merge_plans(*plans: WarmupPlan) -> WarmupPlan:
@@ -301,7 +315,7 @@ def merge_plans(*plans: WarmupPlan) -> WarmupPlan:
     mesh_buckets: List[Tuple[int, int]] = []
     stream_buckets: List[Tuple[int, int]] = []
     keys: List[SolverKey] = []
-    lanes, mode, warm_single = 0, "fused", True
+    lanes, mode, warm_single, kernel = 0, "fused", True, None
     for p in plans:
         for b in p.buckets:
             if b not in buckets:
@@ -319,29 +333,36 @@ def merge_plans(*plans: WarmupPlan) -> WarmupPlan:
         if p.lanes:
             mode = p.mode
         warm_single = warm_single and p.warm_single
+        kernel = kernel or p.kernel
     return WarmupPlan(
         buckets=tuple(buckets), lanes=lanes, mode=mode,
         keys=tuple(keys), warm_single=warm_single,
         mesh_buckets=tuple(mesh_buckets),
         stream_buckets=tuple(stream_buckets),
+        kernel=kernel,
     )
 
 
 # ----------------------------------------------------------------------
 # Execution
 # ----------------------------------------------------------------------
-def _warm_single_graph_kernel(n_pad: int, m_pad: int) -> None:
+def _warm_single_graph_kernel(n_pad: int, m_pad: int, kernel: str) -> None:
     """Warm the single-graph fused kernel for one shape bucket by solving
     an inert all-pad stack: self-edge slots, sentinel ranks. The level
     loop exits after one no-progress level, so the call costs one compile
     (or nothing when the jit cache / persistent cache already has it) —
     this is the path bypass, fallback, and non-batched serving hit.
+    ``kernel`` is the static level-kernel variant requests will resolve.
     """
     e_pad = 2 * m_pad
     src = jnp.zeros(e_pad, jnp.int32)
     rank = jnp.full(e_pad, _INT32_MAX, jnp.int32)
     ra = jnp.zeros(m_pad, jnp.int32)
-    _solve_from_iota(src, src, rank, ra, ra, num_nodes=n_pad)
+    out = _solve_from_iota(src, src, rank, ra, ra, num_nodes=n_pad, kernel=kernel)
+    # Scalar fetch = real sync (block_until_ready does not block on the
+    # axon remote backend): an execution fault must surface HERE, where
+    # run_warmup's kernel fallback can catch it, not at a later request.
+    _ = int(jax.device_get(out[2]))
 
 
 def run_warmup(plan: WarmupPlan, *, lane=None) -> dict:
@@ -355,6 +376,12 @@ def run_warmup(plan: WarmupPlan, *, lane=None) -> dict:
     counted ``mesh_skipped`` (declared but unreachable, like oversize
     shape buckets on the fused kernel).
     """
+    from distributed_ghs_implementation_tpu.ops.pallas_kernels import (
+        disable_pallas,
+        kernel_choice,
+    )
+
+    kernel = kernel_choice(plan.kernel)
     report = {
         "buckets": 0,
         "compiled": 0,
@@ -364,10 +391,23 @@ def run_warmup(plan: WarmupPlan, *, lane=None) -> dict:
         "mesh_warmed": 0,
         "mesh_skipped": 0,
         "stream_warmed": 0,
+        "kernel": kernel,
         "wall_s": 0.0,
     }
     if plan.is_empty():
         return report
+
+    def _warm_fallback(site: str, ex: Exception) -> None:
+        # The same degrade-never-error contract the request path has
+        # (docs/KERNELS.md): a Pallas compile failure during warmup trips
+        # the sticky process fallback and the rest of the phase — and the
+        # retried site — warms the XLA variant serving will now resolve.
+        # Boot must not die on the kernel the process won't even run.
+        nonlocal kernel
+        disable_pallas(f"warmup[{site}]: {type(ex).__name__}: {ex}")
+        kernel = "xla"
+        report["kernel"] = "xla"
+
     t0 = time.perf_counter()
     keys: List[SolverKey] = list(plan.keys)
     if plan.lanes > 0:
@@ -378,7 +418,7 @@ def run_warmup(plan: WarmupPlan, *, lane=None) -> dict:
     with BUS.span(
         "compile.warmup_phase", cat="compile",
         lane_buckets=len(keys), shape_buckets=len(plan.buckets),
-        mesh_buckets=len(plan.mesh_buckets),
+        mesh_buckets=len(plan.mesh_buckets), kernel=kernel,
     ) as span:
         for n_pad, m_pad, lanes, mode in keys:
             if lanes < 1:
@@ -390,7 +430,20 @@ def run_warmup(plan: WarmupPlan, *, lane=None) -> dict:
                 report["skipped"] += 1
                 continue
             report["buckets"] += 1
-            if precompile_bucket(n_pad, m_pad, lanes, mode):
+            try:
+                fresh = precompile_bucket(
+                    n_pad, m_pad, lanes, mode, kernel=kernel
+                )
+            except ValueError:
+                raise  # geometry rejections are never kernel faults
+            except Exception as ex:  # noqa: BLE001 — kernel fallback
+                if kernel != "pallas":
+                    raise
+                _warm_fallback(f"bucket {n_pad}x{m_pad}", ex)
+                fresh = precompile_bucket(
+                    n_pad, m_pad, lanes, mode, kernel="xla"
+                )
+            if fresh:
                 report["compiled"] += 1
             else:
                 report["cached"] += 1
@@ -400,7 +453,15 @@ def run_warmup(plan: WarmupPlan, *, lane=None) -> dict:
             for n_pad, m_pad in sorted(shapes):
                 if not warmable_single(n_pad, m_pad):
                     continue  # routed to the rank solver, never this kernel
-                _warm_single_graph_kernel(n_pad, m_pad)
+                try:
+                    _warm_single_graph_kernel(n_pad, m_pad, kernel)
+                except ValueError:
+                    raise  # geometry rejections are never kernel faults
+                except Exception as ex:  # noqa: BLE001 — kernel fallback
+                    if kernel != "pallas":
+                        raise
+                    _warm_fallback(f"single {n_pad}x{m_pad}", ex)
+                    _warm_single_graph_kernel(n_pad, m_pad, "xla")
                 report["single_warmed"] += 1
         for nodes, edges in plan.mesh_buckets:
             if lane is None:

@@ -348,6 +348,17 @@ def _cmd_serve(args) -> int:
         serve_loop,
     )
 
+    if args.kernel:
+        # Process default for every solve layer (kernel_choice), exported
+        # through the environment so fleet worker subprocesses resolve the
+        # same variant their warmup precompiles.
+        from distributed_ghs_implementation_tpu.ops.pallas_kernels import (
+            set_default_kernel,
+        )
+
+        set_default_kernel(args.kernel)
+        os.environ["GHS_KERNEL"] = args.kernel
+
     if args.fleet:
         from distributed_ghs_implementation_tpu.fleet.router import (
             FleetConfig,
@@ -416,6 +427,7 @@ def _cmd_serve(args) -> int:
         lanes=args.batch_lanes,
         mesh_buckets=args.warmup_mesh_buckets,
         stream_buckets=args.warmup_stream_buckets,
+        kernel=args.kernel,
     )
 
     service = MSTService(
@@ -673,6 +685,14 @@ def build_parser() -> argparse.ArgumentParser:
         "--warmup-stream-buckets",
         help="AOT-warm the windowed-maintenance kernels for subscribed "
         "graphs of these RAW NODESxEDGES sizes before serving",
+    )
+    srv.add_argument(
+        "--kernel", choices=["auto", "pallas", "xla"], default=None,
+        help="per-level solver kernel: 'pallas' = fused Pallas TPU kernels "
+        "(MOE gather+reduce, hook+compress), 'xla' = the plain two-step "
+        "path, 'auto' (default) = Pallas on TPU where the capability probe "
+        "passes, XLA elsewhere; warmup precompiles the selected variant "
+        "and fleet workers inherit the choice (docs/KERNELS.md)",
     )
     srv.add_argument(
         "--warmup-record",

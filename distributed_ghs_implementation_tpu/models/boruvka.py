@@ -62,14 +62,23 @@ def boruvka_level(
     *,
     axis_name: str | None = None,
     identity_fragment: bool = False,
+    kernel: str = "xla",
 ) -> BoruvkaState:
-    """One GHS/Borůvka level over (optionally sharded) directed edge slots."""
+    """One GHS/Borůvka level over (optionally sharded) directed edge slots.
+
+    ``kernel`` selects the fused Pallas forms of the MOE gather/select and
+    the hook+compress round (``ops/pallas_kernels.py``) — a static
+    trace-time choice with identical results either way.
+    """
     fragment = state.fragment
     has_moe, moe_rank, moe_dst_frag = fragment_moe(
         fragment, src, dst, rank, ra, rb,
         axis_name=axis_name, identity_fragment=identity_fragment,
+        kernel=kernel,
     )
-    new_fragment, _ = hook_and_compress(has_moe, moe_dst_frag, fragment)
+    new_fragment, _ = hook_and_compress(
+        has_moe, moe_dst_frag, fragment, kernel=kernel
+    )
 
     # Record winning ranks. Sharded: each shard owns a contiguous rank block
     # and marks only winners inside it.
@@ -109,6 +118,8 @@ def boruvka_solve(
     rank: jax.Array,
     ra: jax.Array,
     rb: jax.Array,
+    *,
+    kernel: str = "xla",
 ) -> Tuple[jax.Array, jax.Array, jax.Array]:
     """Full single-device solve from an arbitrary starting partition.
 
@@ -130,14 +141,14 @@ def boruvka_solve(
         return s.progress & (s.level < max_levels)
 
     def body(s: BoruvkaState):
-        return boruvka_level(s, src, dst, rank, ra, rb)
+        return boruvka_level(s, src, dst, rank, ra, rb, kernel=kernel)
 
     final = jax.lax.while_loop(cond, body, state)
     return final.mst_ranks, final.fragment, final.level
 
 
-@functools.partial(jax.jit, static_argnames=("num_nodes",))
-def _solve_from_iota(src, dst, rank, ra, rb, *, num_nodes: int):
+@functools.partial(jax.jit, static_argnames=("num_nodes", "kernel"))
+def _solve_from_iota(src, dst, rank, ra, rb, *, num_nodes: int, kernel: str = "xla"):
     """Solve from the identity partition, with the level-0 fast path (the
     relabel gathers on the biggest level are skipped because fragment == iota;
     only safe when the partition really is the identity)."""
@@ -148,19 +159,21 @@ def _solve_from_iota(src, dst, rank, ra, rb, *, num_nodes: int):
         progress=jnp.ones((), bool),
     )
     max_levels = _max_levels(num_nodes)
-    state = boruvka_level(state, src, dst, rank, ra, rb, identity_fragment=True)
+    state = boruvka_level(
+        state, src, dst, rank, ra, rb, identity_fragment=True, kernel=kernel
+    )
 
     def cond(s: BoruvkaState):
         return s.progress & (s.level < max_levels)
 
     def body(s: BoruvkaState):
-        return boruvka_level(s, src, dst, rank, ra, rb)
+        return boruvka_level(s, src, dst, rank, ra, rb, kernel=kernel)
 
     final = jax.lax.while_loop(cond, body, state)
     return final.mst_ranks, final.fragment, final.level
 
 
-_jit_solve = jax.jit(boruvka_solve)
+_jit_solve = jax.jit(boruvka_solve, static_argnames=("kernel",))
 
 
 # ---------------------------------------------------------------------------
@@ -176,9 +189,16 @@ _jit_solve = jax.jit(boruvka_solve)
 
 
 def _ell_level(
-    fragment, mst_ranks, buckets, ra, rb, *, axis_name=None, identity_fragment=False
+    fragment, mst_ranks, buckets, ra, rb, *, axis_name=None,
+    identity_fragment=False, kernel="xla",
 ):
     """One level over ELL buckets; returns (fragment2, mst2, has_any).
+
+    ``kernel="pallas"`` runs each bucket's scan through the fused
+    VMEM-resident row-min kernel (``pallas_kernels.fused_ell_row_min`` —
+    both fragment gathers + mask + row reduction in one pass) and the
+    merge through the fused hook+compress kernel; guarded geometries and
+    ``"xla"`` take the plain forms below. Identical results either way.
 
     With ``axis_name``, bucket rows are a shard and per-vertex minima are
     merged across the mesh with one ``lax.pmin`` — the single collective per
@@ -192,9 +212,13 @@ def _ell_level(
     n = fragment.shape[0]
     ids = jnp.arange(n, dtype=jnp.int32)
     vmin = jnp.full(n, INT32_MAX, jnp.int32)
+    if kernel == "pallas":
+        from distributed_ghs_implementation_tpu.ops import pallas_kernels as pk
     for verts, dstb, rankb in buckets:
         if identity_fragment:
             row_min = rankb[:, 0]  # rank-sorted rows: first entry is the min
+        elif kernel == "pallas" and pk.ell_shape_ok(n, *dstb.shape):
+            row_min = pk.fused_ell_row_min(fragment, verts, dstb, rankb)
         else:
             fv = fragment[verts]
             fd = fragment[dstb]
@@ -217,19 +241,20 @@ def _ell_level(
         fa = fragment[ra[safe]]
         fb = fragment[rb[safe]]
     dst_frag = jnp.where(has, jnp.where(fa == ids, fb, fa), ids)
-    fragment2, _ = hook_and_compress(has, dst_frag, fragment)
+    fragment2, _ = hook_and_compress(has, dst_frag, fragment, kernel=kernel)
     mst2 = mst_ranks.at[safe].max(has)
     return fragment2, mst2, jnp.any(has)
 
 
-def ell_solve_loop(buckets, ra, rb, *, num_nodes: int, axis_name=None):
+def ell_solve_loop(buckets, ra, rb, *, num_nodes: int, axis_name=None,
+                   kernel="xla"):
     """Full ELL solve from the identity partition (shared by the single-device
     jit wrapper and the sharded shard_map body)."""
     fragment = jnp.arange(num_nodes, dtype=jnp.int32)
     mst_ranks = jnp.zeros(ra.shape[0], dtype=bool)
     fragment, mst_ranks, has = _ell_level(
         fragment, mst_ranks, buckets, ra, rb, axis_name=axis_name,
-        identity_fragment=True,
+        identity_fragment=True, kernel=kernel,
     )
     max_levels = _max_levels(num_nodes)
 
@@ -238,7 +263,9 @@ def ell_solve_loop(buckets, ra, rb, *, num_nodes: int, axis_name=None):
 
     def body(s):
         f, m, _, lv = s
-        f2, m2, h = _ell_level(f, m, buckets, ra, rb, axis_name=axis_name)
+        f2, m2, h = _ell_level(
+            f, m, buckets, ra, rb, axis_name=axis_name, kernel=kernel
+        )
         return (f2, m2, h, lv + 1)
 
     f, m, _, lv = jax.lax.while_loop(
@@ -247,9 +274,9 @@ def ell_solve_loop(buckets, ra, rb, *, num_nodes: int, axis_name=None):
     return m, f, lv
 
 
-@functools.partial(jax.jit, static_argnames=("num_nodes",))
-def _solve_ell(buckets, ra, rb, *, num_nodes: int):
-    return ell_solve_loop(buckets, ra, rb, num_nodes=num_nodes)
+@functools.partial(jax.jit, static_argnames=("num_nodes", "kernel"))
+def _solve_ell(buckets, ra, rb, *, num_nodes: int, kernel: str = "xla"):
+    return ell_solve_loop(buckets, ra, rb, num_nodes=num_nodes, kernel=kernel)
 
 
 def prepare_ell_arrays(graph: Graph):
@@ -277,8 +304,9 @@ def prepare_ell_arrays(graph: Graph):
 # ---------------------------------------------------------------------------
 
 
-@jax.jit
-def _level_kernel(fragment, mst_ranks, src_f, dst_f, rank, ra, rb):
+@functools.partial(jax.jit, static_argnames=("kernel",))
+def _level_kernel(fragment, mst_ranks, src_f, dst_f, rank, ra, rb, *,
+                  kernel: str = "xla"):
     """One level over fragment-relabeled slots; returns relabeled survivors.
 
     ``src_f/dst_f`` hold *current fragment ids* (relabeled each level), so the
@@ -286,9 +314,10 @@ def _level_kernel(fragment, mst_ranks, src_f, dst_f, rank, ra, rb):
     vertices (for the rank-indexed far-side lookup and the final result).
     """
     has, moe_rank, dst_frag = fragment_moe(
-        fragment, src_f, dst_f, rank, ra, rb, identity_fragment=True
+        fragment, src_f, dst_f, rank, ra, rb, identity_fragment=True,
+        kernel=kernel,
     )
-    fragment2, parent = hook_and_compress(has, dst_frag, fragment)
+    fragment2, parent = hook_and_compress(has, dst_frag, fragment, kernel=kernel)
     safe = jnp.where(has, moe_rank, 0)
     mst2 = mst_ranks.at[safe].max(has)
     src2 = parent[src_f]
@@ -312,8 +341,9 @@ def _compact_kernel(src_f, dst_f, rank, out_size: int):
 _COMPACT_MIN_SLOTS = 2048
 
 
-@jax.jit
-def _continue_solve(fragment, mst_ranks, level, src_f, dst_f, rank, ra, rb):
+@functools.partial(jax.jit, static_argnames=("kernel",))
+def _continue_solve(fragment, mst_ranks, level, src_f, dst_f, rank, ra, rb, *,
+                    kernel: str = "xla"):
     """Finish the solve on-device from a mid-run state (post-compaction)."""
     n = fragment.shape[0]
     state = BoruvkaState(
@@ -328,7 +358,7 @@ def _continue_solve(fragment, mst_ranks, level, src_f, dst_f, rank, ra, rb):
         return s.progress & (s.level < max_levels)
 
     def body(s: BoruvkaState):
-        return boruvka_level(s, src_f, dst_f, rank, ra, rb)
+        return boruvka_level(s, src_f, dst_f, rank, ra, rb, kernel=kernel)
 
     final = jax.lax.while_loop(cond, body, state)
     return final.mst_ranks, final.fragment, final.level
@@ -346,6 +376,7 @@ def solve_arrays_stepped(
     stepped_levels: int | None = 2,
     initial_state: tuple | None = None,
     on_level=None,
+    kernel: str | None = None,
 ):
     """Host-stepped solve — the single driver behind the hybrid strategy,
     instrumentation, and checkpointing (each was once its own loop copy).
@@ -356,9 +387,16 @@ def solve_arrays_stepped(
     must observe each one). ``initial_state`` is ``(fragment, mst_ranks,
     level)`` to resume mid-solve (slots are relabeled to the restored
     partition first). ``on_level(level, fragment, mst_ranks, has_np, count_np,
-    wall_time_s)`` fires after each stepped level. Returns
+    wall_time_s)`` fires after each stepped level. ``kernel`` selects the
+    fused Pallas level kernels (``None`` = process default via
+    ``pallas_kernels.kernel_choice``). Returns
     ``(mst_ranks, fragment, levels)``.
     """
+    from distributed_ghs_implementation_tpu.ops.pallas_kernels import (
+        kernel_choice,
+    )
+
+    kernel = kernel_choice(kernel)
     n = fragment0.shape[0]
     if initial_state is not None:
         fragment, mst_ranks, levels = initial_state
@@ -378,7 +416,7 @@ def solve_arrays_stepped(
     while levels < step_until:
         t0 = time.perf_counter()
         fragment, mst_ranks, src_f, dst_f, has, count = _level_kernel(
-            fragment, mst_ranks, src_f, dst_f, rank, ra, rb
+            fragment, mst_ranks, src_f, dst_f, rank, ra, rb, kernel=kernel
         )
         levels += 1
         has_np, count_np = jax.device_get((has, count))  # one round trip
@@ -407,7 +445,7 @@ def solve_arrays_stepped(
     with BUS.span("solver.fused_finish", cat="solver", from_level=levels):
         mst_ranks, fragment, level = _continue_solve(
             fragment, mst_ranks, jnp.asarray(levels, jnp.int32),
-            src_f, dst_f, rank, ra, rb,
+            src_f, dst_f, rank, ra, rb, kernel=kernel,
         )
         level = int(level)
     return mst_ranks, fragment, level
@@ -458,7 +496,11 @@ def prepare_device_arrays(graph: Graph, *, bucket_shapes: bool = True):
 
 
 def solve_graph(
-    graph: Graph, *, bucket_shapes: bool = True, strategy: str = "auto"
+    graph: Graph,
+    *,
+    bucket_shapes: bool = True,
+    strategy: str = "auto",
+    kernel: str | None = None,
 ) -> Tuple[np.ndarray, np.ndarray, int]:
     """Host entry: run the solver on a ``Graph``.
 
@@ -472,7 +514,16 @@ def solve_graph(
     graphs: shared pow2-bucketed compiles, one dispatch); ``"stepped"`` =
     host-stepped levels with edge compaction, kept for instrumentation and
     checkpointing.
+
+    ``kernel`` (``None`` = process default, ``"pallas"``/``"xla"``) selects
+    the fused Pallas level kernels on the ``ell``/``fused``/``stepped``
+    strategies (docs/KERNELS.md); the rank solver keeps its own XLA-shaped
+    program. A Pallas failure at compile or dispatch trips the sticky
+    process-wide fallback (``pallas_kernels.disable_pallas``) and the solve
+    re-runs on the XLA path — degraded throughput, never a failed solve.
     """
+    from distributed_ghs_implementation_tpu.ops import pallas_kernels as pk
+
     n = graph.num_nodes
     if n == 0 or graph.num_edges == 0:
         return np.zeros(0, dtype=np.int64), np.arange(n, dtype=np.int32), 0
@@ -481,9 +532,10 @@ def solve_graph(
         # far cheaper host prep); small graphs stay on the shape-bucketed flat
         # kernel (shared compiles, single dispatch).
         strategy = "rank" if graph.num_edges >= ELL_AUTO_EDGE_THRESHOLD else "fused"
+    kernel = pk.kernel_choice(kernel)
     with BUS.span(
         "solver.solve", cat="solver",
-        strategy=strategy, nodes=n, edges=graph.num_edges,
+        strategy=strategy, nodes=n, edges=graph.num_edges, kernel=kernel,
     ):
         if strategy == "rank":
             from distributed_ghs_implementation_tpu.models.rank_solver import (
@@ -491,19 +543,44 @@ def solve_graph(
             )
 
             return solve_graph_rank(graph)
-        if strategy == "ell":
-            buckets, ra, rb, n_pad = prepare_ell_arrays(graph)
-            mst_ranks, fragment, levels = _solve_ell(buckets, ra, rb, num_nodes=n_pad)
-        elif strategy == "stepped":
-            args = prepare_device_arrays(graph, bucket_shapes=bucket_shapes)
-            mst_ranks, fragment, levels = solve_arrays_stepped(*args)
-        elif strategy == "fused":
-            args = prepare_device_arrays(graph, bucket_shapes=bucket_shapes)
-            mst_ranks, fragment, levels = _solve_from_iota(
-                *args[1:], num_nodes=args[0].shape[0]
+        try:
+            if strategy == "ell":
+                buckets, ra, rb, n_pad = prepare_ell_arrays(graph)
+                mst_ranks, fragment, levels = _solve_ell(
+                    buckets, ra, rb, num_nodes=n_pad, kernel=kernel
+                )
+            elif strategy == "stepped":
+                args = prepare_device_arrays(graph, bucket_shapes=bucket_shapes)
+                mst_ranks, fragment, levels = solve_arrays_stepped(
+                    *args, kernel=kernel
+                )
+            elif strategy == "fused":
+                args = prepare_device_arrays(graph, bucket_shapes=bucket_shapes)
+                mst_ranks, fragment, levels = _solve_from_iota(
+                    *args[1:], num_nodes=args[0].shape[0], kernel=kernel
+                )
+            else:
+                raise ValueError(f"unknown strategy {strategy!r}")
+            # Fetch INSIDE the try: dispatch is async, so a Pallas program
+            # that faults at execution raises at this first host sync, not
+            # at the solver call above — without this, a runtime failure
+            # would escape the fallback below.
+            mst_ranks, fragment, levels = jax.device_get(
+                (mst_ranks, fragment, levels)
             )
-        else:
-            raise ValueError(f"unknown strategy {strategy!r}")
+        except ValueError:
+            raise
+        except Exception as ex:  # noqa: BLE001 — speculative-kernel fallback
+            if kernel != "pallas":
+                raise
+            # The round-5 fallback discipline: a Pallas compile/dispatch
+            # failure permanently degrades this process to XLA and the
+            # solve re-runs — results stay exact, only throughput changes.
+            pk.disable_pallas(f"solve_graph[{strategy}]: {type(ex).__name__}: {ex}")
+            return solve_graph(
+                graph, bucket_shapes=bucket_shapes, strategy=strategy,
+                kernel="xla",
+            )
         ranks = np.nonzero(np.asarray(mst_ranks))[0]
         edge_ids = np.sort(graph.edge_id_of_rank(ranks))
         return edge_ids, np.asarray(fragment)[:n], int(levels)

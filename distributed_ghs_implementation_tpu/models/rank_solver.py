@@ -220,8 +220,12 @@ def _prefix_level2_core(fragment, fa, fb):
     return fragment, fa, fb, has2, safe2, count
 
 
-def _level_core(fragment, fa, fb, key_of_slot, n):
-    """MOE + hook for one level; returns (fragment2, parent, has, safe)."""
+def _level_core(fragment, fa, fb, key_of_slot, n, *, kernel="xla"):
+    """MOE + hook for one level; returns (fragment2, parent, has, safe).
+
+    ``kernel`` selects the fused Pallas hook+compress round
+    (``ops/pallas_kernels.py``) — a static trace-time choice, identical
+    results either way."""
     ids = jnp.arange(n, dtype=jnp.int32)
     moe = _moe_over(fa, fb, key_of_slot, n)
     has = moe < INT32_MAX
@@ -229,7 +233,7 @@ def _level_core(fragment, fa, fb, key_of_slot, n):
     wa = fa[safe]
     wb = fb[safe]
     dst_frag = jnp.where(has, jnp.where(wa == ids, wb, wa), ids)
-    fragment2, parent = hook_and_compress(has, dst_frag, fragment)
+    fragment2, parent = hook_and_compress(has, dst_frag, fragment, kernel=kernel)
     return fragment2, parent, has, safe
 
 

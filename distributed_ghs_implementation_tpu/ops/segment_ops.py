@@ -45,6 +45,7 @@ def fragment_moe(
     *,
     axis_name: str | None = None,
     identity_fragment: bool = False,
+    kernel: str = "xla",
 ) -> Tuple[jax.Array, jax.Array, jax.Array]:
     """Per-fragment minimum outgoing edge over (optionally sharded) edge slots.
 
@@ -64,6 +65,11 @@ def fragment_moe(
       axis_name: if set, combine per-fragment minima across this mesh axis
         with ``lax.pmin`` — the ICI replacement for the reference's MPI
         point-to-point REPORT convergecast.
+      kernel: ``"pallas"`` fuses the two fragment gathers + the alive-mask
+        rank select into one VMEM pass (``ops.pallas_kernels.
+        fused_gather_key``) on non-identity partitions; guarded geometries
+        and ``"xla"`` take the plain gather/select form. Identical results
+        either way.
 
     Returns:
       ``(has_moe[n], moe_rank[n], moe_dst_frag[n])`` — whether each fragment
@@ -76,11 +82,16 @@ def fragment_moe(
     if identity_fragment:
         # Level 0: fragment == iota, so the relabel gathers are identity.
         f_src, f_dst = src, dst
+        key = jnp.where(f_src != f_dst, rank, INT32_MAX)
     else:
-        f_src = fragment[src]
-        f_dst = fragment[dst]
-    alive = f_src != f_dst
-    key = jnp.where(alive, rank, INT32_MAX)
+        from distributed_ghs_implementation_tpu.ops import pallas_kernels as pk
+
+        if kernel == "pallas" and pk.flat_shape_ok(n, src.shape[0]):
+            f_src, key = pk.fused_gather_key(fragment, src, dst, rank)
+        else:
+            f_src = fragment[src]
+            f_dst = fragment[dst]
+            key = jnp.where(f_src != f_dst, rank, INT32_MAX)
     moe_rank = segment_min(key, f_src, n)
     if axis_name is not None:
         moe_rank = jax.lax.pmin(moe_rank, axis_name)

@@ -60,7 +60,11 @@ def pointer_jump(parent: jax.Array, *, num_iters: int | None = None) -> jax.Arra
 
 
 def hook_and_compress(
-    has_moe: jax.Array, moe_dst_frag: jax.Array, fragment: jax.Array
+    has_moe: jax.Array,
+    moe_dst_frag: jax.Array,
+    fragment: jax.Array,
+    *,
+    kernel: str = "xla",
 ) -> tuple[jax.Array, jax.Array]:
     """One merge round: hook every active fragment, compress, relabel vertices.
 
@@ -69,8 +73,19 @@ def hook_and_compress(
     other root-id-valued arrays). Fragments with no outgoing edge (isolated
     components — the root-termination case, ``ghs_implementation.py:316-320``)
     self-hook and are left untouched.
+
+    ``kernel="pallas"`` routes through the fused Pallas kernel
+    (``ops.pallas_kernels.fused_hook_compress``): symmetric break, bounded
+    pointer jumping, and the relabel gather run in one VMEM-resident pass
+    with no intermediate parent arrays in HBM. Geometries past the VMEM
+    guard take this XLA form regardless; results are identical either way.
     """
     n = fragment.shape[0]
+    if kernel == "pallas":
+        from distributed_ghs_implementation_tpu.ops import pallas_kernels as pk
+
+        if pk.hook_shape_ok(n):
+            return pk.fused_hook_compress(has_moe, moe_dst_frag, fragment)
     ids = jnp.arange(n, dtype=fragment.dtype)
     parent = jnp.where(has_moe, moe_dst_frag, ids)
     parent = break_symmetric_hooks(parent)

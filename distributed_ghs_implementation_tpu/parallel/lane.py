@@ -188,6 +188,7 @@ class ShardedLane:
         capacity: int = DEFAULT_CAPACITY,
         max_update_frac: float = DEFAULT_MAX_UPDATE_FRAC,
         max_in_flight: int = 2,
+        kernel: Optional[str] = None,
     ):
         if capacity < 1:
             raise ValueError(f"capacity must be >= 1, got {capacity}")
@@ -201,6 +202,18 @@ class ShardedLane:
             )
         self.mesh = mesh if mesh is not None else edge_mesh()
         self.n_dev = int(self.mesh.devices.size)
+        # Level-kernel variant for every program this lane dispatches
+        # (head / in-place levels / finish) — resolved ONCE at construction
+        # so warmup and every later solve compile the same variant
+        # (docs/KERNELS.md). A Pallas failure mid-solve repins this to
+        # "xla" (see the fallback in solve()) so later dispatches and the
+        # retry resolve together — degraded to request-time XLA compiles
+        # on first touch, never a failed solve.
+        from distributed_ghs_implementation_tpu.ops.pallas_kernels import (
+            kernel_choice,
+        )
+
+        self.kernel = kernel_choice(kernel)
         self.capacity = capacity
         self.max_update_frac = max_update_frac
         self._lru: "collections.OrderedDict[str, ResidentGraph]" = (
@@ -288,6 +301,13 @@ class ShardedLane:
                 self._in_use.get(digest, 0) > 0,
             )
 
+    def evict(self, digest: str) -> bool:
+        """Drop a resident graph from the LRU (its device buffers free once
+        no in-flight dispatch holds a checkout). Returns whether it was
+        resident. The next solve of that digest restages from the host."""
+        res, _ = self._pop_resident(digest)
+        return res is not None
+
     def _stage_resident(
         self,
         graph: Graph,
@@ -358,10 +378,36 @@ class ShardedLane:
                 res = self._stage_resident(graph, digest)
                 self._put_resident(res, checkout=True)
             try:
-                return self._dispatch_solve(
-                    res, graph, yield_fn=yield_fn, phase=phase,
-                    resident=resident_hit,
-                )
+                try:
+                    return self._dispatch_solve(
+                        res, graph, yield_fn=yield_fn, phase=phase,
+                        resident=resident_hit,
+                    )
+                except ValueError:
+                    raise  # caller/geometry errors are never kernel faults
+                except Exception as ex:  # noqa: BLE001 — kernel fallback
+                    if self.kernel != "pallas":
+                        raise
+                    # Speculative-kernel discipline (docs/KERNELS.md): a
+                    # Pallas compile/dispatch failure in the mesh programs
+                    # trips the sticky process-wide fallback, repins this
+                    # lane to XLA (every later dispatch — and warmup —
+                    # resolves the same variant), and the SAME resident
+                    # graph re-dispatches: the staged arrays are intact
+                    # (the solve programs never donate them), so the
+                    # retry is exact and the oversize query never fails.
+                    from distributed_ghs_implementation_tpu.ops.pallas_kernels import (  # noqa: E501
+                        disable_pallas,
+                    )
+
+                    disable_pallas(
+                        f"sharded lane: {type(ex).__name__}: {ex}"
+                    )
+                    self.kernel = "xla"
+                    return self._dispatch_solve(
+                        res, graph, yield_fn=yield_fn, phase=phase,
+                        resident=resident_hit,
+                    )
             finally:
                 # The checkout pins the entry's buffers against donation
                 # by a concurrent refresh for the dispatch's duration.
@@ -391,8 +437,10 @@ class ShardedLane:
             "lane.solve", cat="lane", nodes=graph.num_nodes,
             edges=graph.num_edges, devices=self.n_dev, resident=resident,
         ) as span:
-            _note_dispatch(("head", n_pad, m_pad, self.n_dev, mesh), phase)
-            head = make_rank_sharded_head(mesh)
+            _note_dispatch(
+                ("head", n_pad, m_pad, self.n_dev, self.kernel, mesh), phase
+            )
+            head = make_rank_sharded_head(mesh, self.kernel)
             fragment, mst, fa, fb, stats = head(
                 res.vmin0, res.parent1, res.ra, res.rb
             )
@@ -402,8 +450,11 @@ class ShardedLane:
                 total > 0
                 and self.n_dev * _bucket_size(cmax) > _FINISH_GATHER_MAX_SLOTS
             ):
-                _note_dispatch(("level", n_pad, m_pad, self.n_dev, mesh), phase)
-                level_fn = make_rank_sharded_level(mesh)
+                _note_dispatch(
+                    ("level", n_pad, m_pad, self.n_dev, self.kernel, mesh),
+                    phase,
+                )
+                level_fn = make_rank_sharded_level(mesh, kernel=self.kernel)
                 fragment, mst, fa, fb, lstats = level_fn(fragment, mst, fa, fb)
                 total, cmax, progressed = (
                     int(x) for x in jax.device_get(lstats)
@@ -417,10 +468,12 @@ class ShardedLane:
                 max_levels = _max_levels(n_pad)
                 _note_dispatch(
                     ("finish", n_pad, m_pad, fs_local, max_levels,
-                     self.n_dev, mesh),
+                     self.n_dev, self.kernel, mesh),
                     phase,
                 )
-                finish = make_rank_sharded_finish(mesh, fs_local, max_levels)
+                finish = make_rank_sharded_finish(
+                    mesh, fs_local, max_levels, kernel=self.kernel
+                )
                 fragment, mst, extra = finish(fragment, mst, fa, fb)
                 lv += int(extra)
             checkpoint()
@@ -629,7 +682,24 @@ class ShardedLane:
         res = self._stage_resident(
             warm, warm.digest(), pad_shape=(n_pad, m_pad)
         )
-        self._dispatch_solve(res, warm, phase="warmup", resident=False)
+        try:
+            self._dispatch_solve(res, warm, phase="warmup", resident=False)
+        except ValueError:
+            raise  # caller/geometry errors are never kernel faults
+        except Exception as ex:  # noqa: BLE001 — kernel fallback
+            if self.kernel != "pallas":
+                raise
+            # Same repin as solve(): a Pallas failure during mesh warmup
+            # must degrade the lane to XLA, not kill boot (docs/KERNELS.md).
+            from distributed_ghs_implementation_tpu.ops.pallas_kernels import (
+                disable_pallas,
+            )
+
+            disable_pallas(
+                f"sharded lane warmup: {type(ex).__name__}: {ex}"
+            )
+            self.kernel = "xla"
+            self._dispatch_solve(res, warm, phase="warmup", resident=False)
         # Warm the donated-update scatter at its floor width too: a
         # single-edge update on this bucket then compiles nothing. The
         # warm entry is being discarded, so donation consuming its
@@ -641,4 +711,8 @@ class ShardedLane:
         idx = np.full(1024, m_pad, dtype=np.int32)  # all pads: a no-op write
         with self._dispatch:
             scatter(res.ra, idx, np.zeros(1024, dtype=np.int32))
-        return {"bucket": (n_pad, m_pad), "devices": self.n_dev}
+        return {
+            "bucket": (n_pad, m_pad),
+            "devices": self.n_dev,
+            "kernel": self.kernel,
+        }

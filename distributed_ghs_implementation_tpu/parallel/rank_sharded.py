@@ -171,20 +171,22 @@ def _moe_kl(fa, fb, k, mb, n):
     return has, mine, li, wa, wb
 
 
-def _sharded_moe_level(fragment, mst, fa, fb, k, n, moe_fn):
+def _sharded_moe_level(fragment, mst, fa, fb, k, n, moe_fn, kernel="xla"):
     """One hook level over relabeled sharded endpoints — the shared body of
     the int32 and split-key programs; ``moe_fn`` is the only difference.
-    Returns ``(fragment, mst, fa, fb, has)``."""
+    ``kernel`` selects the fused Pallas hook+compress round (the n-sized
+    replicated union-find — identical results either way). Returns
+    ``(fragment, mst, fa, fb, has)``."""
     mb = fa.shape[0]
     ids = jnp.arange(n, dtype=jnp.int32)
     has, mine, li, wa, wb = moe_fn(fa, fb, k, mb, n)
     dst = jnp.where(has, jnp.where(wa == ids, wb, wa), ids)
-    fragment, parent = hook_and_compress(has, dst, fragment)
+    fragment, parent = hook_and_compress(has, dst, fragment, kernel=kernel)
     mst = mst.at[jnp.where(mine, li, mb)].max(mine, mode="drop")
     return fragment, mst, parent[fa], parent[fb], has
 
 
-def _rank_sharded_head_kl(vk, vl, parent1, ra, rb):
+def _rank_sharded_head_kl(vk, vl, parent1, ra, rb, *, kernel="xla"):
     """Split-key per-shard head: levels 1-2 with all-int32 device state.
     Same contract as ``_rank_sharded_head``."""
     n = vk.shape[0]
@@ -197,7 +199,7 @@ def _rank_sharded_head_kl(vk, vl, parent1, ra, rb):
     fa = parent1[ra]
     fb = parent1[rb]
     fragment, mst, fa, fb, has2 = _sharded_moe_level(
-        fragment, mst, fa, fb, k, n, _moe_kl
+        fragment, mst, fa, fb, k, n, _moe_kl, kernel
     )
 
     lv = jnp.any(has1).astype(jnp.int32) + jnp.any(has2).astype(jnp.int32)
@@ -208,7 +210,7 @@ def _rank_sharded_head_kl(vk, vl, parent1, ra, rb):
 
 
 def _rank_sharded_finish_kl(
-    fragment, mst, fa, fb, *, fs_local: int, max_levels: int
+    fragment, mst, fa, fb, *, fs_local: int, max_levels: int, kernel="xla"
 ):
     """Split-key variant of ``_rank_sharded_finish``: survivor cranks carry
     LOCAL offsets only; the owning shard of a gathered slot is its block
@@ -232,7 +234,9 @@ def _rank_sharded_finish_kl(
     def body(s):
         fragment, mst, gfa, gfb, _, lv = s
         key = jnp.where(gfa != gfb, cslot, INT32_MAX)
-        fragment, parent, has, safe = _level_core(fragment, gfa, gfb, key, n)
+        fragment, parent, has, safe = _level_core(
+            fragment, gfa, gfb, key, n, kernel=kernel
+        )
         owner = safe // fs_local
         winners = gcrank[safe]
         mine = has & (owner == k)
@@ -245,7 +249,7 @@ def _rank_sharded_finish_kl(
     return fragment, mst, lv
 
 
-def _rank_sharded_head(vmin0, parent1, ra, rb):
+def _rank_sharded_head(vmin0, parent1, ra, rb, *, kernel="xla"):
     """Per-shard body: levels 1-2 (level-1 partition host-precomputed).
     Returns ``(fragment, mst_local, fa, fb, stats)`` with ``stats =
     [levels, total_alive, max_local_alive]``."""
@@ -262,7 +266,7 @@ def _rank_sharded_head(vmin0, parent1, ra, rb):
     fa = parent1[ra]
     fb = parent1[rb]
     fragment, mst, fa, fb, has2 = _sharded_moe_level(
-        fragment, mst, fa, fb, k, n, _moe_int32
+        fragment, mst, fa, fb, k, n, _moe_int32, kernel
     )
 
     lv = jnp.any(has1).astype(jnp.int32) + jnp.any(has2).astype(jnp.int32)
@@ -272,7 +276,8 @@ def _rank_sharded_head(vmin0, parent1, ra, rb):
     return fragment, mst, fa, fb, jnp.stack([lv, total, cmax])
 
 
-def _finish_gathered_loop(fragment, mst, cfa, cfb, crank, k, mb, max_levels):
+def _finish_gathered_loop(fragment, mst, cfa, cfb, crank, k, mb, max_levels,
+                          kernel="xla"):
     """All-gather per-shard compacted survivors and run the remaining levels
     replicated (each shard marks only its own rank block) — the shared tail
     of :func:`_rank_sharded_finish` and :func:`_rank_sharded_finish_pre`.
@@ -290,7 +295,9 @@ def _finish_gathered_loop(fragment, mst, cfa, cfb, crank, k, mb, max_levels):
     def body(s):
         fragment, mst, gfa, gfb, _, lv = s
         key = jnp.where(gfa != gfb, cslot, INT32_MAX)
-        fragment, parent, has, safe = _level_core(fragment, gfa, gfb, key, n)
+        fragment, parent, has, safe = _level_core(
+            fragment, gfa, gfb, key, n, kernel=kernel
+        )
         winners = gcrank[safe] - k * mb  # global rank -> local block offset
         mine = has & (winners >= 0) & (winners < mb)
         mst = mst.at[jnp.where(mine, winners, mb)].max(mine, mode="drop")
@@ -302,7 +309,8 @@ def _finish_gathered_loop(fragment, mst, cfa, cfb, crank, k, mb, max_levels):
     return fragment, mst, lv
 
 
-def _rank_sharded_finish(fragment, mst, fa, fb, *, fs_local: int, max_levels: int):
+def _rank_sharded_finish(fragment, mst, fa, fb, *, fs_local: int,
+                         max_levels: int, kernel="xla"):
     """Per-shard body: compact local survivors, all-gather, run the remaining
     levels replicated (each shard marks only its own rank block)."""
     mb = fa.shape[0]
@@ -310,17 +318,18 @@ def _rank_sharded_finish(fragment, mst, fa, fb, *, fs_local: int, max_levels: in
     crank_local = k * mb + jnp.arange(mb, dtype=jnp.int32)
     cfa, cfb, crank, _ = _compact_slots(fa, fb, crank_local, fs_local)
     return _finish_gathered_loop(
-        fragment, mst, cfa, cfb, crank, k, mb, max_levels
+        fragment, mst, cfa, cfb, crank, k, mb, max_levels, kernel
     )
 
 
-def _rank_sharded_finish_pre(fragment, mst, cfa, cfb, crank, *, max_levels: int):
+def _rank_sharded_finish_pre(fragment, mst, cfa, cfb, crank, *,
+                             max_levels: int, kernel="xla"):
     """Per-shard body for ALREADY-COMPACTED survivors (the fused
     filter+compact path): all-gather + replicated levels only."""
     mb = mst.shape[0]
     k = jax.lax.axis_index(EDGE_AXIS).astype(jnp.int32)
     return _finish_gathered_loop(
-        fragment, mst, cfa, cfb, crank, k, mb, max_levels
+        fragment, mst, cfa, cfb, crank, k, mb, max_levels, kernel
     )
 
 
@@ -360,7 +369,8 @@ def _rank_resume_relabel(fragment, ra, rb):
     return fa, fb, jnp.stack([total, cmax])
 
 
-def _rank_sharded_level(fragment, mst, fa, fb, *, moe_fn=_moe_int32):
+def _rank_sharded_level(fragment, mst, fa, fb, *, moe_fn=_moe_int32,
+                        kernel="xla"):
     """Per-shard body: ONE Borůvka level over already-relabeled sharded
     endpoints, in place (per-shard ``segment_min`` + pmin combine,
     endpoints stay block-sharded — no survivor gather). Used when the alive
@@ -371,7 +381,7 @@ def _rank_sharded_level(fragment, mst, fa, fb, *, moe_fn=_moe_int32):
     n = fragment.shape[0]
     k = jax.lax.axis_index(EDGE_AXIS).astype(jnp.int32)
     fragment, mst, fa, fb, has = _sharded_moe_level(
-        fragment, mst, fa, fb, k, n, moe_fn
+        fragment, mst, fa, fb, k, n, moe_fn, kernel
     )
     local_alive = jnp.sum((fa != fb).astype(jnp.int32))
     total = jax.lax.psum(local_alive, EDGE_AXIS)
@@ -488,8 +498,10 @@ def make_rank_filter_compact(mesh: Mesh, prefix: int, fs_local: int):
 
 
 @functools.lru_cache(maxsize=32)
-def make_rank_sharded_finish_pre(mesh: Mesh, max_levels: int):
-    fn = functools.partial(_rank_sharded_finish_pre, max_levels=max_levels)
+def make_rank_sharded_finish_pre(mesh: Mesh, max_levels: int, kernel: str = "xla"):
+    fn = functools.partial(
+        _rank_sharded_finish_pre, max_levels=max_levels, kernel=kernel
+    )
     mapped = shard_map_compat(
         fn,
         mesh,
@@ -554,9 +566,10 @@ def make_rank_resume_relabel(mesh: Mesh):
 
 
 @functools.lru_cache(maxsize=32)
-def make_rank_sharded_level(mesh: Mesh, rank64: bool = False):
+def make_rank_sharded_level(mesh: Mesh, rank64: bool = False, kernel: str = "xla"):
     fn = functools.partial(
-        _rank_sharded_level, moe_fn=_moe_kl if rank64 else _moe_int32
+        _rank_sharded_level, moe_fn=_moe_kl if rank64 else _moe_int32,
+        kernel=kernel,
     )
     mapped = shard_map_compat(
         fn,
@@ -568,9 +581,9 @@ def make_rank_sharded_level(mesh: Mesh, rank64: bool = False):
 
 
 @functools.lru_cache(maxsize=32)
-def make_rank_sharded_head_kl(mesh: Mesh):
+def make_rank_sharded_head_kl(mesh: Mesh, kernel: str = "xla"):
     mapped = shard_map_compat(
-        _rank_sharded_head_kl,
+        functools.partial(_rank_sharded_head_kl, kernel=kernel),
         mesh,
         in_specs=(P(), P(), P(), P(EDGE_AXIS), P(EDGE_AXIS)),
         out_specs=(P(), P(EDGE_AXIS), P(EDGE_AXIS), P(EDGE_AXIS), P()),
@@ -603,9 +616,9 @@ def _full_mask_host(mesh, mst, m_pad: int, mst_p=None, prefix: int = 0):
 
 
 @functools.lru_cache(maxsize=32)
-def make_rank_sharded_head(mesh: Mesh):
+def make_rank_sharded_head(mesh: Mesh, kernel: str = "xla"):
     mapped = shard_map_compat(
-        _rank_sharded_head,
+        functools.partial(_rank_sharded_head, kernel=kernel),
         mesh,
         in_specs=(P(), P(), P(EDGE_AXIS), P(EDGE_AXIS)),
         out_specs=(P(), P(EDGE_AXIS), P(EDGE_AXIS), P(EDGE_AXIS), P()),
@@ -615,11 +628,12 @@ def make_rank_sharded_head(mesh: Mesh):
 
 @functools.lru_cache(maxsize=64)
 def make_rank_sharded_finish(
-    mesh: Mesh, fs_local: int, max_levels: int, rank64: bool = False
+    mesh: Mesh, fs_local: int, max_levels: int, rank64: bool = False,
+    kernel: str = "xla",
 ):
     fn = functools.partial(
         _rank_sharded_finish_kl if rank64 else _rank_sharded_finish,
-        fs_local=fs_local, max_levels=max_levels,
+        fs_local=fs_local, max_levels=max_levels, kernel=kernel,
     )
     mapped = shard_map_compat(
         fn,
@@ -638,8 +652,15 @@ def solve_graph_rank_sharded(
     on_chunk=None,
     initial_state: tuple | None = None,
     rank64: bool | None = None,
+    kernel: str | None = None,
 ) -> Tuple[np.ndarray, np.ndarray, int]:
     """Host entry mirroring ``solve_graph_rank`` on a device mesh.
+
+    ``kernel`` (``None`` = process default) selects the fused Pallas
+    hook+compress round inside the head / in-place level / finish
+    programs (docs/KERNELS.md); the prefix phase of the filtered path
+    keeps its XLA form (it runs replicated through the single-chip
+    helpers).
 
     Plain path (small/sparse graphs): two dispatches — the sharded head
     (levels 1-2), then the compact/all-gather finish sized from the head's
@@ -685,6 +706,11 @@ def solve_graph_rank_sharded(
     """
     if mesh is None:
         mesh = edge_mesh()
+    from distributed_ghs_implementation_tpu.ops.pallas_kernels import (
+        kernel_choice,
+    )
+
+    kernel = kernel_choice(kernel)
     n_dev = int(mesh.devices.size)
     n = graph.num_nodes
     if n == 0 or graph.num_edges == 0:
@@ -859,11 +885,11 @@ def solve_graph_rank_sharded(
             mst, fa, fb, fstats = filt(fragment, mst_p, mst, ra, rb)
             total, cmax = (int(x) for x in jax.device_get(fstats))
     elif rank64:
-        head = make_rank_sharded_head_kl(mesh)
+        head = make_rank_sharded_head_kl(mesh, kernel)
         fragment, mst, fa, fb, stats = head(vk, vl, parent1, ra, rb)
         lv, total, cmax = (int(x) for x in jax.device_get(stats))
     else:
-        head = make_rank_sharded_head(mesh)
+        head = make_rank_sharded_head(mesh, kernel)
         fragment, mst, fa, fb, stats = head(vmin0, parent1, ra, rb)
         lv, total, cmax = (int(x) for x in jax.device_get(stats))
     if on_chunk is not None and initial_state is None:
@@ -879,7 +905,9 @@ def solve_graph_rank_sharded(
         # gathered width is under the finish budget by construction — no
         # capacity guard.
         if total > 0:
-            finish = make_rank_sharded_finish_pre(mesh, _max_levels(n_pad))
+            finish = make_rank_sharded_finish_pre(
+                mesh, _max_levels(n_pad), kernel
+            )
             fragment, mst, extra = finish(fragment, mst, *fused)
             lv += int(extra)
             if on_chunk is not None:
@@ -896,7 +924,7 @@ def solve_graph_rank_sharded(
         # across processes (the harvest inside mask_fn is a collective).
         guard_iters = 0
         while total > 0 and n_dev * _bucket_size(cmax) > _FINISH_GATHER_MAX_SLOTS:
-            level_fn = make_rank_sharded_level(mesh, rank64)
+            level_fn = make_rank_sharded_level(mesh, rank64, kernel)
             fragment, mst, fa, fb, lstats = level_fn(fragment, mst, fa, fb)
             total, cmax, progressed = (int(x) for x in jax.device_get(lstats))
             lv += 1
@@ -911,7 +939,7 @@ def solve_graph_rank_sharded(
         if total > 0:
             fs_local = max(_bucket_size(cmax), 1024)
             finish = make_rank_sharded_finish(
-                mesh, fs_local, _max_levels(n_pad), rank64
+                mesh, fs_local, _max_levels(n_pad), rank64, kernel
             )
             fragment, mst, extra = finish(fragment, mst, fa, fb)
             lv += int(extra)

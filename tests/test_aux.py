@@ -468,9 +468,9 @@ def test_sharded_resume_capacity_guard(tmp_path, monkeypatch):
     used = []
     orig = rsh.make_rank_sharded_level
 
-    def spying(mesh, rank64=False):
+    def spying(mesh, rank64=False, kernel="xla"):
         used.append(1)
-        return orig(mesh, rank64)
+        return orig(mesh, rank64, kernel)
 
     monkeypatch.setattr(rsh, "make_rank_sharded_level", spying)
     monkeypatch.setattr(rsh, "_FINISH_GATHER_MAX_SLOTS", 64)

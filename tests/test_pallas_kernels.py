@@ -1,0 +1,439 @@
+"""Kernel-vs-XLA parity suite for ``ops/pallas_kernels.py`` (round 15).
+
+Promoted from the standalone hardware probe ``tools/test_pallas_gather.py``:
+off-TPU the fused kernels run in Pallas interpret mode — lowered to the
+same XLA ops the kernels trace, bit-exact — so edge-for-edge MST parity
+between ``kernel="pallas"`` and ``kernel="xla"`` is assertable in CPU-only
+tier-1 CI, with no hardware in the loop. The suite covers:
+
+* unit parity of each fused kernel against its two-step XLA form
+  (``fused_ell_row_min``, ``fused_gather_key``, ``fused_hook_compress``);
+* edge-for-edge MST equality on seeded RMAT (scales 12-14 tier-1, 16-18
+  behind the ``slow`` marker) and adversarial fuzz graphs, across every
+  strategy that threads the selector;
+* the rank-sharded 8-device dryrun path;
+* selection semantics: ``GHS_KERNEL``, ``set_default_kernel``, per-solve
+  override, auto-fallback off TPU, shape guards, and the sticky
+  ``disable_pallas`` runtime fallback (requests never fail, they degrade);
+* the lane cache / ``compile.*`` taxonomy keying kernel variants.
+"""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from distributed_ghs_implementation_tpu.batch.lanes import (
+    _SOLVER_CACHE,
+    clear_solver_cache,
+    compiled_bucket_keys,
+    execute_stacked,
+    solve_lanes,
+    stack_lanes,
+)
+from distributed_ghs_implementation_tpu.graphs.edgelist import Graph
+from distributed_ghs_implementation_tpu.graphs.generators import (
+    gnm_random_graph,
+    rmat_graph,
+)
+from distributed_ghs_implementation_tpu.models.boruvka import solve_graph
+from distributed_ghs_implementation_tpu.obs.events import BUS
+from distributed_ghs_implementation_tpu.ops import pallas_kernels as pk
+from distributed_ghs_implementation_tpu.ops.segment_ops import fragment_moe
+from distributed_ghs_implementation_tpu.ops.union_find import hook_and_compress
+
+INT32_MAX = np.iinfo(np.int32).max
+
+STRATEGIES = ("ell", "fused", "stepped")
+
+
+@pytest.fixture(autouse=True)
+def _clean_kernel_state(monkeypatch):
+    """Each test sees a fresh process: no sticky fallback, no default, no
+    ambient GHS_KERNEL from the invoking shell."""
+    monkeypatch.delenv("GHS_KERNEL", raising=False)
+    pk._reset_for_tests()
+    yield
+    pk._reset_for_tests()
+
+
+@pytest.fixture()
+def bus():
+    BUS.enable()
+    BUS.clear()
+    yield BUS
+    BUS.enable()
+    BUS.clear()
+
+
+def _solve_ids(g, strategy, kernel):
+    ids, _, _ = solve_graph(g, strategy=strategy, kernel=kernel)
+    return ids
+
+
+# ---------------------------------------------------------------------------
+# Unit parity: each fused kernel vs its two-step XLA form
+# ---------------------------------------------------------------------------
+def test_fused_ell_row_min_matches_xla_form():
+    rng = np.random.default_rng(0)
+    n, rows, width = 1000, 96, 8
+    fragment = jnp.asarray(rng.integers(0, n, size=n), jnp.int32)
+    verts = jnp.asarray(rng.integers(0, n, size=rows), jnp.int32)
+    dstb = jnp.asarray(rng.integers(0, n, size=(rows, width)), jnp.int32)
+    rankb = jnp.asarray(rng.integers(0, 10_000, size=(rows, width)), jnp.int32)
+    assert pk.ell_shape_ok(n, rows, width)
+    got = np.asarray(pk.fused_ell_row_min(fragment, verts, dstb, rankb))
+    fv = fragment[verts]
+    fd = fragment[dstb]
+    want = np.asarray(
+        jnp.min(jnp.where(fd != fv[:, None], rankb, INT32_MAX), axis=1)
+    )
+    np.testing.assert_array_equal(got, want)
+
+
+def test_fused_ell_row_min_pad_rows_stay_inert():
+    """All-sentinel pad rows come out INT32_MAX — inert under scatter-min."""
+    n, rows, width = 64, 16, 4
+    fragment = jnp.arange(n, dtype=jnp.int32)
+    verts = jnp.zeros(rows, jnp.int32)
+    dstb = jnp.zeros((rows, width), jnp.int32)  # dst frag == src frag
+    rankb = jnp.full((rows, width), INT32_MAX, jnp.int32)
+    got = np.asarray(pk.fused_ell_row_min(fragment, verts, dstb, rankb))
+    assert (got == INT32_MAX).all()
+
+
+def test_fused_gather_key_matches_xla_form():
+    rng = np.random.default_rng(1)
+    n, e = 500, 1024  # e % 128 == 0 (the flat-shape contract)
+    fragment = jnp.asarray(rng.integers(0, n, size=n), jnp.int32)
+    src = jnp.asarray(rng.integers(0, n, size=e), jnp.int32)
+    dst = jnp.asarray(rng.integers(0, n, size=e), jnp.int32)
+    rank = jnp.asarray(rng.permutation(e), jnp.int32)
+    assert pk.flat_shape_ok(n, e)
+    fsrc, key = pk.fused_gather_key(fragment, src, dst, rank)
+    f_src = fragment[src]
+    f_dst = fragment[dst]
+    np.testing.assert_array_equal(np.asarray(fsrc), np.asarray(f_src))
+    np.testing.assert_array_equal(
+        np.asarray(key),
+        np.asarray(jnp.where(f_src != f_dst, rank, INT32_MAX)),
+    )
+
+
+@pytest.mark.parametrize("n", [1000, 1024])  # with and without lane padding
+def test_fused_hook_compress_matches_hook_and_compress(n):
+    """Real hook forests (from a genuine MOE round, so cycles are only
+    mutual pairs) land on the identical (new_fragment, parent_star)."""
+    rng = np.random.default_rng(n)
+    g = gnm_random_graph(n, 4 * n, seed=int(rng.integers(1 << 30)))
+    src, dst, rank, ra, rb = _staged_arrays(g)
+    fragment = jnp.arange(g.num_nodes, dtype=jnp.int32)
+    has, _moe_rank, dst_frag = fragment_moe(fragment, src, dst, rank, ra, rb)
+    newf_x, par_x = hook_and_compress(has, dst_frag, fragment, kernel="xla")
+    newf_p, par_p = pk.fused_hook_compress(has, dst_frag, fragment)
+    np.testing.assert_array_equal(np.asarray(newf_p), np.asarray(newf_x))
+    np.testing.assert_array_equal(np.asarray(par_p), np.asarray(par_x))
+
+
+def _staged_arrays(g):
+    from distributed_ghs_implementation_tpu.models.boruvka import (
+        prepare_device_arrays,
+    )
+
+    _, src, dst, rank, ra, rb = prepare_device_arrays(g)
+    return src, dst, rank, ra, rb
+
+
+# ---------------------------------------------------------------------------
+# Shape guards: guarded geometries take the XLA form, never an error
+# ---------------------------------------------------------------------------
+def test_shape_guards():
+    assert not pk.hook_shape_ok(0)
+    assert not pk.hook_shape_ok(pk._HOOK_MAX_NODES + 1)
+    assert pk.hook_shape_ok(pk._HOOK_MAX_NODES)
+    assert not pk.flat_shape_ok(100, 130)  # not a lane multiple
+    assert not pk.flat_shape_ok(100, 64)  # under one lane row
+    assert not pk.flat_shape_ok(pk._TABLE_MAX_ELEMS + 1, 1024)
+    assert pk.flat_shape_ok(100, 128)
+    assert not pk.ell_shape_ok(0, 4, 4)
+    assert not pk.ell_shape_ok(pk._TABLE_MAX_ELEMS + 1, 4, 4)
+    assert pk.ell_shape_ok(100, 4, 4)
+
+
+def test_guarded_geometry_still_solves_under_pallas_request():
+    """A graph whose slot count fails the flat guard must still solve
+    correctly with kernel='pallas' — the guard routes it to XLA inline."""
+    g = gnm_random_graph(50, 60, seed=3)
+    ids_x = _solve_ids(g, "stepped", "xla")
+    ids_p = _solve_ids(g, "stepped", "pallas")
+    np.testing.assert_array_equal(ids_p, ids_x)
+
+
+# ---------------------------------------------------------------------------
+# Selection semantics
+# ---------------------------------------------------------------------------
+def test_kernel_choice_auto_never_interprets_for_throughput():
+    # CPU CI: probe passes (interpret mode), but auto must still pick xla.
+    assert pk.pallas_supported()
+    assert pk.kernel_choice() == "xla"
+    assert pk.kernel_choice("auto") == "xla"
+
+
+def test_kernel_choice_explicit_pallas_uses_interpret_probe():
+    assert pk.kernel_choice("pallas") == "pallas"
+    assert pk.kernel_choice("xla") == "xla"
+
+
+def test_kernel_choice_env_default_and_override(monkeypatch):
+    monkeypatch.setenv("GHS_KERNEL", "pallas")
+    assert pk.kernel_choice() == "pallas"
+    # Process default (serve --kernel) wins over the env var.
+    pk.set_default_kernel("xla")
+    assert pk.kernel_choice() == "xla"
+    # Per-solve override wins over both.
+    assert pk.kernel_choice("pallas") == "pallas"
+    # "auto" default clears back to env resolution.
+    pk.set_default_kernel("auto")
+    assert pk.kernel_choice() == "pallas"
+
+
+def test_kernel_choice_rejects_garbage(monkeypatch):
+    with pytest.raises(ValueError):
+        pk.kernel_choice("mosaic")
+    with pytest.raises(ValueError):
+        pk.set_default_kernel("fast")
+    monkeypatch.setenv("GHS_KERNEL", "banana")
+    with pytest.raises(ValueError):
+        pk.kernel_choice()
+
+
+def test_disable_pallas_is_sticky_and_counted(bus):
+    pk.disable_pallas("test: simulated mosaic failure")
+    assert pk.kernel_choice("pallas") == "xla"
+    assert not pk.pallas_supported()
+    assert bus.counters().get("kernel.fallback") == 1
+    pk.disable_pallas("second trip")  # idempotent: no double count
+    assert bus.counters().get("kernel.fallback") == 1
+    report = pk.kernel_report()
+    assert report["resolved"] == "xla"
+    assert "simulated mosaic failure" in report["disabled_reason"]
+    pk._reset_for_tests()  # simulated restart clears the latch
+    assert pk.kernel_choice("pallas") == "pallas"
+
+
+# ---------------------------------------------------------------------------
+# End-to-end parity: edge-for-edge identical MSTs
+# ---------------------------------------------------------------------------
+@pytest.mark.parametrize("scale", [12, 14])
+@pytest.mark.parametrize("strategy", STRATEGIES)
+def test_rmat_parity(scale, strategy):
+    g = rmat_graph(scale, 16, seed=24)
+    np.testing.assert_array_equal(
+        _solve_ids(g, strategy, "pallas"), _solve_ids(g, strategy, "xla")
+    )
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("scale", [16, 18])
+@pytest.mark.parametrize("strategy", STRATEGIES)
+def test_rmat_parity_large(scale, strategy):
+    g = rmat_graph(scale, 16, seed=24)
+    np.testing.assert_array_equal(
+        _solve_ids(g, strategy, "pallas"), _solve_ids(g, strategy, "xla")
+    )
+
+
+# Adversarial shapes from the fuzz net: pow2-straddling sizes, all-equal
+# weights (pure tie-break), dense multigraphs, single edges, disconnection.
+FUZZ_CASES = [
+    (16, 15, 3),
+    (17, 40, 2),
+    (33, 33, 1),
+    (257, 2048, 5),
+    (64, 1, 7),
+    (40, 4000, 4),
+]
+
+
+@pytest.mark.parametrize("n,m,wmax", FUZZ_CASES)
+def test_fuzz_parity(n, m, wmax):
+    rng = np.random.default_rng(n * 31 + m)
+    g = Graph.from_arrays(
+        n,
+        rng.integers(0, n, size=m),
+        rng.integers(0, n, size=m),
+        rng.integers(1, wmax + 1, size=m),
+    )
+    if g.num_edges == 0:
+        pytest.skip("degenerate draw: every edge was a self-loop")
+    for strategy in STRATEGIES:
+        np.testing.assert_array_equal(
+            _solve_ids(g, strategy, "pallas"),
+            _solve_ids(g, strategy, "xla"),
+            err_msg=strategy,
+        )
+
+
+def test_rank_sharded_parity_8dev_dryrun():
+    from distributed_ghs_implementation_tpu.parallel.rank_sharded import (
+        solve_graph_rank_sharded,
+    )
+
+    g = gnm_random_graph(9000, 36000, seed=5)
+    ids_x, _, _ = solve_graph_rank_sharded(g, kernel="xla")
+    ids_p, _, _ = solve_graph_rank_sharded(g, kernel="pallas")
+    np.testing.assert_array_equal(np.sort(ids_p), np.sort(ids_x))
+    ids_ref, _, _ = solve_graph(g, kernel="xla")
+    np.testing.assert_array_equal(np.sort(ids_p), ids_ref)
+
+
+# ---------------------------------------------------------------------------
+# Lane cache, compile taxonomy, warmup coverage
+# ---------------------------------------------------------------------------
+def test_lane_kernel_variants_cache_separately_and_agree(bus):
+    graphs = [gnm_random_graph(128, 480, seed=60 + i) for i in range(4)]
+    clear_solver_cache()
+    out_x = solve_lanes(graphs, lanes=4, kernel="xla")
+    out_p = solve_lanes(graphs, lanes=4, kernel="pallas")
+    for (ids_x, frag_x, _), (ids_p, frag_p, _) in zip(out_x, out_p):
+        np.testing.assert_array_equal(ids_p, ids_x)
+        np.testing.assert_array_equal(frag_p, frag_x)
+    # Two compiles, one per variant, both under the same public 4-key.
+    kernels = {k[4] for k in _SOLVER_CACHE}
+    assert kernels == {"xla", "pallas"}
+    assert len(compiled_bucket_keys()) == 1
+    counters = bus.counters()
+    assert counters.get("compile.kernel.xla") == 1
+    assert counters.get("compile.kernel.pallas") == 1
+
+
+def test_warmed_kernel_variant_is_a_request_time_hit(bus):
+    from distributed_ghs_implementation_tpu.batch.warmup import (
+        WarmupPlan,
+        bucket_of,
+        run_warmup,
+    )
+
+    clear_solver_cache()
+    plan = WarmupPlan(
+        buckets=(bucket_of(128, 480),), lanes=4, kernel="pallas",
+        warm_single=False,
+    )
+    report = run_warmup(plan)
+    assert report["kernel"] == "pallas"
+    assert report["compiled"] == 1
+    BUS.clear()
+    graphs = [gnm_random_graph(128, 480, seed=90 + i) for i in range(4)]
+    solve_lanes(graphs, lanes=4, kernel="pallas")
+    counters = BUS.counters()
+    assert counters.get("compile.miss", 0) == 0
+    assert counters.get("compile.hit") == 1
+
+
+def test_plan_from_flags_threads_kernel():
+    from distributed_ghs_implementation_tpu.batch.warmup import plan_from_flags
+
+    plan = plan_from_flags(buckets="128x480", lanes=4, kernel="pallas")
+    assert plan.kernel == "pallas"
+    plan = plan_from_flags(buckets="128x480", lanes=4, kernel="auto")
+    assert plan.kernel is None
+
+
+# ---------------------------------------------------------------------------
+# Sticky runtime fallback: a Pallas failure degrades, never fails.
+# The failure is injected at the solver-construction layer (a Mosaic
+# lowering regression surfaces exactly there): bombing the traced kernel
+# body itself is not deterministic, because jax's jit cache can satisfy a
+# retrace from an earlier test's jaxpr without re-entering the body.
+# ---------------------------------------------------------------------------
+def test_lane_compile_failure_falls_back_and_answers(bus, monkeypatch):
+    import distributed_ghs_implementation_tpu.batch.lanes as lanes_mod
+
+    graphs = [gnm_random_graph(128, 480, seed=70 + i) for i in range(4)]
+    clear_solver_cache()
+    want = solve_lanes(graphs, lanes=4, kernel="xla")
+    clear_solver_cache()
+    real = lanes_mod._compile_bucket
+
+    def boom(n_pad, m_pad, lanes, mode, kernel):
+        if kernel == "pallas":
+            raise RuntimeError("simulated mosaic lowering failure")
+        return real(n_pad, m_pad, lanes, mode, kernel)
+
+    monkeypatch.setattr(lanes_mod, "_compile_bucket", boom)
+    got = execute_stacked(stack_lanes(graphs, lanes=4), kernel="pallas")
+    for (ids_w, _, _), (ids_g, _, _) in zip(want, got):
+        np.testing.assert_array_equal(ids_g, ids_w)
+    assert bus.counters().get("kernel.fallback") == 1
+    assert pk.kernel_choice("pallas") == "xla"  # sticky for the process
+
+
+def test_warmup_compile_failure_falls_back_and_boots(bus, monkeypatch):
+    """A Pallas failure during the warmup phase must degrade the process
+    to XLA and keep warming — serve boot never dies on a kernel the
+    process won't run (the request-path contract, applied at boot)."""
+    import distributed_ghs_implementation_tpu.batch.warmup as warmup_mod
+    from distributed_ghs_implementation_tpu.batch.warmup import (
+        WarmupPlan,
+        bucket_of,
+        run_warmup,
+    )
+
+    clear_solver_cache()
+    real = warmup_mod.precompile_bucket
+
+    def boom(n_pad, m_pad, lanes, mode="fused", kernel=None):
+        if kernel == "pallas":
+            raise RuntimeError("simulated mosaic lowering failure")
+        return real(n_pad, m_pad, lanes, mode, kernel=kernel)
+
+    monkeypatch.setattr(warmup_mod, "precompile_bucket", boom)
+    plan = WarmupPlan(
+        buckets=(bucket_of(128, 480),), lanes=4, kernel="pallas",
+        warm_single=False,
+    )
+    report = run_warmup(plan)
+    assert report["kernel"] == "xla"  # repinned mid-phase
+    assert report["compiled"] == 1  # the bucket still warmed, on XLA
+    assert bus.counters().get("kernel.fallback") == 1
+    assert pk.kernel_choice("pallas") == "xla"  # sticky for serving too
+
+
+def test_sharded_lane_failure_falls_back_and_answers(bus, monkeypatch):
+    import distributed_ghs_implementation_tpu.parallel.lane as lane_mod
+
+    g = gnm_random_graph(9000, 36000, seed=6)
+    want, _, _ = solve_graph(g, kernel="xla")
+    real = lane_mod.make_rank_sharded_head
+
+    def boom(mesh, kernel="xla"):
+        if kernel == "pallas":
+            raise RuntimeError("simulated mosaic lowering failure")
+        return real(mesh, kernel)
+
+    monkeypatch.setattr(lane_mod, "make_rank_sharded_head", boom)
+    lane = lane_mod.ShardedLane(kernel="pallas")
+    assert lane.kernel == "pallas"
+    ids, _, _ = lane.solve(g)
+    np.testing.assert_array_equal(ids, want)
+    assert lane.kernel == "xla"  # repinned: later dispatches stay XLA
+    assert bus.counters().get("kernel.fallback") == 1
+
+
+def test_solve_graph_failure_falls_back_and_answers(bus, monkeypatch):
+    import distributed_ghs_implementation_tpu.models.boruvka as bz
+
+    g = gnm_random_graph(512, 2048, seed=9)
+    want, _, _ = solve_graph(g, strategy="fused", kernel="xla")
+    real = bz._solve_from_iota
+
+    def boom(*args, **kwargs):
+        if kwargs.get("kernel") == "pallas":
+            raise RuntimeError("simulated mosaic dispatch failure")
+        return real(*args, **kwargs)
+
+    monkeypatch.setattr(bz, "_solve_from_iota", boom)
+    got, _, _ = solve_graph(g, strategy="fused", kernel="pallas")
+    np.testing.assert_array_equal(got, want)
+    assert bus.counters().get("kernel.fallback") == 1
+    assert pk.kernel_choice() == "xla"

@@ -135,7 +135,10 @@ def test_precompiled_bucket_serves_first_query_without_compiling():
         assert np.array_equal(r.edge_ids, minimum_spanning_forest(g).edge_ids)
 
 
-def test_run_warmup_reports_compiled_vs_cached():
+def test_run_warmup_reports_compiled_vs_cached(monkeypatch):
+    # The report's "kernel" key resolves through kernel_choice: shield the
+    # exact-dict assertion below from an ambient GHS_KERNEL in the shell.
+    monkeypatch.delenv("GHS_KERNEL", raising=False)
     clear_solver_cache()
     plan = WarmupPlan(buckets=((64, 256),), lanes=4)
     first = run_warmup(plan)
@@ -146,7 +149,7 @@ def test_run_warmup_reports_compiled_vs_cached():
     assert run_warmup(WarmupPlan()) == {
         "buckets": 0, "compiled": 0, "cached": 0, "skipped": 0,
         "single_warmed": 0, "mesh_warmed": 0, "mesh_skipped": 0,
-        "stream_warmed": 0, "wall_s": 0.0,
+        "stream_warmed": 0, "kernel": "xla", "wall_s": 0.0,
     }
 
 

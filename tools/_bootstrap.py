@@ -1,0 +1,16 @@
+"""Shared ``sys.path`` bootstrap for ``tools/`` scripts.
+
+Every script here is run as a file (``python tools/<script>.py``), so the
+repo root is not importable until someone puts it on ``sys.path``. That
+someone used to be four copy-pasted ``sys.path.insert`` preambles; it is
+now this module — scripts just ``import _bootstrap`` (the script's own
+directory, ``tools/``, is ``sys.path[0]`` when run as a file, so the
+import always resolves).
+"""
+
+import os
+import sys
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _ROOT not in sys.path:
+    sys.path.insert(0, _ROOT)

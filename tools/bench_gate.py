@@ -47,7 +47,11 @@ import sys
 import time
 from typing import Dict, List, Tuple
 
-sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+try:
+    import _bootstrap  # noqa: F401 — repo-root sys.path setup
+except ImportError:  # loaded by file path (importlib in tests): tools/ is
+    # not sys.path[0] then, so inline the bootstrap's one job.
+    sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 SCHEMA = "ghs-bench-metrics-v1"
 DEFAULT_BASELINE = os.path.join(
@@ -107,6 +111,13 @@ KINDS = {
     # gate-stream-bench-v1 (bench.py --update-stream): the windowed-vs-
     # sequential ratio is a wall-clock pair — gate as a throughput floor.
     "window_speedup": "throughput",
+    # gate-kernel-v1 (tools/profile_levels.py --compare-kernels and
+    # bench.py --kernel): the fused-Pallas vs XLA level-kernel ratio is a
+    # wall-clock pair — gate as a throughput floor. On hosts where Pallas
+    # auto-falls-back (no TPU) the profiler pins it at exactly 1.0, so the
+    # gate passes on the XLA path — the fallback-routing contract
+    # (docs/KERNELS.md).
+    "level_kernel_speedup": "throughput",
 }
 
 
@@ -315,6 +326,11 @@ def main(argv=None) -> int:
             # ``gate-load-v1`` workload, obs.slo.gate_metrics): per-class
             # p99 ceilings, goodput floors, error/shed counts,
             # lost_accepted. Gate on those directly.
+            fresh = fresh.get("gate_metrics", {})
+        elif fresh.get("schema") == "ghs-level-profile-v1":
+            # A level-profile receipt (tools/profile_levels.py --json, the
+            # gate-kernel-v1 workload) embeds its gate metrics the same
+            # way: throughput + level_kernel_speedup + exact mst_weight.
             fresh = fresh.get("gate_metrics", {})
     else:
         fresh = run_gate_bench()

@@ -8,11 +8,7 @@ rank-endpoint lookups. Answers: where do the ~780 ms/level go?
 
 from __future__ import annotations
 
-import os as _os
-import sys as _sys
-
-_sys.path.insert(0, _os.path.dirname(_os.path.dirname(_os.path.abspath(__file__))))
-
+import _bootstrap  # noqa: F401 — repo-root sys.path setup
 
 import argparse
 import functools

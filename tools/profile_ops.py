@@ -3,11 +3,7 @@ segment_min, pointer_jump — the numbers the kernel design trades on."""
 
 from __future__ import annotations
 
-import os as _os
-import sys as _sys
-
-_sys.path.insert(0, _os.path.dirname(_os.path.dirname(_os.path.abspath(__file__))))
-
+import _bootstrap  # noqa: F401 — repo-root sys.path setup
 
 import time
 

@@ -48,7 +48,10 @@ import time
 
 import numpy as np
 
-sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+try:
+    import _bootstrap  # noqa: F401 — repo-root sys.path setup
+except ImportError:  # loaded by file path: tools/ is not sys.path[0] then
+    sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
 def _seed_graph(nodes: int, edges: int, seed: int):
@@ -163,14 +166,19 @@ def run_warmup_smoke(args) -> dict:
     g1 = _seed_graph(args.nodes, args.edges, args.seed)
     g2 = _seed_graph(args.nodes, args.edges, args.seed + 1)
     cache_dir = args.compile_cache_dir or "serve_compile_cache"
+    argv = [
+        sys.executable, "-m", "distributed_ghs_implementation_tpu",
+        "serve",
+        "--batch-lanes", "4",
+        "--warmup-buckets", f"{args.nodes}x{args.edges}",
+        "--compile-cache-dir", cache_dir,
+    ]
+    if args.kernel:
+        # Kernel-variant warmup coverage: the warmed buckets must be the
+        # variant the queries resolve (compile.miss == 0 either way).
+        argv += ["--kernel", args.kernel]
     proc = subprocess.Popen(
-        [
-            sys.executable, "-m", "distributed_ghs_implementation_tpu",
-            "serve",
-            "--batch-lanes", "4",
-            "--warmup-buckets", f"{args.nodes}x{args.edges}",
-            "--compile-cache-dir", cache_dir,
-        ],
+        argv,
         stdin=subprocess.PIPE,
         stdout=subprocess.PIPE,
         text=True,
@@ -237,6 +245,7 @@ def run_warmup_smoke(args) -> dict:
     slo_summary = _slo_section(acct, wall_s, stats)
     return {
         "mode": "warmup-smoke",
+        "kernel": args.kernel or "auto",
         "checks": [{"name": n, "ok": bool(ok)} for n, ok in checks],
         "slo": slo_summary,
         "events_dropped": slo_summary["events_dropped"],
@@ -509,6 +518,12 @@ def main(argv=None) -> int:
                    help="oversize deck shape for --sharded-smoke (node "
                    "bucket past the lane-admission ceiling)")
     p.add_argument("--oversize-edges", type=int, default=3_000)
+    p.add_argument(
+        "--kernel", choices=["auto", "pallas", "xla"], default=None,
+        help="pass this level-kernel variant to the serve child "
+        "(--warmup-smoke: asserts zero request-time compiles with the "
+        "variant's warmed buckets; docs/KERNELS.md)",
+    )
     p.add_argument("--compile-cache-dir",
                    help="persistent compile-cache dir for --warmup-smoke")
     p.add_argument("--chaos", action="store_true",
