@@ -409,6 +409,168 @@ def run_update_stream_bench(args) -> int:
     return 0
 
 
+def run_fleet_tcp_bench(args) -> int:
+    """Network-fleet transport metrics (``gate-fleet-tcp-v1``): router-hop
+    latency over TCP sockets vs the round-12 subprocess pipes, plus the
+    cross-host cache-miss forwarding counters, on jax-free echo workers.
+
+    * **router_hop_{tcp,pipe}_{p50,p95}_s** — send-to-response wall time
+      minus the worker's own service time, per request: the transport +
+      framing + queueing overhead a ``--transport`` choice actually moves
+      (workers answer canned content, so nothing solver-shaped pollutes
+      the clock). Both sequential round trips and a concurrent burst feed
+      the histogram — the burst is where TCP's coalesced pipelined writes
+      earn their keep.
+    * **forward_hit / forward_miss** — EXACT: a deterministic forwarding
+      scenario (lane-steered oversize digests whose full-ring owner is a
+      different worker) drives exactly ``--fleet-forward`` probes down
+      each path. A changed count means the forwarding decision logic
+      changed, never jitter.
+
+    Echo workers make this bench CI-cheap (~seconds, no jax import) while
+    exercising the real router, real sockets, real framing, and the real
+    forwarding machinery end to end.
+    """
+    from concurrent.futures import ThreadPoolExecutor
+
+    from distributed_ghs_implementation_tpu.fleet.hashing import HashRing
+    from distributed_ghs_implementation_tpu.fleet.router import (
+        FleetConfig,
+        FleetRouter,
+    )
+    from distributed_ghs_implementation_tpu.obs.events import BUS
+
+    BUS.enable()
+    workers = 3
+    n_seq = args.fleet_requests
+    n_burst = args.fleet_requests
+    hops = {}
+    for transport in ("pipe", "tcp"):
+        BUS.clear()
+        cfg = FleetConfig(
+            workers=workers, test_echo=True, transport=transport,
+            heartbeat_interval_s=0.25, ready_timeout_s=120.0,
+            request_timeout_s=60.0,
+        )
+        with FleetRouter(cfg) as router:
+            for i in range(16):  # warm: interpreter paths, first frames
+                router.handle({"op": "solve", "digest": f"warm-{i}"})
+            BUS.clear()
+            for i in range(n_seq):
+                resp = router.handle({"op": "solve", "digest": f"seq-{i}"})
+                if not resp.get("ok"):
+                    print(f"FLEET BENCH FAILED: {resp}", file=sys.stderr)
+                    return 1
+            # Concurrent burst: many requests in flight at once — the
+            # regime where per-frame syscalls (pipe) vs coalesced writes
+            # (tcp) diverge.
+            with ThreadPoolExecutor(max_workers=8) as pool:
+                burst = list(pool.map(
+                    lambda i: router.handle(
+                        {"op": "solve", "digest": f"burst-{i}"}
+                    ),
+                    range(n_burst),
+                ))
+            if not all(r.get("ok") for r in burst):
+                print("FLEET BENCH FAILED: burst errors", file=sys.stderr)
+                return 1
+            hist = BUS.histograms().get("fleet.hop_s", {})
+            if not hist.get("count"):
+                print("FLEET BENCH FAILED: no hop samples", file=sys.stderr)
+                return 1
+            hops[transport] = hist
+
+    # Forwarding scenario (deterministic): a 3-worker TCP fleet where
+    # worker 0 owns the oversize lane subring and forwarding is ON (no
+    # shared disk — the cross-host topology). Hits: a digest solved at its
+    # full-ring owner, then re-requested oversize — the lane steers the
+    # dispatch at worker 0, the router probes the owner-of-record first,
+    # and the cached result comes back without a local solve. Misses: a
+    # fresh oversize digest — the probe at the (never-asked) full-ring
+    # owner misses and worker 0 solves locally. Digests are pre-screened
+    # so every full-ring owner differs from worker 0; counters then gate
+    # EXACTLY.
+    BUS.clear()
+    ring = HashRing(range(workers), replicas=64)
+    k = args.fleet_forward
+    hit_digests, miss_digests, i = [], [], 0
+    while len(hit_digests) < k or len(miss_digests) < k:
+        d = f"fwd-{i}"
+        i += 1
+        if ring.assign(d) == 0:
+            continue
+        if len(hit_digests) < k:
+            hit_digests.append(d)
+        else:
+            miss_digests.append(d)
+    oversize = {"num_nodes": 200_000, "edges": [[0, 1, 1]]}
+    cfg = FleetConfig(
+        workers=workers, test_echo=True, transport="tcp",
+        sharded_lane_workers=1, forward_cache=True,
+        heartbeat_interval_s=0.25, ready_timeout_s=120.0,
+        request_timeout_s=60.0,
+    )
+    with FleetRouter(cfg) as router:
+        for d in hit_digests:
+            owner = router.handle({"op": "solve", "digest": d})
+            fwd = router.handle({"op": "solve", "digest": d, **oversize})
+            if not (fwd.get("ok") and fwd.get("cached")
+                    and fwd.get("forwarded_from") == owner["worker"]):
+                print(f"FORWARD HIT FAILED: {fwd}", file=sys.stderr)
+                return 1
+        for d in miss_digests:
+            local = router.handle({"op": "solve", "digest": d, **oversize})
+            if not (local.get("ok") and local.get("worker") == 0):
+                print(f"FORWARD MISS FAILED: {local}", file=sys.stderr)
+                return 1
+    counters = BUS.counters()
+    forward_hit = int(counters.get("fleet.forward.hit", 0))
+    forward_miss = int(counters.get("fleet.forward.miss", 0))
+    if forward_hit != k or forward_miss != k:
+        print(
+            f"FORWARD COUNTERS WRONG: hit {forward_hit} miss {forward_miss}"
+            f" (expected {k}/{k})",
+            file=sys.stderr,
+        )
+        return 1
+
+    out = {
+        "metric": f"fleet router hop, {workers} echo workers, "
+        f"{n_seq} sequential + {n_burst} burst requests",
+        "value": round(hops["tcp"]["p50"] * 1e3, 3),
+        "unit": "ms (tcp hop p50)",
+        "router_hop_tcp_p50_s": round(hops["tcp"]["p50"], 6),
+        "router_hop_tcp_p95_s": round(hops["tcp"]["p95"], 6),
+        "router_hop_pipe_p50_s": round(hops["pipe"]["p50"], 6),
+        "router_hop_pipe_p95_s": round(hops["pipe"]["p95"], 6),
+        "forward_hit": forward_hit,
+        "forward_miss": forward_miss,
+    }
+    print(json.dumps(out))
+    if args.metrics_out:
+        metrics = {
+            "router_hop_tcp_p50_s": hops["tcp"]["p50"],
+            "router_hop_tcp_p95_s": hops["tcp"]["p95"],
+            "router_hop_pipe_p50_s": hops["pipe"]["p50"],
+            "router_hop_pipe_p95_s": hops["pipe"]["p95"],
+            "forward_hit": forward_hit,
+            "forward_miss": forward_miss,
+            "fleet_requests": 2 * (n_seq + n_burst + 16),
+        }
+        with open(args.metrics_out, "w") as f:
+            json.dump(
+                {
+                    "schema": "ghs-bench-metrics-v1",
+                    "config": {"workload": "gate-fleet-tcp-v1"},
+                    "metrics": metrics,
+                },
+                f,
+                indent=2,
+            )
+            f.write("\n")
+    return 0
+
+
 def run_sharded_bench(args) -> int:
     """Oversize-lane serving metrics: cold staging vs warm device-resident
     re-solve on the mesh (``parallel/lane.py``), plus the donated-buffer
@@ -597,6 +759,19 @@ def main(argv=None) -> int:
                    help="oversize workload nodes for --sharded-lane")
     p.add_argument("--sharded-edges", type=int, default=140_000)
     p.add_argument(
+        "--fleet-tcp", action="store_true",
+        help="measure network-fleet transport overhead instead of the RMAT "
+        "bench: router-hop p50/p95 over TCP sockets vs subprocess pipes on "
+        "echo workers, plus EXACT cache-miss forwarding counters "
+        "(gate-fleet-tcp-v1, docs/FLEET.md); jax-free and CI-cheap",
+    )
+    p.add_argument("--fleet-requests", type=int, default=200,
+                   help="round trips per transport in --fleet-tcp (each "
+                   "runs once sequentially and once in a concurrent burst)")
+    p.add_argument("--fleet-forward", type=int, default=6,
+                   help="forwarding hits AND misses driven in --fleet-tcp "
+                   "(fleet.forward.hit/miss then gate exactly)")
+    p.add_argument(
         "--update-stream", action="store_true",
         help="measure streaming MSF maintenance: windowed batched apply "
         "(stream/window.py) vs the sequential per-update path, edge-exact "
@@ -625,6 +800,8 @@ def main(argv=None) -> int:
         )
 
         set_default_kernel(args.kernel)
+    if args.fleet_tcp:
+        return run_fleet_tcp_bench(args)
     if args.update_stream:
         return run_update_stream_bench(args)
     if args.sharded_lane:

@@ -359,7 +359,7 @@ def _cmd_serve(args) -> int:
         set_default_kernel(args.kernel)
         os.environ["GHS_KERNEL"] = args.kernel
 
-    if args.fleet:
+    if args.fleet or args.fleet_workers:
         from distributed_ghs_implementation_tpu.fleet.router import (
             FleetConfig,
             FleetRouter,
@@ -371,8 +371,22 @@ def _cmd_serve(args) -> int:
                 "see; record from a single-process serve, then replay "
                 "with --fleet --warmup-replay"
             )
+        remote = tuple(
+            a for a in (args.fleet_workers or "").split(",") if a
+        )
+        if remote and args.fleet and args.fleet != len(remote):
+            raise SystemExit(
+                f"--fleet {args.fleet} contradicts --fleet-workers "
+                f"({len(remote)} endpoints); drop --fleet or make them match"
+            )
         config = FleetConfig(
-            workers=args.fleet,
+            workers=len(remote) or args.fleet,
+            transport="tcp" if remote else args.fleet_transport,
+            remote_workers=remote,
+            forward_cache={"auto": None, "on": True, "off": False}[
+                args.fleet_forward_cache
+            ],
+            lease_s=args.fleet_lease,
             backend=args.backend,
             batch_lanes=args.batch_lanes,
             store_capacity=args.cache_entries,
@@ -399,8 +413,10 @@ def _cmd_serve(args) -> int:
         # compiles, so none of that happens in this process.
         with FleetRouter(config) as router:
             print(
-                f"fleet: {args.fleet} workers ready "
-                f"(queue_depth={config.queue_depth})",
+                f"fleet: {config.workers} workers ready over "
+                f"{config.transport} (queue_depth={config.queue_depth}"
+                + (", forward_cache on" if config.forward_enabled else "")
+                + ")",
                 file=sys.stderr,
             )
             if args.input:
@@ -732,6 +748,37 @@ def build_parser() -> argparse.ArgumentParser:
         "--fleet-obs-dir",
         help="with --fleet: each worker exports its obs event JSONL here "
         "on drain (worker<K>.<incarnation>.jsonl)",
+    )
+    srv.add_argument(
+        "--fleet-transport", choices=("pipe", "tcp"), default="pipe",
+        help="with --fleet: the router<->worker channel — subprocess "
+        "pipes (single host) or TCP sockets with coalesced pipelined "
+        "frame writes (fleet/transport.py; spawned workers dial into the "
+        "router's listener with a tokened hello; docs/FLEET.md "
+        "\"Network transport\")",
+    )
+    srv.add_argument(
+        "--fleet-workers", metavar="HOST:PORT,...",
+        help="serve through externally started workers (`python -m "
+        "distributed_ghs_implementation_tpu.fleet.worker --listen PORT` — "
+        "on other machines or pod slices, launcher/tpu_pod_worker.sh) "
+        "instead of spawning local processes; implies --fleet-transport "
+        "tcp, worker count = the list length",
+    )
+    srv.add_argument(
+        "--fleet-forward-cache", choices=("auto", "on", "off"),
+        default="auto",
+        help="cross-host cache-miss forwarding: probe the digest-owner "
+        "worker with a cached_only frame before solving locally "
+        "(fleet.forward.hit/miss). auto = on for TCP fleets without a "
+        "shared --disk-cache, off elsewhere",
+    )
+    srv.add_argument(
+        "--fleet-lease", type=float, default=None, metavar="SECONDS",
+        help="with --fleet: worker silence window before a connected but "
+        "unresponsive worker is declared dead (default: heartbeat "
+        "interval x miss threshold = 5s); tune UP on congested WANs, "
+        "DOWN for faster failover on a quiet LAN",
     )
     srv.set_defaults(fn=_cmd_serve)
 

@@ -2,10 +2,15 @@
 
 The single-process serving stack (``serve/`` + ``batch/``) caps out at one
 Python process and loses every in-flight query when it crashes. ``fleet/``
-lifts it horizontal: N worker subprocesses (``fleet/worker.py``), each a
+lifts it horizontal: N worker processes (``fleet/worker.py``), each a
 full :class:`serve.service.MSTService`, behind a consistent-hash router
 (``fleet/router.py``) with health-checked failover, re-queue of accepted
 requests, restart-with-backoff, admission control, and graceful drain.
+Workers speak length-prefixed frames (``fleet/framing.py``) over either
+subprocess pipes or TCP sockets (``fleet/transport.py`` — coalesced
+pipelined writes, dial-in hello registration, host:port addressing), so
+the fleet is no longer bound to one machine; cross-host cache misses
+forward to the digest-owner worker before solving locally.
 ``docs/FLEET.md`` covers topology, failure modes, and drill recipes.
 """
 
@@ -14,5 +19,25 @@ from distributed_ghs_implementation_tpu.fleet.router import (
     FleetConfig,
     FleetRouter,
 )
+from distributed_ghs_implementation_tpu.fleet.transport import (
+    PROTO_VERSION,
+    HelloError,
+    PipeTransport,
+    SocketTransport,
+    Transport,
+    build_hello,
+    check_hello,
+)
 
-__all__ = ["FleetConfig", "FleetRouter", "HashRing"]
+__all__ = [
+    "FleetConfig",
+    "FleetRouter",
+    "HashRing",
+    "PROTO_VERSION",
+    "HelloError",
+    "PipeTransport",
+    "SocketTransport",
+    "Transport",
+    "build_hello",
+    "check_hello",
+]

@@ -1,7 +1,7 @@
-"""Length-prefixed JSON framing for the router <-> worker pipes.
+"""Length-prefixed JSON framing for the router <-> worker channels.
 
 The single-process service speaks newline-delimited JSON (one request per
-line, ``serve/service.py``); the fleet cannot: a worker's stdout carries
+line, ``serve/service.py``); the fleet cannot: a worker's channel carries
 *interleaved* responses written by concurrent request threads, and a torn
 line would silently merge two frames. Each frame is therefore::
 
@@ -9,14 +9,23 @@ line would silently merge two frames. Each frame is therefore::
 
 — the reader knows exactly how many bytes belong to the frame before it
 parses a single one, a short read is detected (not mis-parsed), and the
-trailing newline keeps frames greppable in a captured pipe dump.
+trailing newline keeps frames greppable in a captured channel dump. The
+same framing runs over OS pipes (the single-host fleet) and TCP sockets
+(``fleet/transport.py``) — a frame is a frame on either medium.
 
-Framing errors are indistinguishable from a dead peer by design:
-:func:`read_frame` returns ``None`` on EOF *and* on a torn frame, because
-both mean the same thing to the router — this worker's pipe can no longer
-be trusted, fail over. Writes must be serialized by the caller (the router
-holds a per-worker lock; the worker holds one stdout lock across its
-request threads).
+Error surface: :func:`read_frame` returns ``None`` only on a *clean* EOF
+at a frame boundary (the peer closed in between frames — drain, or death)
+and raises :class:`FrameError` on everything garbled: a non-numeric or
+over-long length prefix, a length past ``max_bytes`` (a corrupt prefix
+must not become a multi-gigabyte allocation — the reader sizes its buffer
+from attacker/garbage-controlled bytes), a payload the stream could not
+complete, or bytes that are not one JSON object. ``FrameError`` subclasses
+``ValueError``, so callers that treated every framing problem as
+peer-death (the router's reader catches ``(OSError, ValueError)``) keep
+doing so unchanged — the typed error exists for callers that want to
+*distinguish* a corrupt peer from a closed one (tests, the drills, the
+dial-in hello validation). Writes must be serialized by the caller (the
+transports hold a per-connection write lock).
 """
 
 from __future__ import annotations
@@ -25,34 +34,69 @@ import json
 from typing import IO, Optional
 
 #: A frame larger than this is a protocol violation (a runaway edges_out
-#: response, or garbage on the pipe) — refuse to buffer it.
+#: response, or garbage on the channel) — refuse to buffer it. Callers with
+#: tighter expectations (the hello exchange is a few hundred bytes) pass
+#: their own ``max_bytes``.
 MAX_FRAME_BYTES = 256 * 1024 * 1024
+
+#: The length prefix of MAX_FRAME_BYTES is 9 digits + newline; anything
+#: longer is garbage, and an unbounded ``readline`` on a corrupt stream
+#: would buffer until memory runs out.
+_MAX_HEADER_BYTES = 20
+
+
+class FrameError(ValueError):
+    """A garbled frame: corrupt length prefix, oversize declaration,
+    truncated payload, or non-JSON bytes. The channel can no longer be
+    trusted to be frame-aligned — the only safe response is to drop it."""
+
+
+def encode_frame(obj: dict) -> bytes:
+    """``obj`` as one wire-ready frame (length prefix + payload + LF)."""
+    payload = json.dumps(obj, separators=(",", ":")).encode("utf-8")
+    return b"%d\n" % len(payload) + payload + b"\n"
 
 
 def write_frame(stream: IO[bytes], obj: dict) -> None:
     """Serialize ``obj`` as one length-prefixed frame and flush."""
-    payload = json.dumps(obj, separators=(",", ":")).encode("utf-8")
-    stream.write(b"%d\n" % len(payload) + payload + b"\n")
+    stream.write(encode_frame(obj))
     stream.flush()
 
 
-def read_frame(stream: IO[bytes]) -> Optional[dict]:
-    """Read one frame; ``None`` on EOF or any torn/garbled frame."""
-    header = stream.readline()
+def read_frame(
+    stream: IO[bytes], *, max_bytes: int = MAX_FRAME_BYTES
+) -> Optional[dict]:
+    """Read one frame; ``None`` on clean EOF, :class:`FrameError` on
+    anything garbled (see module docstring for the contract)."""
+    header = stream.readline(_MAX_HEADER_BYTES)
     if not header:
         return None
+    if not header.endswith(b"\n"):
+        raise FrameError(
+            f"frame header not newline-terminated within "
+            f"{_MAX_HEADER_BYTES} bytes: {header[:32]!r}"
+        )
     try:
         n = int(header)
     except ValueError:
-        return None
-    if n < 0 or n > MAX_FRAME_BYTES:
-        return None
+        raise FrameError(f"non-numeric frame length prefix: {header!r}") from None
+    if n < 0 or n > max_bytes:
+        raise FrameError(
+            f"declared frame length {n} outside [0, {max_bytes}]"
+        )
     payload = stream.read(n)
     if payload is None or len(payload) != n:
-        return None
+        raise FrameError(
+            f"truncated frame: header promised {n} bytes, "
+            f"got {0 if payload is None else len(payload)}"
+        )
     stream.read(1)  # the trailing newline (EOF here still parsed a frame)
     try:
         obj = json.loads(payload)
     except ValueError:
-        return None
-    return obj if isinstance(obj, dict) else None
+        raise FrameError(
+            f"frame payload is not valid JSON ({n} bytes)"
+        ) from None
+    if not isinstance(obj, dict):
+        raise FrameError(f"frame payload is {type(obj).__name__}, not object")
+    return obj

@@ -1,9 +1,10 @@
 """Fleet front-end: digest-routed dispatch over N worker processes.
 
 The router is the serving tier's availability layer. It owns no solver —
-every query is forwarded over a framed pipe to one of N worker processes
-(``fleet/worker.py``), each a full single-process serving stack. What the
-router adds is exactly what one process cannot have:
+every query is forwarded over a framed channel (``fleet/transport.py``:
+subprocess pipes on one host, TCP sockets across hosts) to one of N worker
+processes (``fleet/worker.py``), each a full single-process serving stack.
+What the router adds is exactly what one process cannot have:
 
 * **Cache-affine routing** — ``Graph.digest()`` consistent-hashes onto the
   ring (``fleet/hashing.py``), so repeats of a graph land on the worker
@@ -12,18 +13,26 @@ router adds is exactly what one process cannot have:
   Updates re-key content-addressed, so the router pins each *session
   digest* to the worker holding the materialized session and follows the
   chain as responses rename it.
+* **Cache-miss forwarding** — across hosts there is no shared disk store,
+  so whenever routing must deviate from the worker that last served a
+  digest (lane steering, failover, ring rejoin), the router first asks the
+  digest's owner-of-record with a tiny ``cached_only`` probe and only
+  lets the dispatch target solve locally on a miss (``fleet.forward.hit``
+  / ``fleet.forward.miss``) — consistent-hash affinity keeps paying off
+  even where ``disk_dir`` cannot follow.
 * **Admission control** — per-worker bounded in-flight queues
   (``queue_depth``). A full queue sheds requests whose ``slo_class`` is in
   ``shed_classes`` (``{"ok": false, "shed": true}``, counted
   ``fleet.shed``); every other class blocks — backpressure, not loss.
 * **Health-checked failover** — a heartbeat thread pings every worker; a
-  worker that misses ``heartbeat_miss_threshold`` intervals, or whose pipe
-  reaches EOF, is declared dead. Its accepted-but-unanswered requests are
-  **re-queued** onto surviving workers by the same digest key
-  (``fleet.requeue``) — idempotent, because results are content-addressed
-  and every worker computes the identical forest. The dead worker restarts
-  with capped exponential backoff and rejoins the ring when it reports
-  ready.
+  worker silent past its **lease** (``lease_s``, default
+  ``heartbeat_interval_s * heartbeat_miss_threshold``), or whose channel
+  reaches EOF (pipe closed, TCP connection lost), is declared dead. Its
+  accepted-but-unanswered requests are **re-queued** onto surviving
+  workers by the same digest key (``fleet.requeue``) — idempotent, because
+  results are content-addressed and every worker computes the identical
+  forest. The dead worker restarts (spawned) or is re-dialed (remote) with
+  capped exponential backoff and rejoins the ring when it says hello.
 * **Graceful drain** — :meth:`FleetRouter.shutdown` stops admitting, sends
   every worker a drain frame, and waits for in-flight responses to flush
   before the processes exit 0.
@@ -32,11 +41,16 @@ Telemetry (router-process bus): ``fleet.request`` spans carry ``cls`` /
 ``worker`` / ``ok`` — ``obs.slo`` joins them into per-class AND per-worker
 SLO breakdowns — plus ``fleet.dispatch`` / ``fleet.requeue`` /
 ``fleet.shed`` / ``fleet.worker.dead`` / ``fleet.worker.restart`` /
-``fleet.heartbeat.miss`` counters. See ``docs/FLEET.md``.
+``fleet.heartbeat.miss`` / ``fleet.lease.expired`` /
+``fleet.forward.hit`` / ``fleet.forward.miss`` counters and the
+``fleet.hop_s[.<cls>]`` histograms (send-to-response minus in-worker
+service time — the transport + queueing overhead a ``--transport`` choice
+actually changes). See ``docs/FLEET.md``.
 """
 
 from __future__ import annotations
 
+import collections
 import dataclasses
 import os
 import subprocess
@@ -45,15 +59,26 @@ import threading
 import time
 from typing import Dict, List, Optional, Tuple
 
-from distributed_ghs_implementation_tpu.fleet.framing import (
-    read_frame,
-    write_frame,
-)
 from distributed_ghs_implementation_tpu.fleet.hashing import HashRing
+from distributed_ghs_implementation_tpu.fleet.transport import (
+    HelloError,
+    PipeTransport,
+    Transport,
+    WorkerListener,
+    check_hello,
+    connect_to_worker,
+    new_conn_token,
+)
 from distributed_ghs_implementation_tpu.obs.events import BUS
 from distributed_ghs_implementation_tpu.obs.slo import sanitize_class
 
 _SESSION_MAP_CAP = 4096  # digest -> worker pins retained (LRU)
+_FORWARD_MAP_CAP = 4096  # digest -> last-serving worker (LRU)
+# The forwarding probe is an OPTIMIZATION riding ahead of a correct local
+# solve: on a busy owner it must give up fast (miss and move on), never
+# queue behind slow solves for the full control-plane timeout.
+_FORWARD_PROBE_TIMEOUT_S = 2.0
+_FORWARD_PROBE_SLOT_TIMEOUT_S = 0.25
 
 
 @dataclasses.dataclass(frozen=True)
@@ -90,9 +115,31 @@ class FleetConfig:
     # (stream/log.py, docs/STREAMING.md).
     stream_dir: Optional[str] = None
     stream_snapshot_every: int = 8
-    # A dead process is caught instantly by pipe EOF; heartbeats exist for
-    # WEDGED processes, so the threshold errs generous — a false-positive
-    # kill under load-spike GIL starvation costs more than slow detection.
+    # -- transport (round 16, docs/FLEET.md "Network transport") -------
+    # "pipe": subprocess stdin/stdout (single host). "tcp": length-prefixed
+    # frames over sockets with coalesced pipelined writes — spawned workers
+    # dial into the router's listener with a tokened hello; with
+    # remote_workers set, the router instead dials each listed
+    # host:port (externally started `fleet.worker --listen` processes,
+    # possibly on other machines / pod slices).
+    transport: str = "pipe"
+    listen_host: str = "127.0.0.1"
+    remote_workers: Tuple[str, ...] = ()
+    # Cross-host cache-miss forwarding: before a solve lands on a worker
+    # that is NOT the digest's owner-of-record, probe the owner with a
+    # cached_only frame and return its cached result on a hit. None = auto:
+    # on for TCP fleets without a shared disk store (the topology where a
+    # deviating dispatch would otherwise re-solve), off elsewhere.
+    forward_cache: Optional[bool] = None
+    # Worker lease: silence (no pong, no frames) longer than this declares
+    # the worker dead even while its connection stays open. None derives
+    # heartbeat_interval_s * heartbeat_miss_threshold. A dead process is
+    # caught instantly by channel EOF; the lease exists for WEDGED
+    # processes and half-dead network paths, so the default errs generous —
+    # a false-positive kill under load-spike GIL starvation costs more
+    # than slow detection.
+    lease_s: Optional[float] = None
+    pipelined_io: bool = True  # coalesce TCP frame writes (transport.py)
     heartbeat_interval_s: float = 0.25
     heartbeat_miss_threshold: int = 20
     restart_backoff_base_s: float = 0.05
@@ -104,6 +151,18 @@ class FleetConfig:
     obs_dir: Optional[str] = None  # per-worker JSONL exports on drain
     test_echo: bool = False  # spawn jax-free echo workers (tests)
     worker_env: Optional[Dict[int, Dict[str, str]]] = None  # incarnation 0 only
+
+    @property
+    def effective_lease_s(self) -> float:
+        if self.lease_s is not None:
+            return self.lease_s
+        return self.heartbeat_interval_s * self.heartbeat_miss_threshold
+
+    @property
+    def forward_enabled(self) -> bool:
+        if self.forward_cache is not None:
+            return self.forward_cache
+        return self.transport == "tcp" and not self.disk_dir
 
 
 #: Default admission-ceiling BUCKETS mirrored from ``batch.policy
@@ -135,7 +194,7 @@ class _Pending:
     """One accepted request: survives its worker by being re-dispatched."""
 
     __slots__ = ("request", "key", "cls", "event", "response", "worker_id",
-                 "requeues", "lane")
+                 "requeues", "lane", "sent_at")
 
     def __init__(
         self,
@@ -152,15 +211,21 @@ class _Pending:
         self.worker_id: Optional[int] = None
         self.requeues = 0
         self.lane = lane  # prefers a mesh-owning worker (oversize solve)
+        self.sent_at: Optional[float] = None  # hop-latency clock start
 
 
 class _Worker:
-    """One worker slot: a stable ring identity across process incarnations."""
+    """One worker slot: a stable ring identity across process incarnations
+    (spawned) or connections (remote)."""
 
-    def __init__(self, worker_id: int, queue_depth: int):
+    def __init__(self, worker_id: int, queue_depth: int,
+                 addr: Optional[str] = None):
         self.id = worker_id
-        self.lock = threading.Lock()  # pipe writes + pending map
+        self.lock = threading.Lock()  # channel writes + pending map
         self.proc: Optional[subprocess.Popen] = None
+        self.transport: Optional[Transport] = None
+        self.addr = addr  # remote endpoint (None for spawned workers)
+        self.conn_token: Optional[str] = None  # per-incarnation dial-in auth
         self.alive = False
         self.ready = threading.Event()
         self.incarnation = -1
@@ -168,11 +233,12 @@ class _Worker:
         self.slots = threading.BoundedSemaphore(queue_depth)
         self.last_pong = 0.0
         self.restarts = 0
-        self.lane_advertised = False  # capability from the ready frame
+        self.caps: Dict[str, object] = {}  # from the hello frame
+        self.lane_advertised = False  # caps["lane"]
 
 
 class FleetRouter:
-    """Digest-routed, health-checked front end over worker subprocesses.
+    """Digest-routed, health-checked front end over worker processes.
 
     :meth:`handle` is request/response-compatible with
     :class:`serve.service.MSTService.handle`, so ``serve_loop``, the load
@@ -181,45 +247,75 @@ class FleetRouter:
 
     def __init__(self, config: Optional[FleetConfig] = None):
         self.config = config or FleetConfig()
-        if self.config.workers < 1:
+        if self.config.transport not in ("pipe", "tcp"):
             raise ValueError(
-                f"workers must be >= 1, got {self.config.workers}"
+                f"transport must be 'pipe' or 'tcp', got "
+                f"{self.config.transport!r}"
             )
+        if self.config.remote_workers and self.config.transport != "tcp":
+            raise ValueError("remote_workers requires transport='tcp'")
+        n = len(self.config.remote_workers) or self.config.workers
+        if n < 1:
+            raise ValueError(f"workers must be >= 1, got {n}")
         self._workers = [
-            _Worker(i, self.config.queue_depth)
-            for i in range(self.config.workers)
+            _Worker(
+                i, self.config.queue_depth,
+                addr=(self.config.remote_workers[i]
+                      if self.config.remote_workers else None),
+            )
+            for i in range(n)
         ]
         self._ring = HashRing(replicas=self.config.ring_replicas)
         # Mesh-owning worker slots (config-derived — stable across
         # incarnations): oversize solves hash onto this subring.
         k = self.config.sharded_lane_workers
-        self._lane_ids = set(
-            range(self.config.workers if k == -1 else max(0, min(k, self.config.workers)))
-        )
+        self._lane_ids = set(range(n if k == -1 else max(0, min(k, n))))
         self._lane_ring = HashRing(replicas=self.config.ring_replicas)
         self._ring_lock = threading.Lock()
         self._sessions: Dict[str, int] = {}  # update-session digest -> worker
+        # digest -> worker that LAST answered it ok (the forwarding hop's
+        # owner-of-record; survives ring changes that move ownership).
+        self._last_served: "collections.OrderedDict[str, int]" = (
+            collections.OrderedDict()
+        )
         self._next_id = 0
         self._id_lock = threading.Lock()
         self._rr = 0  # round-robin cursor for keyless ops
         self._closed = False
         self._started = False
         self._heartbeat: Optional[threading.Thread] = None
+        self._listener: Optional[WorkerListener] = None
+        self._hello_rejections: List[str] = []  # surfaced on ready timeout
 
     # -- lifecycle -----------------------------------------------------
     def start(self) -> "FleetRouter":
         if self._started:
             return self
         self._started = True
+        if self.config.transport == "tcp" and not self.config.remote_workers:
+            self._listener = WorkerListener(
+                self._on_dial_in,
+                host=self.config.listen_host,
+                on_reject=self._on_hello_reject,
+                pipelined=self.config.pipelined_io,
+            )
         for w in self._workers:
-            self._spawn(w)
+            if w.addr is not None:
+                threading.Thread(
+                    target=self._connect_remote, args=(w,),
+                    name=f"fleet-dial-{w.id}", daemon=True,
+                ).start()
+            else:
+                self._spawn(w)
         deadline = time.monotonic() + self.config.ready_timeout_s
         for w in self._workers:
             if not w.ready.wait(max(0.0, deadline - time.monotonic())):
+                rejections = "; ".join(self._hello_rejections[-3:])
                 self.shutdown(drain=False)
                 raise TimeoutError(
                     f"worker {w.id} not ready within "
                     f"{self.config.ready_timeout_s}s"
+                    + (f" (hello rejected: {rejections})" if rejections else "")
                 )
         now = time.monotonic()
         with self._ring_lock:
@@ -246,34 +342,47 @@ class FleetRouter:
         """Stop admitting, drain every worker, reap the processes.
 
         ``drain=True`` sends the drain frame and waits: in-flight requests
-        finish and flush before the workers exit 0. ``drain=False`` kills.
+        finish and flush before the workers exit 0 (remote workers exit
+        too — shutdown drains the whole fleet it was configured with).
+        ``drain=False`` kills.
         """
         self._closed = True
         if self._heartbeat is not None:
             self._heartbeat.join(timeout=2.0)
         for w in self._workers:
             with w.lock:
+                transport = w.transport
                 proc = w.proc
-                if proc is None or proc.poll() is not None:
-                    continue
-                if drain:
+                if drain and transport is not None and not transport.closed:
                     try:
-                        write_frame(proc.stdin, {"drain": True})
-                        proc.stdin.close()
+                        transport.send({"drain": True})
                     except OSError:
                         pass
-                else:
+                elif not drain and proc is not None and proc.poll() is None:
                     proc.kill()
         deadline = time.monotonic() + timeout_s
         for w in self._workers:
             proc = w.proc
             if proc is None:
+                # Remote worker: wait for its reader to see the post-drain
+                # close (bye + EOF), bounded by the shutdown deadline.
+                if drain and w.transport is not None:
+                    t_deadline = max(0.1, deadline - time.monotonic())
+                    t_end = time.monotonic() + t_deadline
+                    while (time.monotonic() < t_end
+                           and not w.transport.closed):
+                        time.sleep(0.02)
                 continue
             try:
                 proc.wait(timeout=max(0.1, deadline - time.monotonic()))
             except subprocess.TimeoutExpired:
                 proc.kill()
                 proc.wait(timeout=5.0)
+        for w in self._workers:
+            if w.transport is not None:
+                w.transport.close()
+        if self._listener is not None:
+            self._listener.close()
 
     # -- spawning ------------------------------------------------------
     def _worker_argv(self, w: _Worker) -> List[str]:
@@ -289,6 +398,9 @@ class FleetRouter:
             "--max-sessions", str(cfg.max_sessions),
             "--threads", str(cfg.worker_threads),
         ]
+        if self._listener is not None:
+            argv += ["--connect", self._listener.address,
+                     "--conn-token", w.conn_token]
         if cfg.batch_wait_s is not None:
             argv += ["--batch-wait", str(cfg.batch_wait_s)]
         if cfg.disk_dir:
@@ -316,7 +428,7 @@ class FleetRouter:
         if cfg.obs_dir:
             os.makedirs(cfg.obs_dir, exist_ok=True)
             argv += ["--obs-jsonl", os.path.join(
-                cfg.obs_dir, f"worker{w.id}.{w.incarnation + 1}.jsonl"
+                cfg.obs_dir, f"worker{w.id}.{w.incarnation}.jsonl"
             )]
         if cfg.test_echo:
             argv += ["--test-echo"]
@@ -335,35 +447,134 @@ class FleetRouter:
             # Incarnation 0 only: a crash-fault env inherited by restarts
             # would kill every incarnation and the fleet could never heal.
             env.update(extra)
-        argv = self._worker_argv(w)
+        tcp = self._listener is not None
         with w.lock:
             w.incarnation += 1
             incarnation = w.incarnation
+            # A fresh token per incarnation: a limping previous incarnation
+            # (or a stranger on the port) cannot register into this slot.
+            w.conn_token = new_conn_token() if tcp else None
             w.ready.clear()
             w.slots = threading.BoundedSemaphore(self.config.queue_depth)
-            w.proc = subprocess.Popen(
-                argv, stdin=subprocess.PIPE, stdout=subprocess.PIPE, env=env
-            )
+            argv = self._worker_argv(w)
+            if tcp:
+                # The framed channel is the socket the worker dials back;
+                # stdin/stdout stay free (stderr inherits for logs).
+                w.transport = None
+                w.proc = subprocess.Popen(
+                    argv, stdin=subprocess.DEVNULL, env=env
+                )
+            else:
+                w.proc = subprocess.Popen(
+                    argv, stdin=subprocess.PIPE, stdout=subprocess.PIPE,
+                    env=env,
+                )
+                w.transport = PipeTransport(w.proc.stdin, w.proc.stdout)
+        if not tcp:
+            threading.Thread(
+                target=self._reader,
+                args=(w, incarnation, w.transport),
+                name=f"fleet-reader-{w.id}.{incarnation}",
+                daemon=True,
+            ).start()
+        # tcp: the reader starts when the worker's dial-in hello arrives
+        # (_on_dial_in); until then the slot has no channel.
+
+    # -- connection establishment (tcp) --------------------------------
+    def _on_hello_reject(self, reason: str) -> None:
+        BUS.count("fleet.hello.rejected")
+        BUS.instant("fleet.hello.reject", cat="fleet", reason=reason[:200])
+        self._hello_rejections.append(reason)
+        del self._hello_rejections[:-16]  # keep the tail only
+
+    def _on_dial_in(self, hello: dict, transport: Transport) -> None:
+        """Listener callback: attach a validated dial-in to its slot."""
+        wid = int(hello["worker"])
+        if not 0 <= wid < len(self._workers):
+            raise HelloError(f"hello for unknown worker slot {wid}")
+        w = self._workers[wid]
+        with w.lock:
+            if self._closed:
+                raise HelloError("router is shutting down")
+            if hello.get("token") != w.conn_token:
+                raise HelloError(
+                    f"stale or foreign dial-in token for worker {wid} "
+                    f"(incarnation {w.incarnation})"
+                )
+            if w.transport is not None and not w.transport.closed:
+                raise HelloError(f"worker {wid} already connected")
+            w.transport = transport
+            incarnation = w.incarnation
+        self._register_hello(w, hello)
         threading.Thread(
             target=self._reader,
-            args=(w, incarnation, w.proc.stdout),
+            args=(w, incarnation, transport),
             name=f"fleet-reader-{w.id}.{incarnation}",
             daemon=True,
         ).start()
 
-    # -- the pipe reader (one per incarnation) -------------------------
-    def _reader(self, w: _Worker, incarnation: int, stdout) -> None:
-        while True:
+    def _connect_remote(self, w: _Worker) -> None:
+        """Dial one externally started worker (``--listen``) until it
+        answers with a valid hello or the ready timeout passes."""
+        deadline = time.monotonic() + self.config.ready_timeout_s
+        while not self._closed and time.monotonic() < deadline:
             try:
-                frame = read_frame(stdout)
-            except (OSError, ValueError):
-                frame = None
+                hello, transport = connect_to_worker(
+                    w.addr, pipelined=self.config.pipelined_io
+                )
+            except HelloError as e:
+                self._on_hello_reject(str(e))
+                return  # incompatible peer: retrying cannot fix a version
+            except OSError:
+                time.sleep(0.2)
+                continue
+            if int(hello.get("worker", -1)) != w.id:
+                # A misconfigured endpoint (two --listen workers started
+                # with the same --worker-id, or the wrong port listed):
+                # registering it anyway would mis-attribute every
+                # response's `worker` field, the per-worker SLO breakdown,
+                # and the session pins — silently. Redialing the same
+                # endpoint cannot fix a config error, so fail loud.
+                self._on_hello_reject(
+                    f"worker at {w.addr} says --worker-id "
+                    f"{hello.get('worker')}, but this slot is {w.id} — "
+                    f"fix the --worker-id/--fleet-workers pairing"
+                )
+                transport.close(flush=False)
+                return
+            with w.lock:
+                w.incarnation += 1
+                incarnation = w.incarnation
+                w.transport = transport
+            self._register_hello(w, hello)
+            threading.Thread(
+                target=self._reader,
+                args=(w, incarnation, transport),
+                name=f"fleet-reader-{w.id}.{incarnation}",
+                daemon=True,
+            ).start()
+            return
+
+    def _register_hello(self, w: _Worker, hello: dict) -> None:
+        w.caps = dict(hello.get("caps") or {})
+        w.lane_advertised = bool(w.caps.get("lane"))
+        w.last_pong = time.monotonic()
+        w.ready.set()
+
+    # -- the channel reader (one per incarnation) ----------------------
+    def _reader(self, w: _Worker, incarnation: int, transport: Transport) -> None:
+        while True:
+            frame = transport.recv()
             if frame is None:
                 break
             if "ready" in frame:
-                w.last_pong = time.monotonic()
-                w.lane_advertised = bool(frame.get("lane"))
-                w.ready.set()
+                # Pipe mode: the hello arrives in-band as the first frame.
+                try:
+                    hello = check_hello(frame)
+                except HelloError as e:
+                    self._on_hello_reject(str(e))
+                    break  # incompatible peer: drop the channel
+                self._register_hello(w, hello)
                 continue
             if "pong" in frame:
                 w.last_pong = time.monotonic()
@@ -374,6 +585,7 @@ class FleetRouter:
             resp = frame.get("resp")
             if rid is None or not isinstance(resp, dict):
                 continue
+            w.last_pong = time.monotonic()  # a response proves liveness too
             with w.lock:
                 pending = w.pending.pop(rid, None)
             if pending is None:
@@ -383,6 +595,7 @@ class FleetRouter:
                 BUS.count("fleet.duplicate.response")
                 continue
             self._release_slot(w)
+            self._record_hop(pending, frame.get("t"))
             if resp.get("ok") and resp.get("op") in (
                 "update", "publish", "subscribe"
             ):
@@ -391,9 +604,15 @@ class FleetRouter:
                 self._note_session(
                     resp.get("digest"), w.id, prev=resp.get("prev_digest")
                 )
+            if resp.get("ok") and resp.get("digest"):
+                # Forwarding's owner-of-record: this worker now holds the
+                # digest's result warm, wherever the ring says it *should*
+                # live.
+                self._note_served(str(resp["digest"]), w.id)
             pending.response = resp
             pending.worker_id = w.id
             pending.event.set()
+        transport.close(flush=False)  # channel already dead: never wait on it
         self._on_death(w, incarnation)
 
     @staticmethod
@@ -402,6 +621,23 @@ class FleetRouter:
             w.slots.release()
         except ValueError:
             pass  # slot already reclaimed by a respawn's fresh semaphore
+
+    @staticmethod
+    def _record_hop(p: _Pending, service_s) -> None:
+        """Hop latency = send-to-response wall time minus the worker's own
+        service time: what the transport, framing, queueing, and router
+        bookkeeping cost this request — the number a pipe-vs-TCP choice
+        moves, tracked per class so the SLO report can carry it."""
+        if p.sent_at is None:
+            return
+        try:
+            service = float(service_s or 0.0)
+        except (TypeError, ValueError):
+            service = 0.0
+        hop = max(0.0, time.monotonic() - p.sent_at - service)
+        BUS.record("fleet.hop_s", hop)
+        if p.cls:
+            BUS.record(f"fleet.hop_s.{p.cls}", hop)
 
     def _note_session(
         self, digest: Optional[str], worker_id: int, prev: Optional[str]
@@ -415,9 +651,17 @@ class FleetRouter:
             while len(self._sessions) > _SESSION_MAP_CAP:
                 self._sessions.pop(next(iter(self._sessions)))
 
+    def _note_served(self, digest: str, worker_id: int) -> None:
+        with self._ring_lock:
+            self._last_served[digest] = worker_id
+            self._last_served.move_to_end(digest)
+            while len(self._last_served) > _FORWARD_MAP_CAP:
+                self._last_served.popitem(last=False)
+
     # -- health --------------------------------------------------------
     def _heartbeat_loop(self) -> None:
         cfg = self.config
+        lease_s = cfg.effective_lease_s
         seq = 0
         while not self._closed:
             time.sleep(cfg.heartbeat_interval_s)
@@ -427,15 +671,20 @@ class FleetRouter:
                 if not (w.alive and w.ready.is_set()):
                     continue
                 age = time.monotonic() - w.last_pong
-                if age > cfg.heartbeat_interval_s * cfg.heartbeat_miss_threshold:
+                if age > lease_s:
+                    # The channel is still open but the worker went silent
+                    # past its lease: a wedged process, or a half-dead
+                    # network path TCP keepalive hasn't noticed.
                     BUS.count("fleet.heartbeat.miss")
+                    if w.transport is not None and w.transport.kind == "tcp":
+                        BUS.count("fleet.lease.expired")
                     self._on_death(w, w.incarnation)
                     continue
                 seq += 1
                 try:
                     with w.lock:
-                        if w.proc is not None and w.proc.stdin:
-                            write_frame(w.proc.stdin, {"ping": seq})
+                        if w.transport is not None:
+                            w.transport.send({"ping": seq})
                 except OSError:
                     self._on_death(w, w.incarnation)
 
@@ -454,15 +703,33 @@ class FleetRouter:
                 d for d, wid in self._sessions.items() if wid == w.id
             ]:
                 del self._sessions[digest]
+            for digest in [
+                d for d, wid in self._last_served.items() if wid == w.id
+            ]:
+                # Its warm copies died with it (memory) or became
+                # unreachable (its host-local disk): stop forwarding there.
+                del self._last_served[digest]
         with w.lock:
             orphans = list(w.pending.values())
             w.pending.clear()
             proc = w.proc
+            transport = w.transport
+        if transport is not None:
+            # flush=False: this is the death path — waiting on a wedged
+            # peer's full TCP window here would stall the heartbeat thread
+            # (and every other worker's failover) for the flush timeout.
+            transport.close(flush=False)
         if not self._closed:  # drained workers EOF on purpose: not a death
             BUS.count("fleet.worker.dead")
             BUS.instant("fleet.worker.death", cat="fleet", worker=w.id,
                         incarnation=incarnation, orphans=len(orphans))
-        if proc is not None and proc.poll() is None:
+        if proc is not None and proc.poll() is None and not self._closed:
+            # During shutdown the channel closes BEFORE the process exits
+            # (a TCP worker tears its socket down, then flushes obs and
+            # returns 0) — killing here would turn every graceful drain
+            # into a SIGKILL. shutdown() owns the reap (and the kill, past
+            # its deadline); outside shutdown a dead channel means the
+            # incarnation is done: make sure the process is too.
             try:
                 proc.kill()
             except OSError:
@@ -508,10 +775,16 @@ class FleetRouter:
             time.sleep(backoff)
             if self._closed:
                 return
-            try:
-                self._spawn(w)
-            except OSError:
-                continue
+            if w.addr is not None:
+                # Remote worker: re-dial. The process (and its caches) may
+                # have survived a mere connection loss — the hello-led
+                # reconnect is then a warm rejoin, not a cold restart.
+                self._connect_remote(w)
+            else:
+                try:
+                    self._spawn(w)
+                except OSError:
+                    continue
             if w.ready.wait(cfg.ready_timeout_s):
                 with self._ring_lock:
                     w.alive = True
@@ -526,6 +799,8 @@ class FleetRouter:
             with w.lock:
                 if w.proc is not None and w.proc.poll() is None:
                     w.proc.kill()
+                if w.transport is not None:
+                    w.transport.close()
 
     # -- routing + dispatch --------------------------------------------
     def _routing_key(self, request: dict) -> Optional[str]:
@@ -555,8 +830,13 @@ class FleetRouter:
         return None
 
     def _route(
-        self, key: Optional[str], *, lane: bool = False
+        self, key: Optional[str], *, lane: bool = False, count: bool = True
     ) -> Optional[_Worker]:
+        """``count=False`` is the side-effect-free peek the forwarding
+        probe uses to learn the prospective target — the lane-routing
+        counters must reflect dispatches only (``fleet.route
+        .lane_fallback`` is documented as the all-lane-workers-down
+        signal; a probe pre-pass must not double it)."""
         with self._ring_lock:
             if key is not None:
                 wid = self._sessions.get(key)
@@ -569,10 +849,12 @@ class FleetRouter:
                     # solve is slow, never wrong.
                     try:
                         wid = self._lane_ring.assign(key)
-                        BUS.count("fleet.route.sharded_lane")
+                        if count:
+                            BUS.count("fleet.route.sharded_lane")
                         return self._workers[wid]
                     except LookupError:
-                        BUS.count("fleet.route.lane_fallback")
+                        if count:
+                            BUS.count("fleet.route.lane_fallback")
                 try:
                     return self._workers[self._ring.assign(key)]
                 except LookupError:
@@ -620,13 +902,15 @@ class FleetRouter:
             rid = None
             try:
                 with w.lock:
-                    if not w.alive or w.incarnation != incarnation:
+                    if (not w.alive or w.incarnation != incarnation
+                            or w.transport is None):
                         raise OSError("worker died during dispatch")
                     with self._id_lock:
                         self._next_id += 1
                         rid = self._next_id
                     w.pending[rid] = p
-                    write_frame(w.proc.stdin, {"id": rid, "req": p.request})
+                    p.sent_at = time.monotonic()
+                    w.transport.send({"id": rid, "req": p.request})
             except OSError:
                 if rid is not None:
                     with w.lock:
@@ -637,6 +921,64 @@ class FleetRouter:
             BUS.count("fleet.dispatch")
             BUS.sample(f"fleet.queue.depth.{w.id}", len(w.pending))
             return None
+
+    # -- cache-miss forwarding -----------------------------------------
+    def _forward_probe(
+        self, request: dict, key: Optional[str], cls: Optional[str],
+        lane: bool,
+    ) -> Optional[dict]:
+        """The cross-host affinity hop: when a solve is about to land on a
+        worker that is NOT the digest's owner-of-record, ask the owner
+        first with a tiny ``cached_only`` frame (digest + backend — never
+        the edge list). A hit returns the owner's cached result without
+        any local solve (``fleet.forward.hit``); a miss falls through to
+        the normal dispatch, which solves locally
+        (``fleet.forward.miss``). ``None`` = no probe applies."""
+        if key is None or request.get("op") != "solve":
+            return None
+        if request.get("cached_only"):
+            return None  # already a probe: no recursion
+        target = self._route(key, lane=lane, count=False)  # peek only
+        if target is None:
+            return None
+        with self._ring_lock:
+            owner = self._last_served.get(key)
+            if owner is None and lane:
+                # No serving history: the lane steered this dispatch away
+                # from the full-ring owner — the worker affinity WOULD have
+                # chosen. Ask it (the literal "ask the digest-owner
+                # first"); on the first-ever solve this is a recorded miss.
+                try:
+                    owner = self._ring.assign(key)
+                except LookupError:
+                    owner = None
+        if owner is None or owner == target.id:
+            return None
+        ow = self._workers[owner]
+        if not (ow.alive and ow.ready.is_set()):
+            return None
+        probe = {"op": "solve", "digest": key, "cached_only": True}
+        if "backend" in request:
+            probe["backend"] = request["backend"]
+        resp = self._request_worker(
+            ow, probe,
+            timeout_s=min(_FORWARD_PROBE_TIMEOUT_S,
+                          self.config.request_timeout_s),
+            # A saturated owner (no free admission slot) is a miss, not
+            # something to wait out: the probe must not queue behind slow
+            # solves or starve real requests of the owner's slots.
+            slot_timeout_s=_FORWARD_PROBE_SLOT_TIMEOUT_S,
+        )
+        if resp and resp.get("ok"):
+            BUS.count("fleet.forward.hit")
+            out = dict(resp)
+            out["forwarded_from"] = owner
+            out.setdefault("worker", owner)
+            if cls is not None:
+                out.setdefault("slo_class", cls)
+            return out
+        BUS.count("fleet.forward.miss")
+        return None
 
     # -- the service surface -------------------------------------------
     def handle(self, request: dict) -> dict:
@@ -662,10 +1004,14 @@ class FleetRouter:
             # workers — otherwise every oversize request would probe the
             # empty lane ring and pollute the lane_fallback counter
             # (documented as the all-lane-workers-down signal).
-            p = _Pending(
-                request, key, cls,
-                lane=bool(self._lane_ids) and _request_oversize(request),
-            )
+            lane = bool(self._lane_ids) and _request_oversize(request)
+            if self.config.forward_enabled:
+                forwarded = self._forward_probe(request, key, cls, lane)
+                if forwarded is not None:
+                    span.set(ok=True, worker=forwarded.get("worker"),
+                             forwarded=True)
+                    return forwarded
+            p = _Pending(request, key, cls, lane=lane)
             err = self._dispatch(p)
             if err is not None:
                 span.set(ok=False, shed=bool(err.get("shed")))
@@ -703,26 +1049,33 @@ class FleetRouter:
                 return
 
     def _request_worker(
-        self, w: _Worker, request: dict, timeout_s: float = 10.0
+        self, w: _Worker, request: dict, timeout_s: float = 10.0,
+        slot_timeout_s: Optional[float] = None,
     ) -> Optional[dict]:
-        """A control-plane request pinned to one worker (stats fan-out)."""
+        """A control-plane request pinned to one worker (stats fan-out,
+        forwarding probes). ``slot_timeout_s`` bounds the admission-slot
+        wait separately (probes give up fast on a saturated worker)."""
         p = _Pending(request, None, None)
-        if not w.slots.acquire(timeout=timeout_s):
+        if not w.slots.acquire(
+            timeout=timeout_s if slot_timeout_s is None else slot_timeout_s
+        ):
             return None
         try:
             with w.lock:
-                if not w.alive:
+                if not w.alive or w.transport is None:
                     self._release_slot(w)
                     return None
                 with self._id_lock:
                     self._next_id += 1
                     rid = self._next_id
                 w.pending[rid] = p
-                write_frame(w.proc.stdin, {"id": rid, "req": request})
+                p.sent_at = time.monotonic()
+                w.transport.send({"id": rid, "req": request})
         except OSError:
             self._release_slot(w)
             return None
         if not p.event.wait(timeout_s):
+            self._forget(p)
             return None
         return p.response
 
@@ -736,7 +1089,14 @@ class FleetRouter:
                 "restarts": w.restarts,
                 "pending": len(w.pending),
                 "lane": w.id in self._lane_ids,
+                "caps": dict(w.caps),
             }
+            if w.addr is not None:
+                info["addr"] = w.addr
+            if w.transport is not None:
+                info["transport"] = w.transport.kind
+                info["channel_writes"] = w.transport.writes
+                info["channel_frames"] = w.transport.frames
             if w.alive and w.ready.is_set():
                 resp = self._request_worker(w, {"op": "stats"})
                 if resp and resp.get("ok"):
@@ -751,7 +1111,12 @@ class FleetRouter:
             name: value for name, value in BUS.counters().items()
             if name.startswith("fleet.")
         }
-        return {
+        hop = {
+            name: summary
+            for name, summary in BUS.histograms().items()
+            if name.startswith("fleet.hop_s")
+        }
+        out = {
             "ok": True,
             "op": "stats",
             "counters": counters,  # summed across live workers
@@ -759,17 +1124,39 @@ class FleetRouter:
             "workers": workers_out,
             "ring": sorted(self._ring.members()),
             "sessions": len(self._sessions),
+            "transport": self.config.transport,
+            "forward_cache": self.config.forward_enabled,
         }
+        if hop:
+            out["router_hop_s"] = hop
+        return out
 
     # -- chaos/drill surface -------------------------------------------
     def kill_worker(self, worker_id: int) -> None:
-        """SIGKILL one worker mid-traffic (drills). Failover is automatic."""
+        """SIGKILL one worker mid-traffic (drills). Failover is automatic.
+        Remote workers have no process handle here — their connection is
+        hard-closed instead (the same death signal a network partition
+        gives)."""
         w = self._workers[worker_id]
         with w.lock:
             proc = w.proc
+            transport = w.transport
         if proc is not None and proc.poll() is None:
             proc.kill()
+        elif transport is not None:
+            transport.close(flush=False)
         # The reader sees EOF and runs the death path; nothing else to do.
+
+    def close_worker_connection(self, worker_id: int) -> None:
+        """Hard-close one worker's channel WITHOUT killing the process
+        (drills: a network partition / socket reset, distinct from a
+        crash). The reader sees EOF, pending requests re-queue onto
+        survivors, and the restart path re-establishes the channel."""
+        w = self._workers[worker_id]
+        with w.lock:
+            transport = w.transport
+        if transport is not None:
+            transport.close(flush=False)  # a partition does not flush
 
     def arm_worker_fault(
         self, worker_id: int, *, site: str = "fleet.worker.crash",
@@ -781,9 +1168,9 @@ class FleetRouter:
         w = self._workers[worker_id]
         try:
             with w.lock:
-                if not w.alive or w.proc is None:
+                if not w.alive or w.transport is None:
                     return False
-                write_frame(w.proc.stdin, {
+                w.transport.send({
                     "arm": {"site": site, "times": times, "kind": kind,
                             "value": value},
                 })

@@ -1,25 +1,44 @@
-"""Fleet worker process: one MSTService behind a framed stdin/stdout pipe.
+"""Fleet worker process: one MSTService behind a framed channel.
 
 Spawned by :class:`fleet.router.FleetRouter` as
-``python -m distributed_ghs_implementation_tpu.fleet.worker --worker-id K``.
-Each worker owns a full serving stack — its own lane engine, warm-bucket
-cache, obs bus, and solve scheduler — and shares only the *persistent*
-layers with its siblings: the on-disk result store (flock-serialized
-writes, ``serve/store.py``) and the machine-fingerprinted XLA compile
-cache. Inbound frames (``fleet/framing.py``):
+``python -m distributed_ghs_implementation_tpu.fleet.worker --worker-id K``
+— over stdin/stdout pipes (the single-host default), or over TCP
+(``fleet/transport.py``): ``--connect HOST:PORT`` dials into the router's
+listener and registers with a hello frame; ``--listen [HOST:]PORT`` serves
+a socket an off-host router dials (the ``--fleet-workers host:port`` remote
+topology). Each worker owns a full serving stack — its own lane engine,
+warm-bucket cache, obs bus, and solve scheduler — and shares only the
+*persistent* layers with same-host siblings: the on-disk result store
+(flock-serialized writes, ``serve/store.py``) and the machine-fingerprinted
+XLA compile cache. Across hosts nothing is shared — the router's
+cache-miss forwarding hop covers that gap (``docs/FLEET.md``).
+
+Inbound frames (``fleet/framing.py``):
 
 * ``{"id": N, "req": {...}}`` — one service request; the response frame
-  ``{"id": N, "resp": {...}}`` may be written out of order (requests run on
-  a small thread pool so the batch engine can coalesce lane-mates).
+  ``{"id": N, "resp": {...}, "t": seconds}`` may be written out of order
+  (requests run on a small thread pool so the batch engine can coalesce
+  lane-mates); ``t`` is the in-worker service time, which lets the router
+  compute the pure transport+queueing hop latency per request.
 * ``{"ping": S}`` — heartbeat; answered ``{"pong": S}`` inline from the
   read loop, so a worker busy solving still proves its process is alive
-  (busy is not dead — only a wedged or exited process misses heartbeats).
+  (busy is not dead — only a wedged or exited process misses heartbeats,
+  and over TCP that silence is what expires the router-side lease).
 * ``{"arm": {"site": ..., "times": T, "kind": ...}}`` — arm the in-process
   :data:`~distributed_ghs_implementation_tpu.utils.resilience.FAULTS`
   registry (kill drills arm ``fleet.worker.crash`` mid-traffic this way).
-* ``{"drain": true}`` (or stdin EOF, or SIGTERM) — graceful drain: stop
-  reading, finish every in-flight request, flush the responses, export the
-  obs JSONL (``--obs-jsonl``), and exit 0.
+* ``{"drain": true}`` (or channel EOF in pipe/connect mode, or SIGTERM) —
+  graceful drain: stop reading, finish every in-flight request, flush the
+  responses, export the obs JSONL (``--obs-jsonl``), and exit 0. In
+  ``--listen`` mode a *connection loss without drain* instead returns the
+  worker to ``accept()`` with its caches and sessions intact — the router
+  re-dials and the worker rejoins warm.
+
+The hello/ready frame (one builder for every medium,
+``transport.build_hello``) carries the protocol version and the worker's
+capability flags — ``lane`` (owns a mesh-sharded oversize lane),
+``stream`` (durable stream log attached), ``kernel`` (level-kernel
+choice) — so the router learns everything routing needs in one place.
 
 The ``fleet.worker.crash`` fault site is consulted once per request,
 *before* it is handled: when the armed shot count reaches zero the process
@@ -36,13 +55,18 @@ import hashlib
 import json
 import os
 import signal
+import socket
 import sys
 import threading
+import time
 from concurrent.futures import ThreadPoolExecutor
 
-from distributed_ghs_implementation_tpu.fleet.framing import (
-    read_frame,
-    write_frame,
+from distributed_ghs_implementation_tpu.fleet.transport import (
+    PipeTransport,
+    SocketTransport,
+    Transport,
+    build_hello,
+    parse_hostport,
 )
 
 CRASH_SITE = "fleet.worker.crash"
@@ -57,19 +81,20 @@ class EchoService:
     """A jax-free stand-in service for fleet plumbing tests.
 
     Answers the same ops as :class:`serve.service.MSTService` with canned
-    content: solves echo a digest derived from the request payload, updates
-    re-key it digest-chained, ``sleep_s`` simulates a slow solve. This is
-    what lets ``tests/test_fleet.py`` exercise routing, re-queue, shedding,
-    heartbeats, and drain without compiling a single kernel.
+    content: solves echo a digest derived from the request payload (and
+    remember it, so ``cached_only`` probes answer hit/miss honestly — the
+    forwarding drills need that), updates re-key it digest-chained,
+    ``sleep_s`` simulates a slow solve. This is what lets
+    ``tests/test_fleet.py`` exercise routing, re-queue, shedding,
+    heartbeats, forwarding, and drain without compiling a single kernel.
     """
 
     def __init__(self, worker_id: int):
         self.worker_id = worker_id
         self.handled = 0
+        self._served = set()  # digests this worker has "solved" (cached)
 
     def handle(self, request: dict) -> dict:
-        import time
-
         self.handled += 1
         op = request.get("op")
         if request.get("sleep_s"):
@@ -78,6 +103,15 @@ class EchoService:
             digest = request.get("digest") or hashlib.sha256(
                 json.dumps(request.get("edges", []), sort_keys=True).encode()
             ).hexdigest()[:32]
+            if request.get("cached_only"):
+                if digest in self._served:
+                    return {"ok": True, "op": "solve", "digest": digest,
+                            "source": "cache", "cached": True,
+                            "worker": self.worker_id}
+                return {"ok": False, "op": "solve", "digest": digest,
+                        "cache_miss": True, "worker": self.worker_id,
+                        "error": f"cache_miss: {digest} not cached here"}
+            self._served.add(digest)
             return {"ok": True, "op": "solve", "digest": digest,
                     "source": "echo", "worker": self.worker_id}
         if op == "update":
@@ -87,6 +121,7 @@ class EchoService:
             new = hashlib.sha256(
                 (digest + json.dumps(request.get("updates", []))).encode()
             ).hexdigest()[:32]
+            self._served.add(new)
             return {"ok": True, "op": "update", "digest": new,
                     "prev_digest": digest, "worker": self.worker_id}
         if op == "stats":
@@ -102,6 +137,16 @@ def _build_service(args):
     if args.test_echo:
         return EchoService(args.worker_id)
     # Deferred: the echo path must never pay the jax import.
+    if args.multihost:
+        # A pod-slice worker: bring up the JAX distributed runtime from
+        # the standard env (launcher/tpu_pod_worker.sh exports it) BEFORE
+        # any other JAX API, so jax.devices() spans the slice and the
+        # sharded lane's mesh covers every chip the worker owns.
+        from distributed_ghs_implementation_tpu.parallel.multihost import (
+            initialize,
+        )
+
+        initialize()
     from distributed_ghs_implementation_tpu.batch.warmup import plan_from_flags
     from distributed_ghs_implementation_tpu.serve.service import MSTService
     from distributed_ghs_implementation_tpu.utils.compile_cache import (
@@ -135,15 +180,74 @@ def _build_service(args):
     )
 
 
+def _hello_for(args) -> dict:
+    # The one place capability flags live (routing reads them off the
+    # hello; ad-hoc per-feature keys are what this replaces).
+    return build_hello(
+        args.worker_id,
+        caps={
+            "lane": bool(args.sharded_lane),
+            "stream": bool(args.stream_dir),
+            "kernel": os.environ.get("GHS_KERNEL", "auto"),
+        },
+        token=args.conn_token,
+    )
+
+
+def _serve_connection(transport: Transport, service, pool) -> str:
+    """Drain frames off one channel until drain/EOF; returns ``"drain"``
+    (stop the worker) or ``"eof"`` (connection lost; a ``--listen`` worker
+    goes back to accept)."""
+    from distributed_ghs_implementation_tpu.utils.resilience import FAULTS
+
+    def _serve_one(rid: int, request: dict) -> None:
+        shot = FAULTS.pop(CRASH_SITE)
+        if shot is not None and shot.remaining == 0:
+            os._exit(CRASH_EXIT_CODE)  # a real crash: no response, no flush
+        t0 = time.perf_counter()
+        try:
+            response = service.handle(request)
+        except Exception as e:  # noqa: BLE001 — the channel must survive
+            response = {"ok": False, "error": f"{type(e).__name__}: {e}"}
+        try:
+            transport.send({
+                "id": rid, "resp": response,
+                "t": round(time.perf_counter() - t0, 6),
+            })
+        except OSError:
+            pass  # router gone mid-response; it re-queues, we carry on
+
+    try:
+        while True:
+            frame = transport.recv()
+            if frame is None or frame.get("drain"):
+                return "drain" if frame else "eof"
+            if "ping" in frame:
+                try:
+                    transport.send({"pong": frame["ping"]})
+                except OSError:
+                    return "eof"
+                continue
+            if "arm" in frame:
+                arm = frame["arm"]
+                FAULTS.arm(
+                    arm.get("site", CRASH_SITE),
+                    times=int(arm.get("times", 1)),
+                    kind=arm.get("kind", "raise"),
+                    value=float(arm.get("value", 0.0)),
+                )
+                continue
+            if "req" in frame:
+                pool.submit(_serve_one, frame["id"], frame["req"])
+    except _DrainSignal:
+        return "drain"
+
+
 def run_worker(args) -> int:
     from distributed_ghs_implementation_tpu.obs.events import BUS
-    from distributed_ghs_implementation_tpu.utils.resilience import FAULTS
 
     BUS.enable()
     service = _build_service(args)
-    stdin = sys.stdin.buffer
-    stdout = sys.stdout.buffer
-    out_lock = threading.Lock()
     draining = threading.Event()
 
     def _drain_handler(signum, frame):
@@ -158,57 +262,68 @@ def run_worker(args) -> int:
     except ValueError:  # not the main thread (in-process tests)
         pass
 
-    def _serve_one(rid: int, request: dict) -> None:
-        shot = FAULTS.pop(CRASH_SITE)
-        if shot is not None and shot.remaining == 0:
-            os._exit(CRASH_EXIT_CODE)  # a real crash: no response, no flush
-        try:
-            response = service.handle(request)
-        except Exception as e:  # noqa: BLE001 — the pipe must survive
-            response = {"ok": False, "error": f"{type(e).__name__}: {e}"}
-        with out_lock:
-            write_frame(stdout, {"id": rid, "resp": response})
-
     pool = ThreadPoolExecutor(
         max_workers=args.threads, thread_name_prefix=f"worker{args.worker_id}"
     )
-    with out_lock:
-        # The lane capability flag rides the ready frame: the router sends
-        # oversize digests only to mesh-owning workers (fleet/router.py).
-        write_frame(
-            stdout,
-            {"ready": True, "worker": args.worker_id, "pid": os.getpid(),
-             "lane": bool(args.sharded_lane)},
-        )
+    hello = _hello_for(args)
+
+    last_transport = None
     try:
-        while True:
-            frame = read_frame(stdin)
-            if frame is None or frame.get("drain"):
-                break
-            if "ping" in frame:
-                with out_lock:
-                    write_frame(stdout, {"pong": frame["ping"]})
-                continue
-            if "arm" in frame:
-                arm = frame["arm"]
-                FAULTS.arm(
-                    arm.get("site", CRASH_SITE),
-                    times=int(arm.get("times", 1)),
-                    kind=arm.get("kind", "raise"),
-                    value=float(arm.get("value", 0.0)),
-                )
-                continue
-            if "req" in frame:
-                pool.submit(_serve_one, frame["id"], frame["req"])
+        if args.listen:
+            host, port = parse_hostport(args.listen, default_host="0.0.0.0")
+            server = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+            server.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+            server.bind((host, port))
+            server.listen(1)
+            print(
+                f"fleet.worker {args.worker_id}: listening on "
+                f"{server.getsockname()[0]}:{server.getsockname()[1]}",
+                file=sys.stderr, flush=True,
+            )
+            # One router connection at a time; a lost connection (router
+            # death, network partition) returns to accept with the warm
+            # service intact — the re-dialing router gets a warm rejoin,
+            # not a cold restart.
+            while not draining.is_set():
+                try:
+                    conn, _addr = server.accept()
+                except (OSError, _DrainSignal):
+                    break
+                transport = last_transport = SocketTransport(conn)
+                try:
+                    transport.send(hello)
+                except OSError:
+                    transport.close()
+                    continue
+                outcome = _serve_connection(transport, service, pool)
+                if outcome == "drain":
+                    break
+                transport.close()
+            server.close()
+        elif args.connect:
+            sock = socket.create_connection(
+                parse_hostport(args.connect), timeout=30.0
+            )
+            sock.settimeout(None)
+            transport = last_transport = SocketTransport(sock)
+            transport.send(hello)
+            _serve_connection(transport, service, pool)
+        else:
+            transport = last_transport = PipeTransport(
+                sys.stdout.buffer, sys.stdin.buffer
+            )
+            transport.send(hello)
+            _serve_connection(transport, service, pool)
     except _DrainSignal:
         pass
     # Drain: everything admitted gets its response flushed before exit 0.
     pool.shutdown(wait=True)
-    with out_lock:
+    if last_transport is not None:
         try:
-            write_frame(stdout, {"bye": True, "worker": args.worker_id})
+            last_transport.send({"bye": True, "worker": args.worker_id})
         except OSError:
             pass  # router already gone; the drain still completed
+        last_transport.close()
     if args.obs_jsonl:
         from distributed_ghs_implementation_tpu.obs.export import (
             write_events_jsonl,
@@ -249,6 +364,20 @@ def build_parser() -> argparse.ArgumentParser:
                    default=0, metavar="N",
                    help="own a mesh-sharded oversize solve lane over N "
                    "devices (bare flag = all; 0 = off)")
+    p.add_argument("--connect", default=None, metavar="HOST:PORT",
+                   help="dial into the router's listener over TCP and "
+                   "register with a hello frame (spawned network workers)")
+    p.add_argument("--listen", default=None, metavar="[HOST:]PORT",
+                   help="serve a TCP socket the router dials (remote "
+                   "workers addressed via --fleet-workers host:port); a "
+                   "lost connection returns to accept with caches warm")
+    p.add_argument("--conn-token", default=None,
+                   help="dial-in token proving this process belongs to its "
+                   "router-assigned slot + incarnation")
+    p.add_argument("--multihost", action="store_true",
+                   help="initialize the JAX distributed runtime before "
+                   "building the service (a pod-slice worker; "
+                   "launcher/tpu_pod_worker.sh)")
     p.add_argument("--compile-cache-dir", default=None)
     p.add_argument("--no-compile-cache", action="store_true")
     p.add_argument("--obs-jsonl", default=None,

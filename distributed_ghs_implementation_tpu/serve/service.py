@@ -267,6 +267,8 @@ class MSTService:
 
     # ------------------------------------------------------------------
     def _handle_solve(self, request: dict) -> dict:
+        if request.get("cached_only"):
+            return self._handle_cached_probe(request)
         graph = self._load_graph(request)
         backend = request.get("backend", self.backend)
         bucket = bucket_of(graph.num_nodes, graph.num_edges)
@@ -284,6 +286,39 @@ class MSTService:
             "digest": digest,
             "source": source,
             "cached": source != "solved",
+        }
+        out.update(self._result_fields(result, request))
+        return out
+
+    def _handle_cached_probe(self, request: dict) -> dict:
+        """A ``cached_only`` solve: answer from the store (memory LRU, or
+        this host's disk layer) by digest alone — never solve. This is the
+        fleet router's cross-host forwarding probe: the frame carries only
+        the digest (no edge list), so a hit ships one cached result over
+        the wire and a miss costs a single tiny round trip before the
+        dispatch target solves locally (``docs/FLEET.md``)."""
+        digest = request.get("digest")
+        if not digest:
+            raise ValueError("cached_only solve needs a digest")
+        backend = request.get("backend", self.backend)
+        result = self.store.get(
+            cache_key_for_digest(str(digest), backend=backend),
+            record_miss=False,
+        )
+        BUS.count("serve.probe.hit" if result is not None
+                  else "serve.probe.miss")
+        if result is None:
+            # Not an error: the probing router falls back to a local
+            # solve, so this must not land in serve.errors.
+            return {"ok": False, "op": "solve", "digest": digest,
+                    "cache_miss": True,
+                    "error": f"cache_miss: {digest} not cached here"}
+        out = {
+            "ok": True,
+            "op": "solve",
+            "digest": digest,
+            "source": "cache",
+            "cached": True,
         }
         out.update(self._result_fields(result, request))
         return out

@@ -1,0 +1,31 @@
+#!/bin/bash
+# Attach a Cloud TPU pod slice to a serving fleet as one network worker.
+#
+# Starts `fleet.worker --listen` on the slice so an off-host router
+# (`ghs serve --fleet-workers <slice-host>:<port>`) can dial it: the
+# worker owns a mesh-sharded oversize lane over every chip it can see
+# (`--sharded-lane`), and `--multihost` brings up the JAX distributed
+# runtime from pod metadata first (parallel/multihost.py) so
+# jax.devices() spans the slice before the service builds its mesh.
+#
+# Single-host slices (v5e-8, v4-8, ...) are fully supported: one process,
+# all chips, one listening socket. Multi-host slices start the same
+# command on every host; today only host 0's listener should be given to
+# the router (the fleet protocol is served per-process — driving
+# pod-spanning collectives from one worker's request loop is the
+# follower-broadcast frontier ROADMAP item 1 names).
+#
+# Usage:
+#   ./launcher/tpu_pod_worker.sh <tpu-name> <zone> <worker-id> <port> [extra flags]
+#   # then, from the router host:
+#   #   ghs serve --fleet-workers <slice-host>:<port> --backend device
+set -euo pipefail
+
+TPU_NAME="$1"; shift
+ZONE="$1"; shift
+WORKER_ID="$1"; shift
+PORT="$1"; shift
+
+gcloud compute tpus tpu-vm ssh "$TPU_NAME" --zone "$ZONE" --worker=all \
+  --command "cd $(pwd) && python -m distributed_ghs_implementation_tpu.fleet.worker \
+    --worker-id $WORKER_ID --listen 0.0.0.0:$PORT --multihost --sharded-lane $*"

@@ -17,6 +17,7 @@ import time
 import pytest
 
 from distributed_ghs_implementation_tpu.fleet.framing import (
+    FrameError,
     read_frame,
     write_frame,
 )
@@ -24,6 +25,12 @@ from distributed_ghs_implementation_tpu.fleet.hashing import HashRing
 from distributed_ghs_implementation_tpu.fleet.router import (
     FleetConfig,
     FleetRouter,
+)
+from distributed_ghs_implementation_tpu.fleet.transport import (
+    PROTO_VERSION,
+    HelloError,
+    build_hello,
+    check_hello,
 )
 from distributed_ghs_implementation_tpu.obs.events import BUS
 
@@ -50,14 +57,78 @@ def test_frame_round_trip_and_interleaved_stream():
     assert read_frame(buf) is None  # EOF
 
 
-def test_frame_torn_and_garbage_reads_as_eof():
+def test_frame_torn_and_garbage_raise_typed_frame_error():
     # Torn payload: header promises more bytes than the stream holds.
-    buf = io.BytesIO(b"100\n{\"id\": 1}")
-    assert read_frame(buf) is None
+    with pytest.raises(FrameError, match="truncated"):
+        read_frame(io.BytesIO(b"100\n{\"id\": 1}"))
     # Garbage header.
-    assert read_frame(io.BytesIO(b"not-a-length\nxx\n")) is None
+    with pytest.raises(FrameError, match="non-numeric"):
+        read_frame(io.BytesIO(b"not-a-length\nxx\n"))
     # Valid length, invalid JSON.
-    assert read_frame(io.BytesIO(b"2\nxx\n")) is None
+    with pytest.raises(FrameError, match="not valid JSON"):
+        read_frame(io.BytesIO(b"2\nxx\n"))
+    # A frame that parses but is not an object.
+    with pytest.raises(FrameError, match="not object"):
+        read_frame(io.BytesIO(b"7\n[1,2,3]\n"))
+    # FrameError IS a ValueError: peer-death handlers that catch
+    # (OSError, ValueError) keep treating a garbled peer as dead.
+    assert issubclass(FrameError, ValueError)
+
+
+def test_frame_truncated_prefix_and_header_flood():
+    # Truncated prefix: bytes end inside the header (no newline) — the
+    # stream is garbage, not EOF.
+    with pytest.raises(FrameError, match="header"):
+        read_frame(io.BytesIO(b"123"))
+    # A corrupt stream with no newline anywhere must NOT buffer
+    # unboundedly hunting for one: the header read is capped.
+    with pytest.raises(FrameError, match="header"):
+        read_frame(io.BytesIO(b"9" * 10_000))
+
+
+def test_frame_oversize_declaration_refused_before_allocating():
+    # A corrupt length prefix may not size an allocation: past max_bytes
+    # the frame is refused without reading the payload.
+    big = b"999999999999\n" + b"x" * 64
+    with pytest.raises(FrameError, match="outside"):
+        read_frame(io.BytesIO(big))
+    # Per-call ceilings tighten the default (the hello exchange).
+    frame = io.BytesIO()
+    write_frame(frame, {"pad": "y" * 2048})
+    frame.seek(0)
+    with pytest.raises(FrameError, match="outside"):
+        read_frame(frame, max_bytes=128)
+    # ...and a frame under the ceiling still round-trips.
+    frame.seek(0)
+    assert read_frame(frame, max_bytes=1 << 20)["pad"] == "y" * 2048
+
+
+# ----------------------------------------------------------------------
+# Hello / protocol version (fleet/transport.py)
+# ----------------------------------------------------------------------
+def test_hello_round_trip_carries_proto_and_caps():
+    hello = build_hello(
+        3, caps={"lane": True, "stream": False, "kernel": "xla"},
+        token="tok-1",
+    )
+    checked = check_hello(dict(hello))
+    assert checked["proto"] == PROTO_VERSION
+    assert checked["worker"] == 3 and checked["token"] == "tok-1"
+    assert checked["caps"] == {"lane": True, "stream": False,
+                               "kernel": "xla"}
+
+
+def test_hello_version_mismatch_rejected_with_clear_error():
+    hello = build_hello(0)
+    hello["proto"] = PROTO_VERSION + 7
+    with pytest.raises(HelloError, match="protocol version mismatch"):
+        check_hello(hello)
+    with pytest.raises(HelloError, match="not a hello"):
+        check_hello({"pong": 1})
+    missing = build_hello(0)
+    del missing["worker"]
+    with pytest.raises(HelloError, match="worker id"):
+        check_hello(missing)
 
 
 # ----------------------------------------------------------------------
@@ -316,3 +387,237 @@ def test_fleet_real_service_affinity_update_and_disk_failover(tmp_path):
         after = r.handle(_solve_request(graphs[1], "hit"))
         assert after["ok"]
         assert after["total_weight"] == solved[1]["total_weight"]
+
+
+def test_service_cached_only_probe_hits_after_solve(tmp_path):
+    # The forwarding hop's worker-side half: a cached_only solve answers
+    # from the store by digest alone (no edge list on the wire) and NEVER
+    # solves on a miss.
+    from distributed_ghs_implementation_tpu.graphs.generators import (
+        gnm_random_graph,
+    )
+    from distributed_ghs_implementation_tpu.serve.service import MSTService
+
+    service = MSTService()
+    g = gnm_random_graph(30, 60, seed=5)
+    digest = g.digest()
+    miss = service.handle({"op": "solve", "cached_only": True,
+                           "digest": digest})
+    assert not miss["ok"] and miss["cache_miss"]
+    assert BUS.counters().get("serve.errors", 0) == 0  # a miss is not an error
+    solved = service.handle(_solve_request(g))
+    hit = service.handle({"op": "solve", "cached_only": True,
+                          "digest": digest})
+    assert hit["ok"] and hit["cached"] and hit["source"] == "cache"
+    assert hit["total_weight"] == solved["total_weight"]
+    bad = service.handle({"op": "solve", "cached_only": True})
+    assert not bad["ok"] and "digest" in bad["error"]
+
+
+# ----------------------------------------------------------------------
+# TCP transport: the same fleet over localhost sockets
+# ----------------------------------------------------------------------
+@pytest.fixture(scope="module")
+def tcp_fleet():
+    cfg = FleetConfig(
+        workers=3, test_echo=True, transport="tcp",
+        heartbeat_interval_s=0.1, restart_backoff_base_s=0.02,
+        restart_backoff_cap_s=0.2, ready_timeout_s=120.0,
+        request_timeout_s=30.0,
+    )
+    router = FleetRouter(cfg).start()
+    yield router
+    router.shutdown()
+
+
+def test_tcp_fleet_routes_and_pins_sessions_like_pipes(tcp_fleet):
+    r = tcp_fleet
+    first = {
+        d: r.handle({"op": "solve", "digest": d})["worker"]
+        for d in (f"t{i}" for i in range(24))
+    }
+    assert set(first.values()) == {0, 1, 2}
+    for d, w in first.items():
+        assert r.handle({"op": "solve", "digest": d})["worker"] == w
+    solved = r.handle({"op": "solve", "digest": "tcp-chain"})
+    digest, workers = "tcp-chain", set()
+    for _ in range(4):
+        resp = r.handle({"op": "update", "digest": digest,
+                         "updates": [{"k": 1}]})
+        assert resp["ok"]
+        digest = resp["digest"]
+        workers.add(resp["worker"])
+    assert workers == {solved["worker"]}
+    stats = r.handle({"op": "stats"})
+    assert stats["transport"] == "tcp"
+    assert stats["workers"]["0"]["transport"] == "tcp"
+    assert stats["workers"]["0"]["caps"].get("kernel") is not None
+
+
+def test_tcp_fleet_kill_mid_traffic_requeues_and_restarts(tcp_fleet):
+    r = tcp_fleet
+    victim = r.handle({"op": "solve", "digest": "tcp-kill"})["worker"]
+    dead_before = BUS.counters().get("fleet.worker.dead", 0)
+    assert r.arm_worker_fault(victim, times=1)
+    resp = r.handle({"op": "solve", "digest": "tcp-kill", "slo_class": "x"})
+    assert resp["ok"] and resp["worker"] != victim
+    assert resp.get("requeued", 0) >= 1
+    assert BUS.counters().get("fleet.worker.dead", 0) == dead_before + 1
+    deadline = time.monotonic() + 30
+    while time.monotonic() < deadline and not r._workers[victim].alive:
+        time.sleep(0.05)
+    assert r._workers[victim].alive  # re-dialed in and rejoined the ring
+
+
+def test_tcp_hard_socket_close_requeues_in_flight_onto_survivors(tcp_fleet):
+    # Satellite: connection loss WITHOUT process death. The victim's
+    # socket is hard-closed while it is mid-solve; its accepted request
+    # must re-queue onto a survivor by digest — and the limping victim's
+    # late response hits a dead socket, never a client.
+    import threading
+
+    r = tcp_fleet
+    victim = r.handle({"op": "solve", "digest": "conn-loss"})["worker"]
+    results = []
+    t = threading.Thread(target=lambda: results.append(r.handle(
+        {"op": "solve", "digest": "conn-loss", "sleep_s": 1.0}
+    )))
+    requeue_before = BUS.counters().get("fleet.requeue", 0)
+    t.start()
+    time.sleep(0.4)  # the request is inside the victim worker now
+    r.close_worker_connection(victim)
+    t.join(timeout=30)
+    assert results, "in-flight request lost on connection close"
+    resp = results[0]
+    assert resp["ok"] and resp["worker"] != victim
+    assert resp.get("requeued", 0) >= 1
+    assert BUS.counters().get("fleet.requeue", 0) >= requeue_before + 1
+    # Idempotency: the same digest keeps answering consistently afterwards.
+    again = r.handle({"op": "solve", "digest": "conn-loss"})
+    assert again["ok"]
+    deadline = time.monotonic() + 30
+    while time.monotonic() < deadline and not r._workers[victim].alive:
+        time.sleep(0.05)
+    assert r._workers[victim].alive
+
+
+def test_tcp_graceful_drain_answers_in_flight_and_exits_zero():
+    import threading
+
+    cfg = FleetConfig(
+        workers=1, test_echo=True, transport="tcp",
+        heartbeat_interval_s=0.2, ready_timeout_s=120.0,
+    )
+    r = FleetRouter(cfg).start()
+    results = []
+    t = threading.Thread(
+        target=lambda: results.append(
+            r.handle({"op": "solve", "digest": "inflight", "sleep_s": 0.5})
+        )
+    )
+    t.start()
+    time.sleep(0.2)
+    r.shutdown(drain=True)
+    t.join(timeout=10)
+    assert results and results[0]["ok"]  # drained, not dropped
+    assert r._workers[0].proc.returncode == 0
+
+
+def test_tcp_forwarding_probes_owner_before_local_solve():
+    # Cross-host cache-miss forwarding: worker 0 owns the lane subring,
+    # forwarding on (no shared disk). A digest solved at its full-ring
+    # owner and re-requested oversize forwards (hit, answered by the
+    # owner, no local solve); a fresh oversize digest probes the owner,
+    # misses, and solves locally at the lane worker.
+    cfg = FleetConfig(
+        workers=3, test_echo=True, transport="tcp",
+        sharded_lane_workers=1, forward_cache=True,
+        heartbeat_interval_s=0.2, ready_timeout_s=120.0,
+        request_timeout_s=30.0,
+    )
+    ring = HashRing(range(3), replicas=cfg.ring_replicas)
+    d_hit = next(f"fh-{i}" for i in range(1000)
+                 if ring.assign(f"fh-{i}") != 0)
+    d_miss = next(f"fm-{i}" for i in range(1000)
+                  if ring.assign(f"fm-{i}") != 0)
+    oversize = {"num_nodes": 200_000, "edges": [[0, 1, 1]]}
+    with FleetRouter(cfg) as r:
+        owner = r.handle({"op": "solve", "digest": d_hit})
+        assert owner["worker"] == ring.assign(d_hit)
+        fwd = r.handle({"op": "solve", "digest": d_hit, **oversize})
+        assert fwd["ok"] and fwd["cached"]
+        assert fwd["forwarded_from"] == owner["worker"]
+        local = r.handle({"op": "solve", "digest": d_miss, **oversize})
+        assert local["ok"] and local["worker"] == 0  # lane worker solved
+        assert "forwarded_from" not in local
+        counters = BUS.counters()
+        assert counters.get("fleet.forward.hit", 0) == 1
+        assert counters.get("fleet.forward.miss", 0) == 1
+        stats = r.handle({"op": "stats"})
+        assert stats["forward_cache"] is True
+
+
+def _spawn_listening_worker(extra_env=None):
+    env = {**os.environ, "PYTHONPATH": os.pathsep.join(
+        [os.path.dirname(os.path.dirname(os.path.abspath(__file__)))]
+        + os.environ.get("PYTHONPATH", "").split(os.pathsep)
+    ), **(extra_env or {})}
+    proc = subprocess.Popen(
+        [sys.executable, "-m",
+         "distributed_ghs_implementation_tpu.fleet.worker",
+         "--worker-id", "0", "--test-echo", "--listen", "127.0.0.1:0"],
+        stderr=subprocess.PIPE, env=env,
+    )
+    line = proc.stderr.readline().decode()
+    assert "listening on" in line, line
+    addr = line.rsplit(" ", 1)[-1].strip()
+    return proc, addr
+
+
+def test_remote_listen_worker_survives_partition_with_warm_rejoin():
+    # The remote topology: an externally started `--listen` worker the
+    # router dials by host:port. A hard connection close (network
+    # partition) re-queues + reconnects to the SAME process — state
+    # (echo.handled) proves the rejoin was warm, not a cold restart.
+    proc, addr = _spawn_listening_worker()
+    try:
+        cfg = FleetConfig(
+            remote_workers=(addr,), transport="tcp", test_echo=True,
+            heartbeat_interval_s=0.1, restart_backoff_base_s=0.02,
+            ready_timeout_s=30.0, request_timeout_s=30.0,
+        )
+        with FleetRouter(cfg) as r:
+            for i in range(5):
+                assert r.handle({"op": "solve", "digest": f"r{i}"})["ok"]
+            r.close_worker_connection(0)
+            deadline = time.monotonic() + 20
+            while time.monotonic() < deadline and not r._workers[0].alive:
+                time.sleep(0.05)
+            assert r._workers[0].alive, "router never re-dialed the worker"
+            after = r.handle({"op": "solve", "digest": "post-partition"})
+            assert after["ok"]
+            stats = r.handle({"op": "stats"})
+            handled = stats["counters"].get("echo.handled", 0)
+            # > 2: the pre-partition requests still count — same process.
+            assert handled >= 6, f"cold restart suspected: handled={handled}"
+            assert stats["workers"]["0"]["addr"] == addr
+        # shutdown() drained the remote worker: it exits 0.
+        assert proc.wait(timeout=30) == 0
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+
+
+def test_router_rejects_wrong_protocol_version_with_clear_error():
+    # A worker advertising the wrong fleet protocol version must be
+    # rejected at hello with an actionable message — not a silent ready
+    # timeout. GHS_FLEET_PROTO is the test hook that fakes an old build.
+    cfg = FleetConfig(
+        workers=1, test_echo=True, transport="tcp",
+        ready_timeout_s=6.0, max_restarts=1,
+        worker_env={0: {"GHS_FLEET_PROTO": "999"}},
+    )
+    router = FleetRouter(cfg)
+    with pytest.raises(TimeoutError, match="protocol version mismatch"):
+        router.start()
+    router.shutdown(drain=False)

@@ -108,6 +108,13 @@ KINDS = {
     "drain_errors": "exact",
     "stream_resets": "exact",
     "fresh_solves": "exact",
+    # gate-fleet-tcp-v1 (bench.py --fleet-tcp): the forwarding scenario is
+    # fully deterministic (pre-screened digests, echo workers) — a changed
+    # hit/miss count means the router's forwarding decision logic changed,
+    # never jitter. router_hop_*_s keys need no override: the _s suffix
+    # already gates them as wall-time ceilings.
+    "forward_hit": "exact",
+    "forward_miss": "exact",
     # gate-stream-bench-v1 (bench.py --update-stream): the windowed-vs-
     # sequential ratio is a wall-clock pair — gate as a throughput floor.
     "window_speedup": "throughput",

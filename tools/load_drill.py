@@ -609,7 +609,15 @@ def _run_drill(args, resources: dict) -> dict:
         resources["disk_tmp"] = tempfile.mkdtemp(prefix="ghs-fleet-store-")
         config = FleetConfig(
             workers=args.fleet,
-            batch_lanes=args.lanes,
+            # The transport under test: "pipe" (round-12 subprocess pipes)
+            # or "tcp" (localhost sockets through the round-16 transport —
+            # dial-in hello registration, coalesced pipelined writes,
+            # connection-loss death detection). Same deck, same checks;
+            # the per-class router_hop_s section is the pipe-vs-TCP
+            # overhead number.
+            transport=args.transport,
+            test_echo=args.test_echo,
+            batch_lanes=0 if args.test_echo else args.lanes,
             batch_wait_s=args.batch_wait,
             max_sessions=256,
             store_capacity=max(256, len(schedule)),
@@ -866,6 +874,21 @@ def _run_drill(args, resources: dict) -> dict:
     # per-worker breakdown).
     summary = slo.summarize_bus(BUS, wall_s=wall_s)
     client = client_summary(records, wall_s)
+    router_hop = {}
+    if fleet_router is not None:
+        # Router-hop latency (send-to-response minus in-worker service
+        # time — transport + queueing overhead) joins the shared SLO
+        # section per class, so pipe-vs-TCP cost is a tracked number in
+        # every fleet report.
+        for name, hist in BUS.histograms().items():
+            if not name.startswith("fleet.hop_s") or not hist.get("count"):
+                continue
+            cls = name[len("fleet.hop_s."):] if name != "fleet.hop_s" else None
+            if cls is None:
+                summary["totals"]["router_hop_s"] = hist
+                router_hop = hist
+            elif cls in summary["classes"]:
+                summary["classes"][cls]["router_hop_s"] = hist
     if fleet_router is not None:
         # Worker counters live in the worker processes; the window's share
         # is the post-minus-pre delta per (worker, incarnation). A killed
@@ -1069,7 +1092,13 @@ def _run_drill(args, resources: dict) -> dict:
     if args.fleet:
         config["fleet"] = args.fleet
         config["kill_worker"] = args.kill_worker
+        config["transport"] = args.transport
+        if args.test_echo:
+            config["test_echo"] = True
     extra_metrics = {"lost_accepted": lost, "answered": answered}
+    if router_hop:
+        extra_metrics["router_hop_p50_s"] = router_hop.get("p50", 0.0)
+        extra_metrics["router_hop_p95_s"] = router_hop.get("p95", 0.0)
     if args.update_heavy:
         extra_metrics["notify_gaps"] = notify_gaps
         extra_metrics["notify_dups"] = notify_dups
@@ -1127,6 +1156,7 @@ def _run_drill(args, resources: dict) -> dict:
     if fleet_router is not None:
         report["fleet"] = {
             "workers": args.fleet,
+            "transport": args.transport,
             "counters": fleet_counters,
             "session_resets": resets,
             "rejoined": rejoined,
@@ -1207,6 +1237,18 @@ def main(argv=None) -> int:
                    "K mid-window (it dies in place of its next request); "
                    "the drill then asserts zero lost accepted queries, "
                    "re-queue, restart-with-backoff, and goodput recovery")
+    p.add_argument("--transport", choices=("pipe", "tcp"), default="pipe",
+                   help="with --fleet: the router<->worker channel — "
+                   "subprocess pipes (round 12) or localhost TCP sockets "
+                   "through fleet/transport.py (dial-in hello "
+                   "registration, coalesced pipelined frame writes, "
+                   "connection-loss + lease-expiry death detection); the "
+                   "report's per-class router_hop_s tracks the overhead "
+                   "difference")
+    p.add_argument("--test-echo", action="store_true",
+                   help="with --fleet: spawn jax-free echo workers (canned "
+                   "answers, full transport/failover fidelity) — the CI "
+                   "TCP kill drill's mode")
     p.add_argument("--obs-dir",
                    help="with --fleet: per-worker obs JSONL exports land "
                    "here on drain (worker<K>.<incarnation>.jsonl)")
@@ -1226,6 +1268,11 @@ def main(argv=None) -> int:
         not args.fleet or not 0 <= args.kill_worker < args.fleet
     ):
         p.error("--kill-worker needs --fleet N with 0 <= K < N")
+    if args.test_echo and not args.fleet:
+        p.error("--test-echo needs --fleet N (it is a worker mode)")
+    if args.test_echo and args.update_heavy:
+        p.error("--test-echo cannot run --update-heavy (echo workers have "
+                "no stream layer)")
 
     report = run_drill(args)
     if args.output:
