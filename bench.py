@@ -426,6 +426,14 @@ def run_fleet_tcp_bench(args) -> int:
       different worker) drives exactly ``--fleet-forward`` probes down
       each path. A changed count means the forwarding decision logic
       changed, never jitter.
+    * **elastic churn** — one warm join + one drain-aware retire on a TCP
+      echo fleet: the joiner must own its ring share and serve it, the
+      retiree must exit 0 with its keyspace answerable by survivors, and
+      the whole exchange must register zero worker deaths.
+      ``elastic_scale_up`` / ``elastic_scale_down`` /
+      ``elastic_unplanned_deaths`` gate EXACTLY (1/1/0);
+      ``elastic_join_warm_s`` (spawn -> warmed hello -> ring entry) gates
+      as a wall-time ceiling.
 
     Echo workers make this bench CI-cheap (~seconds, no jax import) while
     exercising the real router, real sockets, real framing, and the real
@@ -534,6 +542,49 @@ def run_fleet_tcp_bench(args) -> int:
         )
         return 1
 
+    # Elastic churn (deterministic): one warm join, one drain-aware
+    # retire, on a fresh TCP fleet. The joiner serves its own ring share
+    # BEFORE the retire (proving ring entry was real, not cosmetic); the
+    # retiree's keyspace stays answerable afterwards; and a planned
+    # departure must never read as a death.
+    BUS.clear()
+    cfg = FleetConfig(
+        workers=2, test_echo=True, transport="tcp",
+        heartbeat_interval_s=0.25, ready_timeout_s=120.0,
+        request_timeout_s=60.0,
+    )
+    with FleetRouter(cfg) as router:
+        for i in range(8):
+            router.handle({"op": "solve", "digest": f"pre-{i}"})
+        joined = router.add_worker()
+        ring3 = HashRing(range(3), replicas=cfg.ring_replicas)
+        d_new = next(f"el-{i}" for i in range(1000)
+                     if ring3.assign(f"el-{i}") == joined["worker"])
+        served = router.handle({"op": "solve", "digest": d_new})
+        if not (served.get("ok")
+                and served.get("worker") == joined["worker"]):
+            print(f"ELASTIC JOIN FAILED: {served}", file=sys.stderr)
+            return 1
+        retired = router.retire_worker(joined["worker"])
+        if retired["exit_code"] != 0:
+            print(f"ELASTIC RETIRE FAILED: {retired}", file=sys.stderr)
+            return 1
+        handoff = router.handle({"op": "solve", "digest": d_new})
+        if not handoff.get("ok") or handoff.get("worker") == joined["worker"]:
+            print(f"ELASTIC HANDOFF FAILED: {handoff}", file=sys.stderr)
+            return 1
+    counters = BUS.counters()
+    elastic_up = int(counters.get("fleet.scale.up", 0))
+    elastic_down = int(counters.get("fleet.scale.down", 0))
+    elastic_deaths = int(counters.get("fleet.worker.dead", 0))
+    if elastic_up != 1 or elastic_down != 1 or elastic_deaths != 0:
+        print(
+            f"ELASTIC COUNTERS WRONG: up {elastic_up} down {elastic_down} "
+            f"deaths {elastic_deaths} (expected 1/1/0)",
+            file=sys.stderr,
+        )
+        return 1
+
     out = {
         "metric": f"fleet router hop, {workers} echo workers, "
         f"{n_seq} sequential + {n_burst} burst requests",
@@ -545,6 +596,9 @@ def run_fleet_tcp_bench(args) -> int:
         "router_hop_pipe_p95_s": round(hops["pipe"]["p95"], 6),
         "forward_hit": forward_hit,
         "forward_miss": forward_miss,
+        "elastic_join_warm_s": round(joined["warm_s"], 6),
+        "elastic_scale_up": elastic_up,
+        "elastic_scale_down": elastic_down,
     }
     print(json.dumps(out))
     if args.metrics_out:
@@ -555,6 +609,10 @@ def run_fleet_tcp_bench(args) -> int:
             "router_hop_pipe_p95_s": hops["pipe"]["p95"],
             "forward_hit": forward_hit,
             "forward_miss": forward_miss,
+            "elastic_join_warm_s": joined["warm_s"],
+            "elastic_scale_up": elastic_up,
+            "elastic_scale_down": elastic_down,
+            "elastic_unplanned_deaths": elastic_deaths,
             "fleet_requests": 2 * (n_seq + n_burst + 16),
         }
         with open(args.metrics_out, "w") as f:

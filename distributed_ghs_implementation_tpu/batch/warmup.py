@@ -29,6 +29,13 @@ query phase must add no ``compile.miss`` counts (``tools/serve_drill.py
 --warmup-smoke`` gates exactly this in CI). Pair with the persistent XLA
 compile cache (``utils/compile_cache.py``) and even the warmup compiles
 are disk reads after the first boot.
+
+The elastic fleet (``fleet/autoscaler.py``) leans on exactly this: a
+*joining* worker runs the same ladder (the router's ``_worker_argv``
+forwards the fleet's warmup flags to every spawn, scale-ups included) and
+only advertises ``warmed`` in its hello afterwards — so scale-up is warm
+handoff by construction, and :func:`summarize_report` is the compact
+what-did-the-joiner-warm record the hello carries for the stats op.
 """
 
 from __future__ import annotations
@@ -341,6 +348,27 @@ def merge_plans(*plans: WarmupPlan) -> WarmupPlan:
         stream_buckets=tuple(stream_buckets),
         kernel=kernel,
     )
+
+
+def summarize_report(report: Optional[dict]) -> Optional[dict]:
+    """Compact warmup facts for the fleet hello (``caps["warmup"]``).
+
+    A joining worker's hello should say *what* it warmed (so the stats op
+    can show an operator why the join took ``fleet.join.warm_s``) without
+    shipping the whole report over the wire on every connection.
+    ``None`` in, ``None`` out — a service booted without a plan has
+    nothing to summarize.
+    """
+    if not report:
+        return None
+    return {
+        "buckets": report.get("buckets", 0),
+        "single_warmed": report.get("single_warmed", 0),
+        "mesh_warmed": report.get("mesh_warmed", 0),
+        "stream_warmed": report.get("stream_warmed", 0),
+        "kernel": report.get("kernel"),
+        "wall_s": round(float(report.get("wall_s", 0.0)), 3),
+    }
 
 
 # ----------------------------------------------------------------------

@@ -359,6 +359,8 @@ def _cmd_serve(args) -> int:
         set_default_kernel(args.kernel)
         os.environ["GHS_KERNEL"] = args.kernel
 
+    if args.fleet_elastic and not (args.fleet or args.fleet_workers):
+        raise SystemExit("--fleet-elastic needs --fleet N")
     if args.fleet or args.fleet_workers:
         from distributed_ghs_implementation_tpu.fleet.router import (
             FleetConfig,
@@ -408,6 +410,39 @@ def _cmd_serve(args) -> int:
             stream_dir=args.stream_dir,
             stream_snapshot_every=args.stream_snapshot_every,
         )
+        autoscaler = None
+        if args.fleet_elastic:
+            from distributed_ghs_implementation_tpu.fleet.autoscaler import (
+                Autoscaler,
+                ElasticPolicy,
+                parse_class_budgets,
+            )
+
+            mn, _, mx = args.fleet_elastic.partition(":")
+            try:
+                policy = ElasticPolicy(
+                    min_workers=int(mn),
+                    max_workers=int(mx),
+                    wait_budget_s=args.fleet_scale_budget,
+                    class_budgets_s=parse_class_budgets(
+                        args.fleet_scale_budgets or ""
+                    ),
+                    cooldown_s=args.fleet_scale_cooldown,
+                )
+            except ValueError as e:
+                raise SystemExit(f"--fleet-elastic: {e}")
+            if remote:
+                raise SystemExit(
+                    "--fleet-elastic needs spawnable workers; a "
+                    "--fleet-workers remote topology is fixed by its "
+                    "endpoint list"
+                )
+            if not policy.min_workers <= config.workers <= policy.max_workers:
+                raise SystemExit(
+                    f"--fleet {config.workers} must sit inside "
+                    f"--fleet-elastic {policy.min_workers}:"
+                    f"{policy.max_workers}"
+                )
         # Workers enable the (shared, machine-fingerprinted) persistent
         # compile cache and run warmup themselves; the router never
         # compiles, so none of that happens in this process.
@@ -416,13 +451,21 @@ def _cmd_serve(args) -> int:
                 f"fleet: {config.workers} workers ready over "
                 f"{config.transport} (queue_depth={config.queue_depth}"
                 + (", forward_cache on" if config.forward_enabled else "")
+                + (f", elastic {args.fleet_elastic}"
+                   if args.fleet_elastic else "")
                 + ")",
                 file=sys.stderr,
             )
-            if args.input:
-                with open(args.input) as f:
-                    return serve_loop(f, sys.stdout, router)
-            return serve_loop(sys.stdin, sys.stdout, router)
+            if args.fleet_elastic:
+                autoscaler = Autoscaler(router, policy).start()
+            try:
+                if args.input:
+                    with open(args.input) as f:
+                        return serve_loop(f, sys.stdout, router)
+                return serve_loop(sys.stdin, sys.stdout, router)
+            finally:
+                if autoscaler is not None:
+                    autoscaler.close()
 
     # Persistent compile cache first (default ON for serve): config must
     # land before the first compile — warmup's included.
@@ -772,6 +815,30 @@ def build_parser() -> argparse.ArgumentParser:
         "worker with a cached_only frame before solving locally "
         "(fleet.forward.hit/miss). auto = on for TCP fleets without a "
         "shared --disk-cache, off elsewhere",
+    )
+    srv.add_argument(
+        "--fleet-elastic", metavar="MIN:MAX",
+        help="with --fleet: drive the worker pool between MIN and MAX via "
+        "the obs-driven autoscaler (fleet/autoscaler.py) — scale-up on a "
+        "per-class wait-budget breach or queue-depth watermark, joins "
+        "warm-gated on the worker's 'warmed' hello; scale-down on "
+        "sustained idle by draining the lowest-affinity worker "
+        "(docs/FLEET.md \"Elasticity\")",
+    )
+    srv.add_argument(
+        "--fleet-scale-budget", type=float, default=0.25, metavar="SECONDS",
+        help="with --fleet-elastic: default per-class request-latency "
+        "budget whose tick-window p99 breach triggers scale-up",
+    )
+    srv.add_argument(
+        "--fleet-scale-budgets", metavar="CLS=S,...",
+        help="with --fleet-elastic: per-class budget overrides, e.g. "
+        "interactive=0.05,bulk=2",
+    )
+    srv.add_argument(
+        "--fleet-scale-cooldown", type=float, default=2.0, metavar="SECONDS",
+        help="with --fleet-elastic: minimum seconds between scale events "
+        "(hysteresis; scale steps are always by one worker)",
     )
     srv.add_argument(
         "--fleet-lease", type=float, default=None, metavar="SECONDS",

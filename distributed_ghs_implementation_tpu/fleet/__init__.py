@@ -10,10 +10,16 @@ Workers speak length-prefixed frames (``fleet/framing.py``) over either
 subprocess pipes or TCP sockets (``fleet/transport.py`` — coalesced
 pipelined writes, dial-in hello registration, host:port addressing), so
 the fleet is no longer bound to one machine; cross-host cache misses
-forward to the digest-owner worker before solving locally.
+forward to the digest-owner worker before solving locally. The pool is
+elastic (``fleet/autoscaler.py``): an obs-driven control loop grows it
+with warm-handoff joins and shrinks it with drain-aware retires.
 ``docs/FLEET.md`` covers topology, failure modes, and drill recipes.
 """
 
+from distributed_ghs_implementation_tpu.fleet.autoscaler import (
+    Autoscaler,
+    ElasticPolicy,
+)
 from distributed_ghs_implementation_tpu.fleet.hashing import HashRing
 from distributed_ghs_implementation_tpu.fleet.router import (
     FleetConfig,
@@ -30,6 +36,8 @@ from distributed_ghs_implementation_tpu.fleet.transport import (
 )
 
 __all__ = [
+    "Autoscaler",
+    "ElasticPolicy",
     "FleetConfig",
     "FleetRouter",
     "HashRing",

@@ -13,6 +13,14 @@ Determinism is load-bearing: ring points are sha256 of ``"{member}#{i}"``
 across router restarts and across machines. A restarted fleet re-routes
 every digest to the worker whose shared-disk-store entries and compile
 cache it warmed last time.
+
+Churn-safe by construction: :meth:`HashRing.add` is idempotent (a member
+already on the ring gains no duplicate points — an autoscaler join racing
+a restart rejoin cannot double a worker's keyspace share) and
+:meth:`HashRing.remove` of an absent member is a no-op (a retire racing a
+death-path removal cannot corrupt the point list). The elastic fleet
+(``fleet/autoscaler.py``) adds and removes members continuously, so both
+properties are pinned by the churn tests in ``tests/test_fleet.py``.
 """
 
 from __future__ import annotations
@@ -46,6 +54,8 @@ class HashRing:
         return {m for _, m in self._points}
 
     def add(self, member: int) -> None:
+        if any(m == member for _, m in self._points):
+            return  # idempotent under churn: never duplicate ring points
         for i in range(self.replicas):
             bisect.insort(self._points, (_point(f"{member}#{i}"), member))
 

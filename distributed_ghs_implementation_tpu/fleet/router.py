@@ -36,6 +36,20 @@ What the router adds is exactly what one process cannot have:
 * **Graceful drain** — :meth:`FleetRouter.shutdown` stops admitting, sends
   every worker a drain frame, and waits for in-flight responses to flush
   before the processes exit 0.
+* **Elastic pool primitives** — :meth:`FleetRouter.add_worker` grows the
+  pool by one *warm* worker (spawned with the fleet's warmup flags; it
+  enters the hash ring only after a hello whose ``warmed`` capability is
+  confirmed — a cold worker can never be routed interactive traffic;
+  ``fleet.scale.up``, ``fleet.join.warm_s``) and
+  :meth:`FleetRouter.retire_worker` shrinks it by one (victim = lowest
+  forwarding-affinity worker unless pinned; removed from the ring first,
+  in-flight accepted work drains, pinned update/stream sessions migrate to
+  their ring inheritors — who replay from the shared disk store / stream
+  WAL exactly like failover — then a drain frame and exit 0;
+  ``fleet.scale.down``). A worker in graceful drain is exempt from lease
+  expiry: the heartbeat loop skips draining workers entirely, so a slow
+  drain can never be mistaken for a death and re-queued mid-flush.
+  ``fleet/autoscaler.py`` drives both primitives from the obs bus.
 
 Telemetry (router-process bus): ``fleet.request`` spans carry ``cls`` /
 ``worker`` / ``ok`` — ``obs.slo`` joins them into per-class AND per-worker
@@ -52,6 +66,7 @@ from __future__ import annotations
 
 import collections
 import dataclasses
+import hashlib
 import os
 import subprocess
 import sys
@@ -144,6 +159,14 @@ class FleetConfig:
     heartbeat_miss_threshold: int = 20
     restart_backoff_base_s: float = 0.05
     restart_backoff_cap_s: float = 2.0
+    # Restart jitter: each backoff is scaled by (1 - restart_jitter * u)
+    # with u in [0,1) derived deterministically from (seed, worker,
+    # attempt), so a MASS death (or mass scale-up rejoin) fans restarts
+    # out over the backoff window instead of stampeding the shared disk
+    # store and compile cache in lockstep — while staying reproducible
+    # under a seed and never exceeding the documented cap.
+    restart_jitter: float = 0.5
+    restart_jitter_seed: int = 0
     max_restarts: int = 8  # per worker slot, cumulative
     request_timeout_s: float = 300.0
     ready_timeout_s: float = 120.0
@@ -235,6 +258,11 @@ class _Worker:
         self.restarts = 0
         self.caps: Dict[str, object] = {}  # from the hello frame
         self.lane_advertised = False  # caps["lane"]
+        # Elastic lifecycle: ``draining`` = mid-retire (off the ring,
+        # flushing in-flight work — exempt from lease expiry); ``retired``
+        # = gone on purpose (never restarted, never counted dead).
+        self.draining = False
+        self.retired = False
 
 
 class FleetRouter:
@@ -286,6 +314,11 @@ class FleetRouter:
         self._heartbeat: Optional[threading.Thread] = None
         self._listener: Optional[WorkerListener] = None
         self._hello_rejections: List[str] = []  # surfaced on ready timeout
+        # Serializes pool mutations (add_worker / retire_worker): scale
+        # operations are deliberately one-at-a-time — the hysteresis the
+        # autoscaler's determinism rests on.
+        self._pool_lock = threading.Lock()
+        self.last_scale_decision: Optional[dict] = None
 
     # -- lifecycle -----------------------------------------------------
     def start(self) -> "FleetRouter":
@@ -670,6 +703,16 @@ class FleetRouter:
                     return
                 if not (w.alive and w.ready.is_set()):
                     continue
+                if w.draining:
+                    # A worker in graceful drain stops reading its channel
+                    # on purpose — silence is the PROTOCOL there, not a
+                    # wedge. Lease expiry on a draining worker would
+                    # declare it dead mid-flush and re-queue work it is
+                    # about to answer (duplicate solves, a spurious
+                    # fleet.worker.dead in a planned scale-down), so the
+                    # heartbeat skips it entirely; retire_worker owns its
+                    # deadline.
+                    continue
                 age = time.monotonic() - w.last_pong
                 if age > lease_s:
                     # The channel is still open but the worker went silent
@@ -690,12 +733,18 @@ class FleetRouter:
 
     def _on_death(self, w: _Worker, incarnation: int) -> None:
         """Declare one incarnation dead exactly once: fail over its pending
-        requests, drop its ring share + session pins, schedule a restart."""
+        requests, drop its ring share + session pins, schedule a restart.
+        A *retiring* worker's channel EOF lands here too — that exit is on
+        purpose (drain frame sent, responses flushed), so it closes the
+        slot quietly: no death counter, no kill, no restart."""
         with self._ring_lock:
             if w.incarnation != incarnation or not w.alive:
                 return
             w.alive = False
             w.ready.clear()
+            retiring = w.draining
+            if retiring:
+                w.retired = True
             self._ring.remove(w.id)
             if w.id in self._lane_ids:
                 self._lane_ring.remove(w.id)
@@ -719,6 +768,22 @@ class FleetRouter:
             # peer's full TCP window here would stall the heartbeat thread
             # (and every other worker's failover) for the flush timeout.
             transport.close(flush=False)
+        if retiring:
+            # Planned exit: retire_worker() owns the reap and the
+            # fleet.scale.down accounting. Anything still pending (the
+            # drain deadline fired with work in flight) re-queues onto
+            # survivors — retirement must uphold zero-loss like any other
+            # departure.
+            if orphans and not self._closed:
+                self._redispatch(orphans)
+            elif orphans:
+                for p in orphans:
+                    p.response = {
+                        "ok": False, "error": "fleet shutting down",
+                        "op": p.request.get("op"),
+                    }
+                    p.event.set()
+            return
         if not self._closed:  # drained workers EOF on purpose: not a death
             BUS.count("fleet.worker.dead")
             BUS.instant("fleet.worker.death", cat="fleet", worker=w.id,
@@ -761,16 +826,44 @@ class FleetRouter:
                 p.response = err
                 p.event.set()
 
+    def _backoff_s(self, worker_id: int, attempt: int) -> float:
+        """The jittered restart backoff for one (worker, attempt) pair.
+
+        Capped exponential, then scaled DOWN by a deterministic per-pair
+        jitter: ``sha256(seed:worker:attempt)`` -> u in [0,1), backoff *=
+        (1 - restart_jitter * u). Scaling down (never up) keeps the cap a
+        real ceiling while desynchronizing a mass death's restart wave —
+        N workers that died together stop hammering the shared disk store
+        and compile cache at the same instant. Fully reproducible under
+        ``restart_jitter_seed`` (the property the jitter test pins)."""
+        cfg = self.config
+        backoff = min(
+            cfg.restart_backoff_base_s * (2 ** attempt),
+            cfg.restart_backoff_cap_s,
+        )
+        if cfg.restart_jitter <= 0:
+            return backoff
+        token = f"{cfg.restart_jitter_seed}:{worker_id}:{attempt}"
+        u = int.from_bytes(
+            hashlib.sha256(token.encode("utf-8")).digest()[:8], "big"
+        ) / float(1 << 64)
+        return backoff * (1.0 - cfg.restart_jitter * u)
+
     def _restart(self, w: _Worker) -> None:
         cfg = self.config
         while not self._closed:
+            if w.retired:
+                return  # a planned departure is never restarted
             if w.restarts >= cfg.max_restarts:
                 BUS.count("fleet.worker.abandoned")
+                # The slot is gone for good — it must leave pool_size(),
+                # or the autoscaler would forever count phantom capacity
+                # and refuse to scale up past a crash-looped worker
+                # ("already at max" while real capacity is below it).
+                with self._ring_lock:
+                    w.retired = True
                 return
-            backoff = min(
-                cfg.restart_backoff_base_s * (2 ** w.restarts),
-                cfg.restart_backoff_cap_s,
-            )
+            backoff = self._backoff_s(w.id, w.restarts)
             w.restarts += 1
             time.sleep(backoff)
             if self._closed:
@@ -801,6 +894,250 @@ class FleetRouter:
                     w.proc.kill()
                 if w.transport is not None:
                     w.transport.close()
+
+    # -- elastic pool (fleet/autoscaler.py drives these) ---------------
+    def pool_size(self) -> int:
+        """Worker slots currently in (or rejoining) the pool: everything
+        not retired and not mid-drain. A slot whose process is restarting
+        still counts — the pool's *intent* is N workers; the autoscaler
+        must not scale up just because a restart is in flight."""
+        return sum(
+            1 for w in self._workers if not w.retired and not w.draining
+        )
+
+    def queue_depths(self) -> Dict[int, int]:
+        """Live per-worker in-flight depth (the autoscaler's queue-pressure
+        signal; draining/retired slots excluded — their depth is drain
+        progress, not demand)."""
+        return {
+            w.id: len(w.pending)
+            for w in self._workers
+            if w.alive and not w.draining
+        }
+
+    def note_scale_decision(self, decision: dict) -> None:
+        """Record the latest scale decision (the stats op reports it, so an
+        operator can see WHY the fleet is its current size)."""
+        self.last_scale_decision = dict(decision)
+
+    def add_worker(
+        self, *, addr: Optional[str] = None,
+        timeout_s: Optional[float] = None,
+    ) -> dict:
+        """Grow the pool by one WARM worker; returns ``{"worker", "warm_s"}``.
+
+        The joiner is spawned with the fleet's full flag set — shared disk
+        store, persistent compile cache, warmup buckets — so it pre-seeds
+        and precompiles before saying hello, and it enters the hash ring
+        only once its hello's ``warmed`` capability is confirmed: scale-up
+        can never route interactive traffic at a cold worker. The warm
+        join wall time lands on ``fleet.join.warm_s`` (the elastic gate
+        bounds its p95) and the join counts ``fleet.scale.up``.
+
+        ``addr`` instead DIALS an externally started ``--listen`` worker
+        (an operator bringing standby capacity into a remote fleet: the
+        same warm gate applies — that worker's service, caches, and warmup
+        already exist, which is the whole point of a standby).
+        """
+        if self.config.remote_workers and addr is None:
+            raise ValueError(
+                "add_worker spawns processes; growing a --fleet-workers "
+                "remote topology needs the standby's endpoint: "
+                "add_worker(addr='host:port')"
+            )
+        if addr is not None and self.config.transport != "tcp":
+            raise ValueError("dialing a remote joiner needs transport='tcp'")
+        if self._closed or not self._started:
+            raise RuntimeError("router is not running")
+        with self._pool_lock:
+            t0 = time.monotonic()
+            w = _Worker(len(self._workers), self.config.queue_depth,
+                        addr=addr)
+            if self.config.sharded_lane_workers == -1 and addr is None:
+                # "-1 = every worker" includes workers that join later.
+                self._lane_ids.add(w.id)
+            self._workers.append(w)
+            if addr is not None:
+                threading.Thread(
+                    target=self._connect_remote, args=(w,),
+                    name=f"fleet-dial-{w.id}", daemon=True,
+                ).start()
+            else:
+                self._spawn(w)
+            deadline = timeout_s or self.config.ready_timeout_s
+            if not w.ready.wait(deadline):
+                self._abandon_join(w)
+                BUS.count("fleet.scale.failed")
+                rejections = "; ".join(self._hello_rejections[-3:])
+                raise TimeoutError(
+                    f"joining worker {w.id} not ready within {deadline}s"
+                    + (f" (hello rejected: {rejections})" if rejections
+                       else "")
+                )
+            if not w.caps.get("warmed", False):
+                self._abandon_join(w)
+                BUS.count("fleet.join.cold_rejected")
+                raise RuntimeError(
+                    f"joining worker {w.id} said hello without the "
+                    f"'warmed' capability — a cold joiner would serve "
+                    f"cold p99s, refusing ring entry"
+                )
+            warm_s = time.monotonic() - t0
+            with self._ring_lock:
+                w.alive = True
+                w.last_pong = time.monotonic()
+                self._ring.add(w.id)
+                if addr is not None and w.lane_advertised:
+                    # A dialed standby declares its own lane capability.
+                    self._lane_ids.add(w.id)
+                if w.id in self._lane_ids:
+                    self._lane_ring.add(w.id)
+            BUS.count("fleet.scale.up")
+            BUS.record("fleet.join.warm_s", warm_s)
+            BUS.instant("fleet.join", cat="fleet", worker=w.id,
+                        warm_s=round(warm_s, 4),
+                        warmup=w.caps.get("warmup"))
+            return {"worker": w.id, "warm_s": warm_s}
+
+    def _abandon_join(self, w: _Worker) -> None:
+        """A join that never got warm: close the slot without it ever
+        having owned keyspace (it was never on the ring)."""
+        with self._ring_lock:
+            w.retired = True
+            w.draining = False
+            w.alive = False
+            w.ready.clear()
+        with w.lock:
+            proc, transport = w.proc, w.transport
+        if proc is not None and proc.poll() is None:
+            try:
+                proc.kill()
+            except OSError:
+                pass
+        if transport is not None:
+            transport.close(flush=False)
+
+    def retire_worker(
+        self, worker_id: Optional[int] = None, *, timeout_s: float = 30.0
+    ) -> dict:
+        """Drain one worker out of the pool (scale-down); returns
+        ``{"worker", "sessions_moved", "exit_code"}``.
+
+        Victim (when not pinned by ``worker_id``): the live worker with the
+        fewest ``_last_served`` affinity entries — the one whose warm
+        result cache the fleet would miss least; ties retire the youngest
+        slot (a recent joiner before a long-warmed original). Sequence:
+
+        1. off the ring immediately (its keyspace hands off with bounded
+           movement; no NEW work routes at it) and marked ``draining`` —
+           the heartbeat loop now ignores it, so a slow drain cannot trip
+           ``fleet.lease.expired`` and re-queue work mid-flush;
+        2. in-flight accepted work drains (bounded by ``timeout_s``;
+           whatever outlives the deadline re-queues onto survivors at EOF
+           — zero loss either way);
+        3. pinned update/stream session digests unpin — their ring
+           inheritors recover state exactly like failover does: disk-store
+           reads for results, snapshot+WAL replay for streams (zero fresh
+           solves, the contract the elastic drill gates);
+        4. a drain frame: the worker stops reading, flushes every
+           response, exports its obs JSONL, and exits 0.
+        """
+        with self._pool_lock:
+            with self._ring_lock:
+                live = [
+                    w for w in self._workers
+                    if w.alive and w.ready.is_set()
+                    and not w.draining and not w.retired
+                ]
+                if worker_id is not None:
+                    w = self._workers[worker_id]
+                    if w.retired or w.draining or not w.alive:
+                        raise ValueError(
+                            f"worker {worker_id} is not live "
+                            f"(retired={w.retired}, draining={w.draining})"
+                        )
+                else:
+                    if not live:
+                        raise ValueError("no live worker to retire")
+                    affinity: Dict[int, int] = {}
+                    for wid in self._last_served.values():
+                        affinity[wid] = affinity.get(wid, 0) + 1
+                    w = min(
+                        live,
+                        key=lambda c: (affinity.get(c.id, 0), -c.id),
+                    )
+                if len(live) <= 1:
+                    raise ValueError("cannot retire the last live worker")
+                w.draining = True
+                self._ring.remove(w.id)
+                self._lane_ring.remove(w.id)
+                for digest in [
+                    d for d, wid in self._last_served.items()
+                    if wid == w.id
+                ]:
+                    # Its in-memory warm copies leave with it; survivors
+                    # fall back to the shared disk store (or a forward
+                    # miss + local solve across hosts).
+                    del self._last_served[digest]
+            deadline = time.monotonic() + timeout_s
+            flushed = True
+            while time.monotonic() < deadline:
+                with w.lock:
+                    if not w.pending:
+                        break
+                time.sleep(0.02)
+            else:
+                flushed = False  # EOF re-queue covers what's left
+            with self._ring_lock:
+                moved = [
+                    d for d, wid in self._sessions.items() if wid == w.id
+                ]
+                for d in moved:
+                    del self._sessions[d]
+            with w.lock:
+                transport = w.transport
+                proc = w.proc
+            if transport is not None and not transport.closed:
+                try:
+                    transport.send({"drain": True})
+                except OSError:
+                    pass
+            # The reap gets a grace floor beyond the flush deadline: work
+            # that outlived timeout_s re-queues at EOF anyway, but a drain
+            # that is ALMOST done should exit 0, not eat a SIGKILL at the
+            # buzzer.
+            exit_code = None
+            if proc is not None:
+                try:
+                    proc.wait(timeout=max(10.0, deadline - time.monotonic()))
+                except subprocess.TimeoutExpired:
+                    proc.kill()
+                    proc.wait(timeout=5.0)
+                exit_code = proc.returncode
+            elif transport is not None:
+                t_end = max(time.monotonic() + 10.0, deadline)
+                while time.monotonic() < t_end and not transport.closed:
+                    time.sleep(0.02)
+            with self._ring_lock:
+                # The reader's EOF normally lands in _on_death and marks
+                # these; make retirement unconditional even if the reader
+                # thread lost the race.
+                w.alive = False
+                w.retired = True
+                w.ready.clear()
+            if transport is not None:
+                transport.close(flush=False)
+            BUS.count("fleet.scale.down")
+            BUS.instant(
+                "fleet.retire", cat="fleet", worker=w.id,
+                sessions_moved=len(moved), flushed=flushed,
+                exit_code=exit_code,
+            )
+            return {
+                "worker": w.id,
+                "sessions_moved": len(moved),
+                "exit_code": exit_code,
+            }
 
     # -- routing + dispatch --------------------------------------------
     def _routing_key(self, request: dict) -> Optional[str]:
@@ -859,7 +1196,10 @@ class FleetRouter:
                     return self._workers[self._ring.assign(key)]
                 except LookupError:
                     return None
-            live = [w for w in self._workers if w.alive and w.ready.is_set()]
+            live = [
+                w for w in self._workers
+                if w.alive and w.ready.is_set() and not w.draining
+            ]
             if not live:
                 return None
             self._rr += 1
@@ -955,8 +1295,8 @@ class FleetRouter:
         if owner is None or owner == target.id:
             return None
         ow = self._workers[owner]
-        if not (ow.alive and ow.ready.is_set()):
-            return None
+        if not (ow.alive and ow.ready.is_set() and not ow.draining):
+            return None  # a draining owner is leaving: don't queue on it
         probe = {"op": "solve", "digest": key, "cached_only": True}
         if "backend" in request:
             probe["backend"] = request["backend"]
@@ -1090,6 +1430,11 @@ class FleetRouter:
                 "pending": len(w.pending),
                 "lane": w.id in self._lane_ids,
                 "caps": dict(w.caps),
+                # The elastic pool's operator view: is this slot serving
+                # warm, leaving, or gone?
+                "warmed": bool(w.caps.get("warmed")),
+                "draining": w.draining,
+                "retired": w.retired,
             }
             if w.addr is not None:
                 info["addr"] = w.addr
@@ -1097,7 +1442,9 @@ class FleetRouter:
                 info["transport"] = w.transport.kind
                 info["channel_writes"] = w.transport.writes
                 info["channel_frames"] = w.transport.frames
-            if w.alive and w.ready.is_set():
+            if w.alive and w.ready.is_set() and not w.draining:
+                # Draining workers stop reading mid-retire: a stats
+                # fan-out at them would hang until the control timeout.
                 resp = self._request_worker(w, {"op": "stats"})
                 if resp and resp.get("ok"):
                     info["stats"] = {
@@ -1126,9 +1473,25 @@ class FleetRouter:
             "sessions": len(self._sessions),
             "transport": self.config.transport,
             "forward_cache": self.config.forward_enabled,
+            # The live pool, as the autoscaler sees it: slot counts by
+            # lifecycle state plus the last scale decision and its reason
+            # string — "why is the fleet this size" in one stanza.
+            "pool": {
+                "size": self.pool_size(),
+                "alive": sum(1 for w in self._workers if w.alive),
+                "draining": [w.id for w in self._workers if w.draining
+                             and not w.retired],
+                "retired": [w.id for w in self._workers if w.retired],
+                "warmed": [w.id for w in self._workers
+                           if w.alive and bool(w.caps.get("warmed"))],
+                "last_scale": self.last_scale_decision,
+            },
         }
         if hop:
             out["router_hop_s"] = hop
+        join = BUS.histograms().get("fleet.join.warm_s")
+        if join and join.get("count"):
+            out["join_warm_s"] = join
         return out
 
     # -- chaos/drill surface -------------------------------------------

@@ -21,14 +21,20 @@ frame* — the same frame on pipes (where round 12 called it the ready
 frame) and sockets (where it doubles as dial-in registration)::
 
     {"ready": true, "proto": 1, "worker": K, "pid": ...,
-     "caps": {"lane": bool, "stream": bool, "kernel": "auto"},
+     "caps": {"lane": bool, "stream": bool, "kernel": "auto",
+              "warmed": bool},
      "token": "<spawn token>", "lease_s": ...}
 
 ``proto`` is the fleet protocol version — :func:`check_hello` rejects a
 mismatch with a clear error instead of letting two incompatible processes
 mis-parse each other's frames. ``caps`` carries the worker's capability
 flags in ONE place (round 13 grew an ad-hoc ``lane`` key; round 14 would
-have added ``stream``; this is where all of them live now). ``token``
+have added ``stream``; this is where all of them live now). ``warmed`` is
+the elastic fleet's warm-handoff gate: a worker only sends its hello
+*after* its service is built and its warmup ladder has run, so a truthful
+``warmed: true`` means "route traffic at me and you will not see a cold
+p99" — :meth:`fleet.router.FleetRouter.add_worker` refuses ring entry to
+a hello without it (``docs/FLEET.md`` "Elasticity"). ``token``
 authenticates a spawned TCP worker's dial-in to its slot + incarnation, so
 a stale worker from a previous incarnation cannot hijack a restarted
 slot's connection.
@@ -77,8 +83,14 @@ def build_hello(
     caps: Optional[dict] = None,
     token: Optional[str] = None,
     lease_s: Optional[float] = None,
+    warmed: Optional[bool] = None,
 ) -> dict:
-    """The worker's registration frame (pipes call it the ready frame)."""
+    """The worker's registration frame (pipes call it the ready frame).
+
+    ``warmed`` lands in ``caps`` — it is a capability like the others, but
+    it carries a *timing* promise (the warmup ladder already ran), so it
+    gets a first-class parameter rather than riding in an ad-hoc dict.
+    """
     proto = int(os.environ.get(_PROTO_ENV, PROTO_VERSION))
     hello = {
         "ready": True,
@@ -87,6 +99,8 @@ def build_hello(
         "pid": os.getpid(),
         "caps": dict(caps or {}),
     }
+    if warmed is not None:
+        hello["caps"]["warmed"] = bool(warmed)
     if token is not None:
         hello["token"] = token
     if lease_s is not None:

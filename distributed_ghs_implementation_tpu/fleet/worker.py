@@ -38,7 +38,14 @@ The hello/ready frame (one builder for every medium,
 ``transport.build_hello``) carries the protocol version and the worker's
 capability flags — ``lane`` (owns a mesh-sharded oversize lane),
 ``stream`` (durable stream log attached), ``kernel`` (level-kernel
-choice) — so the router learns everything routing needs in one place.
+choice), ``warmed`` (the elastic fleet's warm-handoff gate) — so the
+router learns everything routing needs in one place. ``warmed`` is
+truthful *by ordering*: the hello is only built after
+:func:`_build_service` returns, which means the service exists, the
+persistent compile cache is attached, and any warmup ladder has already
+run — a joining worker that advertises ``warmed`` cannot serve a cold
+p99. ``GHS_FLEET_COLD_HELLO=1`` is the test hook that advertises cold
+anyway, to prove the router's refuse-a-cold-joiner path end to end.
 
 The ``fleet.worker.crash`` fault site is consulted once per request,
 *before* it is handled: when the armed shot count reaches zero the process
@@ -180,17 +187,23 @@ def _build_service(args):
     )
 
 
-def _hello_for(args) -> dict:
+def _hello_for(args, warmup_summary=None) -> dict:
     # The one place capability flags live (routing reads them off the
-    # hello; ad-hoc per-feature keys are what this replaces).
+    # hello; ad-hoc per-feature keys are what this replaces). Called only
+    # AFTER _build_service, so "warmed" is a statement of fact: the
+    # service — warmup ladder included — already exists.
+    caps = {
+        "lane": bool(args.sharded_lane),
+        "stream": bool(args.stream_dir),
+        "kernel": os.environ.get("GHS_KERNEL", "auto"),
+    }
+    if warmup_summary is not None:
+        caps["warmup"] = warmup_summary
     return build_hello(
         args.worker_id,
-        caps={
-            "lane": bool(args.sharded_lane),
-            "stream": bool(args.stream_dir),
-            "kernel": os.environ.get("GHS_KERNEL", "auto"),
-        },
+        caps=caps,
         token=args.conn_token,
+        warmed=not os.environ.get("GHS_FLEET_COLD_HELLO"),
     )
 
 
@@ -265,7 +278,16 @@ def run_worker(args) -> int:
     pool = ThreadPoolExecutor(
         max_workers=args.threads, thread_name_prefix=f"worker{args.worker_id}"
     )
-    hello = _hello_for(args)
+    warmup_summary = None
+    if not args.test_echo:
+        from distributed_ghs_implementation_tpu.batch.warmup import (
+            summarize_report,
+        )
+
+        warmup_summary = summarize_report(
+            getattr(service, "warmup_report", None)
+        )
+    hello = _hello_for(args, warmup_summary)
 
     last_transport = None
     try:

@@ -275,6 +275,33 @@ def ingest_bus_events(stats: ClassStats, events: Iterable[tuple]) -> None:
         _ingest(stats, ph, name, dur_ns / 1e9, args)
 
 
+def window_class_waits(events: Iterable[tuple]) -> Dict[str, list]:
+    """Per-class request durations from one SLICE of live-bus events.
+
+    The elastic autoscaler's breach signal (``fleet/autoscaler.py``): each
+    control tick it reads the events appended since its last mark
+    (``BUS.events_since``) and joins the same spans the SLO report joins —
+    ``fleet.request`` in fleet mode, ``serve.request`` single-process — by
+    their ``cls`` argument. Returning the raw duration lists (seconds, not
+    a reservoir) keeps the tick-window p99 exact: a reservoir over the
+    whole run would remember breaches long after they healed, and
+    hysteresis needs a *recent* signal. Untagged requests don't feed the
+    breach check — the budgets are per-class by design (an operator who
+    wants a fleet-wide budget tags a fleet-wide class).
+    """
+    out: Dict[str, list] = {}
+    for ph, name, _cat, _ts_ns, dur_ns, _tid, args in events:
+        if ph != PH_COMPLETE or not args:
+            continue
+        if name not in ("fleet.request", "serve.request"):
+            continue
+        cls = args.get("cls")
+        if cls is None:
+            continue
+        out.setdefault(str(cls), []).append(dur_ns / 1e9)
+    return out
+
+
 def ingest_jsonl_events(stats: ClassStats, events: Iterable[dict]) -> None:
     """Event dicts as parsed by ``obs.export.read_events_jsonl``."""
     for rec in events:

@@ -1,0 +1,308 @@
+"""Elastic fleet: warm-handoff scale-up, drain-aware scale-down, and the
+obs-driven control loop (``fleet/autoscaler.py``, ``docs/FLEET.md``
+"Elasticity").
+
+Everything runs against ``--test-echo`` workers — real subprocesses, real
+spawns, real drains — so the join/retire machinery is exercised at full
+fidelity without a kernel compile in sight.
+"""
+
+import threading
+import time
+
+import pytest
+
+from distributed_ghs_implementation_tpu.fleet.autoscaler import (
+    Autoscaler,
+    ElasticPolicy,
+    parse_class_budgets,
+)
+from distributed_ghs_implementation_tpu.fleet.hashing import HashRing
+from distributed_ghs_implementation_tpu.fleet.router import (
+    FleetConfig,
+    FleetRouter,
+)
+from distributed_ghs_implementation_tpu.obs.events import BUS
+
+
+@pytest.fixture(autouse=True)
+def _clean_global_bus():
+    BUS.enable()
+    BUS.clear()
+    yield
+    BUS.enable()
+    BUS.clear()
+
+
+def _echo_config(workers: int, **kw) -> FleetConfig:
+    defaults = dict(
+        workers=workers, test_echo=True, heartbeat_interval_s=0.1,
+        restart_backoff_base_s=0.02, restart_backoff_cap_s=0.2,
+        ready_timeout_s=120.0, request_timeout_s=30.0,
+    )
+    defaults.update(kw)
+    return FleetConfig(**defaults)
+
+
+# ----------------------------------------------------------------------
+# Policy surface
+# ----------------------------------------------------------------------
+def test_policy_validation_and_class_budgets():
+    with pytest.raises(ValueError, match="min_workers"):
+        ElasticPolicy(min_workers=0)
+    with pytest.raises(ValueError, match="max_workers"):
+        ElasticPolicy(min_workers=3, max_workers=2)
+    with pytest.raises(ValueError, match="tick_s"):
+        ElasticPolicy(tick_s=0.0)
+    budgets = parse_class_budgets("interactive=0.05, bulk=2")
+    assert budgets == {"interactive": 0.05, "bulk": 2.0}
+    with pytest.raises(ValueError, match="CLASS=SECONDS"):
+        parse_class_budgets("nope")
+    policy = ElasticPolicy(class_budgets_s=budgets, wait_budget_s=0.5)
+    assert policy.budget_for("interactive") == 0.05
+    assert policy.budget_for("untuned") == 0.5
+
+
+def test_autoscaler_refuses_remote_topologies():
+    cfg = FleetConfig(remote_workers=("127.0.0.1:1",), transport="tcp")
+    router = FleetRouter(cfg)  # never started; construction is enough
+    with pytest.raises(ValueError, match="remote"):
+        Autoscaler(router)
+
+
+# ----------------------------------------------------------------------
+# Router primitives: warm join, drain-aware retire
+# ----------------------------------------------------------------------
+def test_add_worker_joins_warm_and_owns_its_keyspace():
+    cfg = _echo_config(2)
+    with FleetRouter(cfg) as r:
+        for i in range(6):
+            assert r.handle({"op": "solve", "digest": f"w{i}"})["ok"]
+        joined = r.add_worker()
+        assert joined["worker"] == 2 and joined["warm_s"] > 0
+        assert r.pool_size() == 3
+        # The joiner owns its ring share immediately — and only entered
+        # the ring after its warmed hello was confirmed.
+        ring = HashRing(range(3), replicas=cfg.ring_replicas)
+        d = next(f"j{i}" for i in range(1000) if ring.assign(f"j{i}") == 2)
+        resp = r.handle({"op": "solve", "digest": d})
+        assert resp["ok"] and resp["worker"] == 2
+        counters = BUS.counters()
+        assert counters.get("fleet.scale.up", 0) == 1
+        assert BUS.histograms()["fleet.join.warm_s"]["count"] == 1
+        stats = r.handle({"op": "stats"})
+        assert stats["pool"]["size"] == 3
+        assert stats["workers"]["2"]["warmed"] is True
+        assert sorted(stats["ring"]) == [0, 1, 2]
+
+
+def test_add_worker_refuses_cold_hello_join():
+    # The warm-handoff gate end to end: a joiner advertising a cold hello
+    # (GHS_FLEET_COLD_HELLO test hook) must never enter the ring.
+    cfg = _echo_config(
+        1, worker_env={1: {"GHS_FLEET_COLD_HELLO": "1"}},
+    )
+    with FleetRouter(cfg) as r:
+        with pytest.raises(RuntimeError, match="warmed"):
+            r.add_worker()
+        assert r.pool_size() == 1
+        assert BUS.counters().get("fleet.join.cold_rejected", 0) == 1
+        # The pool is undamaged and still serves.
+        assert r.handle({"op": "solve", "digest": "post-cold"})["ok"]
+        assert sorted(r.handle({"op": "stats"})["ring"]) == [0]
+
+
+def test_retire_drains_in_flight_migrates_sessions_and_hands_off():
+    cfg = _echo_config(3)
+    with FleetRouter(cfg) as r:
+        # Pin an update session to some worker via the digest chain.
+        seed = r.handle({"op": "solve", "digest": "retire-chain"})
+        upd = r.handle({"op": "update", "digest": "retire-chain",
+                        "updates": [{"k": 1}]})
+        assert upd["ok"] and upd["worker"] == seed["worker"]
+        victim = upd["worker"]
+        # Slow request in flight inside the victim while it retires.
+        results = []
+        t = threading.Thread(target=lambda: results.append(r.handle(
+            {"op": "solve", "digest": "retire-chain", "sleep_s": 0.4}
+        )))
+        t.start()
+        time.sleep(0.15)
+        out = r.retire_worker(victim)
+        t.join(timeout=30)
+        assert results and results[0]["ok"]  # drained, not dropped
+        assert out["exit_code"] == 0
+        assert out["sessions_moved"] >= 1  # the pinned chain unpinned
+        assert r.pool_size() == 2
+        # The session digest now routes to a survivor (the inheritor —
+        # with a real service it would replay from the shared WAL here).
+        after = r.handle({"op": "update", "digest": upd["digest"],
+                          "updates": [{"k": 2}]})
+        assert after["ok"] and after["worker"] != victim
+        counters = BUS.counters()
+        assert counters.get("fleet.scale.down", 0) == 1
+        assert counters.get("fleet.worker.dead", 0) == 0  # planned != dead
+        stats = r.handle({"op": "stats"})
+        assert stats["workers"][str(victim)]["retired"] is True
+        assert victim not in stats["ring"]
+
+
+def test_abandoned_worker_leaves_the_pool_count():
+    # A slot that exhausts max_restarts is gone for good — if it kept
+    # counting toward pool_size(), the autoscaler would see phantom
+    # capacity and refuse to scale up past a crash-looped worker.
+    cfg = _echo_config(2, max_restarts=0)
+    with FleetRouter(cfg) as r:
+        assert r.pool_size() == 2
+        r.kill_worker(1)
+        deadline = time.monotonic() + 20
+        while time.monotonic() < deadline and r.pool_size() != 1:
+            time.sleep(0.05)
+        assert r.pool_size() == 1
+        assert BUS.counters().get("fleet.worker.abandoned", 0) == 1
+        assert r.handle({"op": "solve", "digest": "survivor"})["ok"]
+
+
+def test_retire_refuses_the_last_live_worker():
+    with FleetRouter(_echo_config(1)) as r:
+        with pytest.raises(ValueError, match="last live"):
+            r.retire_worker(0)
+        assert r.handle({"op": "solve", "digest": "still-here"})["ok"]
+
+
+def test_retire_victim_selection_prefers_lowest_affinity():
+    # Unpinned retire picks the worker whose warm cache the fleet would
+    # miss least: fewest owner-of-record entries, youngest slot on ties.
+    with FleetRouter(_echo_config(2)) as r:
+        for i in range(24):  # both originals accumulate affinity
+            assert r.handle({"op": "solve", "digest": f"aff-{i}"})["ok"]
+        joined = r.add_worker()
+        out = r.retire_worker()  # the affinity-free joiner goes first
+        assert out["worker"] == joined["worker"]
+
+
+def test_add_worker_dials_a_remote_standby_and_retires_it():
+    # The operator path for remote fleets: a standby `--listen` worker is
+    # dialed into the pool by address (same warm gate), then drained back
+    # out — it must exit 0 like any planned departure.
+    from tests.test_fleet import _spawn_listening_worker
+
+    proc0, addr0 = _spawn_listening_worker(worker_id=0)
+    proc1, addr1 = _spawn_listening_worker(worker_id=1)
+    try:
+        cfg = FleetConfig(
+            remote_workers=(addr0,), transport="tcp", test_echo=True,
+            heartbeat_interval_s=0.1, ready_timeout_s=30.0,
+            request_timeout_s=30.0,
+        )
+        with FleetRouter(cfg) as r:
+            with pytest.raises(ValueError, match="standby"):
+                r.add_worker()  # a remote topology cannot spawn
+            joined = r.add_worker(addr=addr1)
+            assert joined["worker"] == 1 and r.pool_size() == 2
+            ring = HashRing(range(2), replicas=cfg.ring_replicas)
+            d = next(f"rm-{i}" for i in range(1000)
+                     if ring.assign(f"rm-{i}") == 1)
+            resp = r.handle({"op": "solve", "digest": d})
+            assert resp["ok"] and resp["worker"] == 1
+            out = r.retire_worker(1)
+            assert out["worker"] == 1 and r.pool_size() == 1
+            assert r.handle({"op": "solve", "digest": d})["ok"]
+        assert proc1.wait(timeout=30) == 0  # drained out, exit 0
+        assert proc0.wait(timeout=30) == 0  # fleet shutdown drains too
+    finally:
+        for proc in (proc0, proc1):
+            if proc.poll() is None:
+                proc.kill()
+
+
+# ----------------------------------------------------------------------
+# The control loop
+# ----------------------------------------------------------------------
+def test_step_decisions_are_deterministic_and_hysteretic():
+    # step() driven by hand (no thread): breach -> up, at-max -> hold
+    # with a reason, sustained idle -> down, at-min -> hold. The exact
+    # sequence the elastic drill's event counts rest on.
+    policy = ElasticPolicy(
+        min_workers=1, max_workers=2, tick_s=0.05, cooldown_s=0.0,
+        wait_budget_s=0.0, idle_ticks=2,
+    )
+    with FleetRouter(_echo_config(1)) as r:
+        a = Autoscaler(r, policy)
+        assert r.handle({"op": "solve", "digest": "t1",
+                         "slo_class": "hit"})["ok"]
+        d1 = a.step()
+        assert d1["action"] == "up" and "budget" in d1["reason"]
+        assert r.pool_size() == 2
+        assert r.handle({"op": "solve", "digest": "t2",
+                         "slo_class": "hit"})["ok"]
+        d2 = a.step()
+        assert d2["action"] == "hold" and "max_workers" in d2["reason"]
+        assert a.step()["action"] == "hold"  # idle tick 1 of 2
+        d3 = a.step()  # idle tick 2: scale down
+        assert d3["action"] == "down" and "idle" in d3["reason"]
+        assert r.pool_size() == 1
+        assert a.step()["action"] == "hold"  # at min: idle never goes lower
+        assert a.step()["action"] == "hold"
+        counters = BUS.counters()
+        assert counters.get("fleet.scale.up", 0) == 1
+        assert counters.get("fleet.scale.down", 0) == 1
+        # The stats op explains the current size with the last decision.
+        last = r.handle({"op": "stats"})["pool"]["last_scale"]
+        assert last["action"] == "down" and "idle" in last["reason"]
+
+
+def test_queue_depth_watermark_breaches_without_latency():
+    # Depth leads latency: a backed-up worker triggers scale-up even when
+    # no tagged request has completed yet (nothing on the bus to join).
+    policy = ElasticPolicy(
+        min_workers=1, max_workers=2, cooldown_s=0.0, queue_high=1,
+        wait_budget_s=1e9,  # latency can never breach in this test
+    )
+    with FleetRouter(_echo_config(1)) as r:
+        slow = threading.Thread(target=lambda: r.handle(
+            {"op": "solve", "digest": "backlog", "sleep_s": 0.8}
+        ))
+        slow.start()
+        time.sleep(0.2)  # the request occupies the one worker's queue
+        a = Autoscaler(r, policy)
+        d = a.step()
+        assert d["action"] == "up" and "watermark" in d["reason"]
+        assert r.pool_size() == 2
+        slow.join()
+
+
+def test_control_loop_scales_up_on_breach_and_down_on_idle():
+    # The threaded loop end to end: drive tagged traffic with a
+    # zero-second budget until the pool grows, then stop and watch it
+    # drain back to min — warm joins, planned retires, no deaths.
+    policy = ElasticPolicy(
+        min_workers=1, max_workers=2, tick_s=0.1, cooldown_s=0.3,
+        wait_budget_s=0.0, idle_ticks=4,
+    )
+    with FleetRouter(_echo_config(1)) as r:
+        with Autoscaler(r, policy):
+            deadline = time.monotonic() + 30
+            i = 0
+            while r.pool_size() < 2 and time.monotonic() < deadline:
+                r.handle({"op": "solve", "digest": f"ramp-{i}",
+                          "slo_class": "hit"})
+                i += 1
+                time.sleep(0.05)
+            assert r.pool_size() == 2, "never scaled up under breach"
+            deadline = time.monotonic() + 30
+            while r.pool_size() > 1 and time.monotonic() < deadline:
+                time.sleep(0.1)
+            assert r.pool_size() == 1, "never drained back to min on idle"
+            # Let the retire's accounting land before reading counters.
+            deadline = time.monotonic() + 10
+            while (BUS.counters().get("fleet.scale.down", 0) < 1
+                   and time.monotonic() < deadline):
+                time.sleep(0.05)
+        counters = BUS.counters()
+        assert counters.get("fleet.scale.up", 0) == 1
+        assert counters.get("fleet.scale.down", 0) == 1
+        assert counters.get("fleet.worker.dead", 0) == 0
+        assert BUS.histograms()["fleet.join.warm_s"]["count"] == 1
+        # The fleet still serves at min size.
+        assert r.handle({"op": "solve", "digest": "after"})["ok"]

@@ -172,6 +172,87 @@ def test_ring_empty_raises_and_len_counts_members():
     assert len(ring) == 1 and ring.assign("anything") == 3
 
 
+def test_ring_churn_moves_bounded_keys_and_stays_deterministic():
+    # The elastic fleet's ring contract: adding K workers moves only the
+    # keys the joiners take over (a bounded fraction), removing them
+    # restores the original mapping exactly, and the digest->owner map is
+    # identical across router restarts with the same member set.
+    import random
+
+    keys = [f"digest-{i}" for i in range(2000)]
+    base = [0, 1, 2, 3, 4, 5]
+    ring = HashRing(base)
+    before = {k: ring.assign(k) for k in keys}
+    for m in (6, 7):
+        ring.add(m)
+    after = {k: ring.assign(k) for k in keys}
+    moved = [k for k in keys if after[k] != before[k]]
+    # Every moved key went TO a joiner — survivors never reshuffle among
+    # themselves — and the moved fraction is bounded (expected K/(N+K) =
+    # 0.25 at 64 replicas; 0.45 leaves room for placement variance).
+    assert moved and all(after[k] in (6, 7) for k in moved)
+    assert len(moved) / len(keys) < 0.45
+    for m in (6, 7):
+        ring.remove(m)
+    assert {k: ring.assign(k) for k in keys} == before
+    # Restart determinism: a freshly built ring with the same member set
+    # (any insertion order) maps identically.
+    rng = random.Random(7)
+    shuffled = list(base)
+    rng.shuffle(shuffled)
+    rebuilt = HashRing(shuffled)
+    assert {k: rebuilt.assign(k) for k in keys} == before
+    # Idempotent add: a join racing a rejoin must not duplicate a
+    # member's ring points (which would silently double its keyspace).
+    rebuilt.add(3)
+    assert {k: rebuilt.assign(k) for k in keys} == before
+    assert len(rebuilt._points) == len(base) * rebuilt.replicas
+    # Sustained churn: after an arbitrary add/remove sequence, assignment
+    # equals a fresh ring over the surviving member set.
+    live = set(base)
+    churn = HashRing(base)
+    for _ in range(40):
+        if rng.random() < 0.5 and len(live) > 1:
+            m = rng.choice(sorted(live))
+            churn.remove(m)
+            live.discard(m)
+        else:
+            m = rng.randrange(0, 12)
+            churn.add(m)
+            live.add(m)
+    fresh = HashRing(sorted(live))
+    assert all(churn.assign(k) == fresh.assign(k) for k in keys[:500])
+
+
+def test_restart_backoff_jitter_deterministic_under_seed():
+    # Satellite: mass worker death must not thundering-herd the shared
+    # disk store / compile cache — backoffs carry a per-(worker, attempt)
+    # jitter that is reproducible under restart_jitter_seed.
+    def seq(router):
+        return [
+            router._backoff_s(w, k) for w in range(4) for k in range(6)
+        ]
+
+    a = FleetRouter(FleetConfig(workers=1, test_echo=True,
+                                restart_jitter_seed=42))
+    b = FleetRouter(FleetConfig(workers=1, test_echo=True,
+                                restart_jitter_seed=42))
+    c = FleetRouter(FleetConfig(workers=1, test_echo=True,
+                                restart_jitter_seed=43))
+    assert seq(a) == seq(b)  # same seed, same schedule (tests reproduce)
+    assert seq(a) != seq(c)  # the seed actually moves the schedule
+    cap = a.config.restart_backoff_cap_s
+    assert all(0 < x <= cap for x in seq(a))  # the cap stays a ceiling
+    # Desync is the point: same attempt number, different workers, all
+    # distinct sleep times — the restart wave fans out.
+    same_attempt = [a._backoff_s(w, 8) for w in range(6)]
+    assert len(set(same_attempt)) == len(same_attempt)
+    # Jitter off: the documented plain capped exponential.
+    plain = FleetRouter(FleetConfig(workers=1, test_echo=True,
+                                    restart_jitter=0.0))
+    assert plain._backoff_s(3, 2) == min(0.05 * 4, 2.0)
+
+
 # ----------------------------------------------------------------------
 # Echo fleet: routing, failover, re-queue idempotency
 # ----------------------------------------------------------------------
@@ -314,6 +395,47 @@ def test_fleet_graceful_drain_answers_in_flight_and_exits_zero():
     t.join(timeout=10)
     assert results and results[0]["ok"]  # drained, not dropped
     assert r._workers[0].proc.returncode == 0  # exit 0, not a kill
+
+
+def test_retire_drain_outliving_lease_is_not_declared_dead():
+    # Satellite regression: a worker in graceful drain stops reading its
+    # channel on purpose — if the lease still applied, a drain slower than
+    # lease_s would be declared dead mid-flush and its in-flight work
+    # re-queued (duplicate solves + a spurious fleet.worker.dead in a
+    # PLANNED scale-down). The lease is 0.3s here and the drain takes
+    # ~0.6s; the response must still come back from the draining worker.
+    import threading
+
+    cfg = FleetConfig(
+        workers=2, test_echo=True, heartbeat_interval_s=0.05,
+        lease_s=0.3, ready_timeout_s=120.0, request_timeout_s=30.0,
+    )
+    r = FleetRouter(cfg).start()
+    try:
+        victim = r.handle({"op": "solve", "digest": "drain-probe"})["worker"]
+        results = []
+        t = threading.Thread(target=lambda: results.append(r.handle(
+            {"op": "solve", "digest": "drain-probe", "sleep_s": 0.6}
+        )))
+        t.start()
+        time.sleep(0.2)  # the slow request is inside the victim now
+        # timeout_s below the in-flight sleep: the drain frame goes out
+        # with work still in flight, so the flush phase outlives lease_s.
+        out = r.retire_worker(victim, timeout_s=0.1)
+        t.join(timeout=30)
+        assert results, "in-flight request lost during retire"
+        resp = results[0]
+        assert resp["ok"] and resp["worker"] == victim  # flushed, not moved
+        assert "requeued" not in resp
+        counters = BUS.counters()
+        assert counters.get("fleet.lease.expired", 0) == 0
+        assert counters.get("fleet.heartbeat.miss", 0) == 0
+        assert counters.get("fleet.worker.dead", 0) == 0
+        assert counters.get("fleet.requeue", 0) == 0
+        assert out["exit_code"] == 0  # drained, never killed
+        assert counters.get("fleet.scale.down", 0) == 1
+    finally:
+        r.shutdown()
 
 
 def test_worker_sigterm_drains_and_exits_zero(tmp_path):
@@ -557,7 +679,7 @@ def test_tcp_forwarding_probes_owner_before_local_solve():
         assert stats["forward_cache"] is True
 
 
-def _spawn_listening_worker(extra_env=None):
+def _spawn_listening_worker(extra_env=None, worker_id=0):
     env = {**os.environ, "PYTHONPATH": os.pathsep.join(
         [os.path.dirname(os.path.dirname(os.path.abspath(__file__)))]
         + os.environ.get("PYTHONPATH", "").split(os.pathsep)
@@ -565,7 +687,8 @@ def _spawn_listening_worker(extra_env=None):
     proc = subprocess.Popen(
         [sys.executable, "-m",
          "distributed_ghs_implementation_tpu.fleet.worker",
-         "--worker-id", "0", "--test-echo", "--listen", "127.0.0.1:0"],
+         "--worker-id", str(worker_id), "--test-echo",
+         "--listen", "127.0.0.1:0"],
         stderr=subprocess.PIPE, env=env,
     )
     line = proc.stderr.readline().decode()

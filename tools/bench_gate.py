@@ -115,6 +115,19 @@ KINDS = {
     # already gates them as wall-time ceilings.
     "forward_hit": "exact",
     "forward_miss": "exact",
+    # Elastic fleet (bench.py --fleet-tcp churn segment and
+    # gate-fleet-elastic-v1, tools/load_drill.py --elastic): scale events
+    # are policy-determined — cooldown serializes them, the min/max bounds
+    # terminate them — so a changed count means the autoscaler's decision
+    # logic (or the warm-join/retire machinery) changed, never jitter. A
+    # planned retire reading as a death is likewise a logic regression.
+    # elastic_join_warm_s / fleet_join_warm_p95_s need no override: the
+    # _s suffix gates them as wall-time ceilings.
+    "scale_up_events": "exact",
+    "scale_down_events": "exact",
+    "elastic_scale_up": "exact",
+    "elastic_scale_down": "exact",
+    "elastic_unplanned_deaths": "exact",
     # gate-stream-bench-v1 (bench.py --update-stream): the windowed-vs-
     # sequential ratio is a wall-clock pair — gate as a throughput floor.
     "window_speedup": "throughput",

@@ -41,6 +41,21 @@ accepted query is answered (the crashed worker's in-flight requests
 re-queue onto survivors), the dead worker restarts with backoff, rejoins
 the ring, and serves a probe query. See ``docs/FLEET.md``.
 
+**Elastic mode** (``--elastic``, needs ``--fleet``): an
+:class:`fleet.autoscaler.Autoscaler` drives the pool during the window —
+a zero-second wait budget makes the ramp deterministically provoke warm
+scale-ups to ``--elastic-max``, and post-window idle drains the pool down
+to ``--elastic-min`` (drain-aware retires: lowest-affinity victim, pinned
+sessions migrating to ring inheritors). The drill waits for both
+convergences, stops the autoscaler, and then — in ``--update-heavy`` mode
+— publishes one more window per stream to prove the migrated streams
+recover by snapshot+WAL replay with ZERO fresh solves. Scale event counts
+gate EXACTLY (``gate-fleet-elastic-v1``,
+``docs/BENCH_BASELINE_FLEET_ELASTIC.json``) and ``fleet.join.warm_s`` p95
+gates as a wall-time ceiling; ``--kill-worker`` composes (the jax-free
+``--test-echo`` kill-during-scale variant CI runs), asserting that a
+crash landing mid-scale still loses nothing.
+
     python tools/load_drill.py --smoke --output load_report.json \
         --gate-baseline docs/BENCH_BASELINE_LOAD.json
     python tools/load_drill.py --smoke --update-baseline   # rewrite baseline
@@ -71,6 +86,8 @@ REPORT_SCHEMA = "ghs-load-report-v1"
 WORKLOAD = "gate-load-v1"
 WORKLOAD_FLEET = "gate-fleet-v1"
 WORKLOAD_FLEET_KILL = "gate-fleet-kill-v1"
+WORKLOAD_FLEET_ELASTIC = "gate-fleet-elastic-v1"
+WORKLOAD_FLEET_ELASTIC_KILL = "gate-fleet-elastic-kill-v1"
 WORKLOAD_OVERSIZE = "gate-oversize-v1"
 WORKLOAD_STREAM = "gate-stream-v1"
 WORKLOAD_STREAM_FLEET = "gate-stream-fleet-v1"
@@ -522,7 +539,27 @@ def client_summary(records, wall_s) -> dict:
 # ----------------------------------------------------------------------
 # The drill
 # ----------------------------------------------------------------------
-def _fleet_worker_counters(router) -> "tuple[dict, List[str]]":
+def _read_exported_counters(obs_dir, wid, incarnation) -> Optional[dict]:
+    """A drained worker's process counters, recovered from the obs JSONL
+    it exported on exit (``worker<K>.<incarnation>.jsonl`` header)."""
+    if not obs_dir or incarnation is None:
+        return None
+    path = os.path.join(obs_dir, f"worker{wid}.{incarnation}.jsonl")
+    try:
+        from distributed_ghs_implementation_tpu.obs.export import (
+            read_events_jsonl,
+        )
+
+        _events, meta = read_events_jsonl(path)
+    except (OSError, ValueError):
+        return None
+    counters = meta.get("counters")
+    # A file without its trailing totals line (torn export) has no
+    # counters — that is a miss, not an empty-but-trustworthy zero.
+    return dict(counters) if isinstance(counters, dict) else None
+
+
+def _fleet_worker_counters(router, obs_dir=None) -> "tuple[dict, List[str]]":
     """Per-``(worker_id, incarnation)`` counter snapshots across the
     fleet's live workers (each worker has its own bus; the router's stats
     op fans out with the incarnation alongside). Also returns the ids of
@@ -540,6 +577,21 @@ def _fleet_worker_counters(router) -> "tuple[dict, List[str]]":
     stats = router.handle({"op": "stats"})
     out, missing = {}, []
     for wid, info in (stats.get("workers") or {}).items():
+        if info.get("retired") or info.get("draining"):
+            # A planned departure (elastic scale-down) flushed its
+            # counters to the obs export on drain — recover them from
+            # there so the window delta keeps the retiree's activity. A
+            # retiree with no readable export would silently zero out of
+            # every exact-gated check (fresh solves, chain evictions), so
+            # that is a MISS the caller must surface, never a zero.
+            counters = _read_exported_counters(
+                obs_dir, wid, info.get("incarnation")
+            )
+            if counters is None:
+                missing.append(f"{wid} (retired, no obs export)")
+            else:
+                out[(wid, info.get("incarnation"))] = counters
+            continue
         wstats = info.get("stats")
         if not wstats:
             missing.append(wid)
@@ -570,6 +622,9 @@ def run_drill(args) -> dict:
     try:
         return _run_drill(args, resources)
     finally:
+        autoscaler = resources.get("autoscaler")
+        if autoscaler is not None:
+            autoscaler.close()
         router = resources.get("router")
         if router is not None:
             router.shutdown()
@@ -747,10 +802,40 @@ def _run_drill(args, resources: dict) -> dict:
     # A pre-window stats miss is the SAFE direction (the delta over-counts
     # that worker), so it doesn't gate; a post-window miss does.
     pre_window = (
-        _fleet_worker_counters(fleet_router)[0] if fleet_router is not None
+        _fleet_worker_counters(fleet_router, args.obs_dir)[0]
+        if fleet_router is not None
         else {}
     )
     BUS.clear()  # the measured window starts here
+    autoscaler = None
+    elastic_policy = None
+    if fleet_router is not None and args.elastic:
+        from distributed_ghs_implementation_tpu.fleet.autoscaler import (
+            Autoscaler,
+            ElasticPolicy,
+        )
+
+        # Deterministic by construction: a ZERO wait budget means any
+        # class-tagged request breaches, so the ramp provokes exactly
+        # (max - fleet) scale-ups (one per cooldown, stopping at max) and
+        # post-window idle drains exactly (max - min) retires. The drill
+        # is proving the machinery — warm joins, lossless retires — not
+        # tuning thresholds; production budgets live in ElasticPolicy
+        # defaults / the serve CLI flags.
+        elastic_policy = ElasticPolicy(
+            min_workers=(args.elastic_min
+                         if args.elastic_min is not None
+                         else max(1, args.fleet - 1)),
+            max_workers=(args.elastic_max
+                         if args.elastic_max is not None
+                         else args.fleet + 1),
+            tick_s=0.25,
+            cooldown_s=1.0,
+            wait_budget_s=0.0,
+            idle_ticks=10,  # 2.5s of silence = the window is over
+        )
+        autoscaler = Autoscaler(fleet_router, elastic_policy).start()
+        resources["autoscaler"] = autoscaler
     try:
         records, wall_s, chaos_armed = run_window(
             service, schedule, streams, args, chaos_plan, arm_chaos
@@ -758,12 +843,58 @@ def _run_drill(args, resources: dict) -> dict:
     finally:
         FAULTS.reset()
 
+    # Elastic convergence: the up decisions fire during the ramp, but a
+    # warm join (spawn + pre-seed + warmup ladder) may outlive the window
+    # — wait for the pool to reach max, then for post-window idle to
+    # drain it back to min, then STOP the autoscaler so the recovery
+    # probes below (real traffic) cannot provoke extra scale events and
+    # break the exact counts the gate pins.
+    elastic = None
+    if autoscaler is not None:
+        def _wait_pool(target: int, timeout_s: float) -> bool:
+            deadline = time.perf_counter() + timeout_s
+            while time.perf_counter() < deadline:
+                if fleet_router.pool_size() == target:
+                    return True
+                time.sleep(0.1)
+            return fleet_router.pool_size() == target
+
+        reached_max = _wait_pool(elastic_policy.max_workers, 240.0)
+        reached_min = _wait_pool(elastic_policy.min_workers, 120.0)
+        autoscaler.close()
+        # Let an in-flight retire's accounting land before counters read.
+        deadline = time.perf_counter() + 10.0
+        expected_downs = (
+            elastic_policy.max_workers - elastic_policy.min_workers
+        )
+        while (time.perf_counter() < deadline
+               and BUS.counters().get("fleet.scale.down", 0)
+               < expected_downs):
+            time.sleep(0.05)
+        elastic = {
+            "policy": {
+                "min_workers": elastic_policy.min_workers,
+                "max_workers": elastic_policy.max_workers,
+                "cooldown_s": elastic_policy.cooldown_s,
+                "idle_ticks": elastic_policy.idle_ticks,
+            },
+            "reached_max": reached_max,
+            "reached_min": reached_min,
+            "final_pool": fleet_router.pool_size(),
+            "decisions": list(autoscaler.decisions),
+        }
+
     # Kill-drill recovery: wait for the dead worker to restart and rejoin
     # the ring, then drive a probe query onto it — "goodput recovery" is a
     # query actually served by the restarted process, not just a counter.
+    # In elastic mode the scale-down may legitimately have RETIRED the
+    # restarted victim (a fresh incarnation has the least affinity), so
+    # the ring-heal + probe-at-victim checks don't apply there; the
+    # elastic checks pin pool convergence instead.
     rejoined = None
     probe = None
-    if fleet_router is not None and args.kill_worker is not None:
+    if (fleet_router is not None and args.kill_worker is not None
+            and not args.elastic):
         rejoined = False
         deadline = time.perf_counter() + 30.0
         while time.perf_counter() < deadline:
@@ -808,7 +939,13 @@ def _run_drill(args, resources: dict) -> dict:
             poll_gap_check,
         )
 
-        if fleet_router is not None and args.kill_worker is not None:
+        if fleet_router is not None and (
+            args.kill_worker is not None or args.elastic
+        ):
+            # After a kill OR an elastic retire, the streams' pins moved:
+            # one more published window per stream proves the inheritor
+            # serves the chain (recovered by snapshot+WAL replay, never a
+            # fresh solve — the counters below assert it).
             recovery = []
             for s, state in enumerate(streams):
                 t_r = time.perf_counter()
@@ -896,7 +1033,9 @@ def _run_drill(args, resources: dict) -> dict:
         # restarted incarnation starts from a zero baseline, so anything
         # it does during the window — a fresh solve where replay was
         # promised — shows up undiminished.
-        post_window, stats_missing = _fleet_worker_counters(fleet_router)
+        post_window, stats_missing = _fleet_worker_counters(
+            fleet_router, args.obs_dir
+        )
         window_counters = _window_counter_delta(pre_window, post_window)
         fleet_counters = {
             k: v for k, v in BUS.counters().items() if k.startswith("fleet.")
@@ -986,18 +1125,31 @@ def _run_drill(args, resources: dict) -> dict:
                  fleet_counters.get("fleet.worker.dead", 0) >= 1),
                 ("dead worker restarted with backoff",
                  fleet_counters.get("fleet.worker.restart", 0) >= 1),
-                ("fleet healed: full ring after the drill", bool(rejoined)),
                 ("streams recovered by snapshot+WAL replay (no re-solve)",
                  window_counters.get("stream.replay.streams", 0) >= 1),
                 ("post-recovery window publishes served",
                  recovery is not None
                  and all(r["ok"] for r in recovery)),
             ]
+            if not args.elastic:  # elastic pins pool convergence instead
+                checks.append(
+                    ("fleet healed: full ring after the drill",
+                     bool(rejoined)),
+                )
         elif fleet_router is not None:
             checks += [
                 ("no unplanned worker deaths",
                  fleet_counters.get("fleet.worker.dead", 0) == 0),
             ]
+            if args.elastic:
+                checks += [
+                    ("retired workers' streams migrated by WAL replay "
+                     "(no re-solve)",
+                     window_counters.get("stream.replay.streams", 0) >= 1),
+                    ("post-retire window publishes served by inheritors",
+                     recovery is not None
+                     and all(r["ok"] for r in recovery)),
+                ]
     elif fleet_router is None:
         checks += [
             ("zero errors (chaos absorbed by the supervisor)", errors == 0),
@@ -1043,19 +1195,54 @@ def _run_drill(args, resources: dict) -> dict:
                  fleet_counters.get("fleet.requeue", 0) >= 1),
                 ("dead worker restarted with backoff",
                  fleet_counters.get("fleet.worker.restart", 0) >= 1),
-                ("fleet healed: full ring after the drill", bool(rejoined)),
-                ("restarted worker serves traffic (goodput recovery)",
-                 bool(probe and probe.get("ok")
-                      and probe.get("worker") == args.kill_worker)),
             ]
+            if not args.elastic:
+                # Elastic scale-down may legitimately retire the restarted
+                # victim (a fresh incarnation has the least affinity) —
+                # pool convergence is the elastic heal check instead.
+                checks += [
+                    ("fleet healed: full ring after the drill",
+                     bool(rejoined)),
+                    ("restarted worker serves traffic (goodput recovery)",
+                     bool(probe and probe.get("ok")
+                          and probe.get("worker") == args.kill_worker)),
+                ]
         else:
             # No kill: the fleet must ride the window without ANY failover.
             checks += [
                 ("no unplanned worker deaths",
                  fleet_counters.get("fleet.worker.dead", 0) == 0),
-                ("zero request-time compiles in the measured window",
-                 compile_counters.get("compile.miss", 0) == 0),
             ]
+            if not args.elastic:
+                # A joiner entering mid-window changes routing, so fresh
+                # digests can land on it cold — zero request-time compiles
+                # is a steady-state-pool property.
+                checks.append(
+                    ("zero request-time compiles in the measured window",
+                     compile_counters.get("compile.miss", 0) == 0),
+                )
+    if elastic is not None:
+        # Exact by construction: ups stop at max_workers, downs stop at
+        # min_workers, cooldown serializes events, and the autoscaler was
+        # stopped before the recovery traffic — so the counts are a
+        # property of the policy, not the machine (gated exactly).
+        expected_ups = elastic_policy.max_workers - args.fleet
+        expected_downs = (
+            elastic_policy.max_workers - elastic_policy.min_workers
+        )
+        scale_ups = int(fleet_counters.get("fleet.scale.up", 0))
+        scale_downs = int(fleet_counters.get("fleet.scale.down", 0))
+        join_hist = BUS.histograms().get("fleet.join.warm_s", {})
+        checks += [
+            ("fleet grew to max under load (exact scale-up events)",
+             elastic["reached_max"] and scale_ups == expected_ups),
+            ("fleet drained back to min on idle (exact scale-down events)",
+             elastic["reached_min"] and scale_downs == expected_downs
+             and elastic["final_pool"] == elastic_policy.min_workers),
+            ("every joiner entered the ring warm (warmed hello confirmed)",
+             fleet_counters.get("fleet.join.cold_rejected", 0) == 0
+             and join_hist.get("count", 0) == scale_ups),
+        ]
     ok = all(passed for _, passed in checks)
 
     if args.update_heavy:
@@ -1063,12 +1250,17 @@ def _run_drill(args, resources: dict) -> dict:
             workload = WORKLOAD_STREAM
         elif args.kill_worker is not None:
             workload = WORKLOAD_STREAM_KILL
+        elif args.elastic:
+            workload = WORKLOAD_FLEET_ELASTIC
         else:
             workload = WORKLOAD_STREAM_FLEET
     elif fleet_router is None:
         workload = WORKLOAD_OVERSIZE if args.oversize_heavy else WORKLOAD
     elif args.kill_worker is not None:
-        workload = WORKLOAD_FLEET_KILL
+        workload = (WORKLOAD_FLEET_ELASTIC_KILL if args.elastic
+                    else WORKLOAD_FLEET_KILL)
+    elif args.elastic:
+        workload = WORKLOAD_FLEET_ELASTIC
     else:
         workload = WORKLOAD_FLEET
     config = {
@@ -1095,6 +1287,8 @@ def _run_drill(args, resources: dict) -> dict:
         config["transport"] = args.transport
         if args.test_echo:
             config["test_echo"] = True
+        if elastic is not None:
+            config["elastic"] = elastic["policy"]
     extra_metrics = {"lost_accepted": lost, "answered": answered}
     if router_hop:
         extra_metrics["router_hop_p50_s"] = router_hop.get("p50", 0.0)
@@ -1116,6 +1310,13 @@ def _run_drill(args, resources: dict) -> dict:
             "fleet.worker.restart", 0
         )
         extra_metrics["requeued"] = fleet_counters.get("fleet.requeue", 0)
+    if elastic is not None:
+        extra_metrics["scale_up_events"] = scale_ups
+        extra_metrics["scale_down_events"] = scale_downs
+        if join_hist.get("count"):
+            # The warm-join wall time (spawn -> pre-seed -> warmup ladder
+            # -> warmed hello -> ring entry); its p95 gates as a ceiling.
+            extra_metrics["fleet_join_warm_p95_s"] = join_hist["p95"]
     gate = slo.gate_metrics(
         summary,
         workload=workload,
@@ -1162,6 +1363,16 @@ def _run_drill(args, resources: dict) -> dict:
             "rejoined": rejoined,
             "probe": probe,
         }
+        if elastic is not None:
+            # The elastic trace: policy, convergence, and the decision
+            # log (action + reason + pool size per scale event) — the
+            # "fleet grew and shrank across the run" evidence.
+            report["elastic"] = {
+                **elastic,
+                "scale_up_events": scale_ups,
+                "scale_down_events": scale_downs,
+                "join_warm_s": join_hist,
+            }
         # run_drill's finally drains the fleet: workers flush in-flight
         # responses + export their per-worker obs JSONL (--obs-dir).
     return report
@@ -1190,6 +1401,10 @@ def main(argv=None) -> int:
                    help="disable the deck's mid-flight fault arming")
     p.add_argument("--arrival", choices=("poisson", "bursty", "ramp"),
                    default="poisson")
+    p.add_argument("--ramp", action="store_true",
+                   help="shorthand for --arrival ramp (the elastic "
+                   "scenario's traffic shape: density doubles across the "
+                   "window)")
     p.add_argument("--duration", type=float, default=10.0,
                    help="arrival window in seconds (open-loop)")
     p.add_argument("--rate", type=float, default=10.0,
@@ -1249,6 +1464,19 @@ def main(argv=None) -> int:
                    help="with --fleet: spawn jax-free echo workers (canned "
                    "answers, full transport/failover fidelity) — the CI "
                    "TCP kill drill's mode")
+    p.add_argument("--elastic", action="store_true",
+                   help="with --fleet: attach the obs-driven autoscaler "
+                   "(fleet/autoscaler.py) with a zero wait budget, so the "
+                   "window deterministically grows the pool to "
+                   "--elastic-max (warm-handoff joins) and post-window "
+                   "idle drains it to --elastic-min (drain-aware "
+                   "retires); scale event counts then gate EXACTLY "
+                   "(gate-fleet-elastic-v1, docs/FLEET.md Elasticity)")
+    p.add_argument("--elastic-min", type=int, default=None, metavar="N",
+                   help="with --elastic: pool floor (default fleet - 1, "
+                   "at least 1)")
+    p.add_argument("--elastic-max", type=int, default=None, metavar="N",
+                   help="with --elastic: pool ceiling (default fleet + 1)")
     p.add_argument("--obs-dir",
                    help="with --fleet: per-worker obs JSONL exports land "
                    "here on drain (worker<K>.<incarnation>.jsonl)")
@@ -1264,10 +1492,29 @@ def main(argv=None) -> int:
     p.add_argument("--update-baseline", nargs="?", const=DEFAULT_BASELINE,
                    help="write the gate baseline from this run and exit")
     args = p.parse_args(argv)
+    if args.ramp:
+        args.arrival = "ramp"
     if args.kill_worker is not None and (
         not args.fleet or not 0 <= args.kill_worker < args.fleet
     ):
         p.error("--kill-worker needs --fleet N with 0 <= K < N")
+    if args.elastic and not args.fleet:
+        p.error("--elastic needs --fleet N (it drives the fleet's pool)")
+    if args.elastic and not args.obs_dir:
+        # Retired workers' counters are recovered from their obs exports;
+        # without the export directory the exact-gated counter checks
+        # (fresh solves, chain evictions) would lose the retirees' window
+        # activity and pass vacuously.
+        p.error("--elastic needs --obs-dir (retired workers' counters "
+                "are recovered from their obs exports)")
+    if args.elastic:
+        mn = (args.elastic_min if args.elastic_min is not None
+              else max(1, args.fleet - 1))
+        mx = (args.elastic_max if args.elastic_max is not None
+              else args.fleet + 1)
+        if not 1 <= mn <= args.fleet <= mx:
+            p.error(f"--elastic needs 1 <= min ({mn}) <= --fleet "
+                    f"({args.fleet}) <= max ({mx})")
     if args.test_echo and not args.fleet:
         p.error("--test-echo needs --fleet N (it is a worker mode)")
     if args.test_echo and args.update_heavy:
