@@ -389,6 +389,7 @@ def _cmd_serve(args) -> int:
                 args.fleet_forward_cache
             ],
             lease_s=args.fleet_lease,
+            journal_dir=args.fleet_journal,
             backend=args.backend,
             batch_lanes=args.batch_lanes,
             store_capacity=args.cache_entries,
@@ -839,6 +840,14 @@ def build_parser() -> argparse.ArgumentParser:
         "--fleet-scale-cooldown", type=float, default=2.0, metavar="SECONDS",
         help="with --fleet-elastic: minimum seconds between scale events "
         "(hysteresis; scale steps are always by one worker)",
+    )
+    srv.add_argument(
+        "--fleet-journal", default=None, metavar="DIR",
+        help="with --fleet: durable accepted-work journal directory "
+        "(fleet/journal.py) — every accept is fsynced before dispatch, "
+        "and a restarted router on the same DIR re-adopts live --listen "
+        "workers warm, rebuilds pins/affinity, and re-queues orphaned "
+        "accepts (docs/FLEET.md 'Router survivability')",
     )
     srv.add_argument(
         "--fleet-lease", type=float, default=None, metavar="SECONDS",

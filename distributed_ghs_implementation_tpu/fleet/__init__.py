@@ -12,8 +12,14 @@ pipelined writes, dial-in hello registration, host:port addressing), so
 the fleet is no longer bound to one machine; cross-host cache misses
 forward to the digest-owner worker before solving locally. The pool is
 elastic (``fleet/autoscaler.py``): an obs-driven control loop grows it
-with warm-handoff joins and shrinks it with drain-aware retires.
-``docs/FLEET.md`` covers topology, failure modes, and drill recipes.
+with warm-handoff joins and shrinks it with drain-aware retires. And the
+router itself is crash-survivable (``fleet/journal.py``): a durable
+accepted-work journal gates every accept on an fsynced append, so a
+restarted router re-adopts live workers warm and replays orphaned work;
+a transport chaos layer (``ChaosTransport``) drills the dirty-link
+failures — partitions, latency, frame corruption — clean kills never
+exercised. ``docs/FLEET.md`` covers topology, failure modes, and drill
+recipes.
 """
 
 from distributed_ghs_implementation_tpu.fleet.autoscaler import (
@@ -21,12 +27,17 @@ from distributed_ghs_implementation_tpu.fleet.autoscaler import (
     ElasticPolicy,
 )
 from distributed_ghs_implementation_tpu.fleet.hashing import HashRing
+from distributed_ghs_implementation_tpu.fleet.journal import (
+    RouterJournal,
+)
 from distributed_ghs_implementation_tpu.fleet.router import (
     FleetConfig,
     FleetRouter,
 )
 from distributed_ghs_implementation_tpu.fleet.transport import (
     PROTO_VERSION,
+    ChaosState,
+    ChaosTransport,
     HelloError,
     PipeTransport,
     SocketTransport,
@@ -41,7 +52,10 @@ __all__ = [
     "FleetConfig",
     "FleetRouter",
     "HashRing",
+    "RouterJournal",
     "PROTO_VERSION",
+    "ChaosState",
+    "ChaosTransport",
     "HelloError",
     "PipeTransport",
     "SocketTransport",

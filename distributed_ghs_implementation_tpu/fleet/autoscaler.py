@@ -140,6 +140,15 @@ class Autoscaler:
         )
         self._idle_streak = 0
         self._last_scale_done = float("-inf")
+        # A journal-restored router hands back its last (wall-clock
+        # stamped) scale decision: the cooldown spans the crash, so a
+        # restarting router cannot double-scale a fleet that had just
+        # scaled (docs/FLEET.md "Router survivability").
+        last = getattr(router, "last_scale_decision", None)
+        if last and last.get("at") and last.get("action") in ("up", "down"):
+            age = max(0.0, time.time() - float(last["at"]))
+            if age < self.policy.cooldown_s:
+                self._last_scale_done = time.monotonic() - age
         self._closed = False
         self._thread: Optional[threading.Thread] = None
         #: Bounded decision log (newest last) — drills read it for the

@@ -76,6 +76,8 @@ from typing import Dict, List, Optional, Tuple
 
 from distributed_ghs_implementation_tpu.fleet.hashing import HashRing
 from distributed_ghs_implementation_tpu.fleet.transport import (
+    ChaosState,
+    ChaosTransport,
     HelloError,
     PipeTransport,
     Transport,
@@ -146,6 +148,20 @@ class FleetConfig:
     # on for TCP fleets without a shared disk store (the topology where a
     # deviating dispatch would otherwise re-solve), off elsewhere.
     forward_cache: Optional[bool] = None
+    # -- survivability (round 18, docs/FLEET.md "Router survivability") --
+    # Durable accepted-work journal (fleet/journal.py): every accept is
+    # fsynced before dispatch, answers/pins/ring/scale changes follow, so
+    # a router crash loses NOTHING acknowledged — a restarted router with
+    # the same journal_dir re-adopts live --listen workers warm,
+    # re-spawns dead ones, rebuilds pins/affinity, and re-queues orphaned
+    # accepts by digest. None = the pre-round-18 in-memory-only router.
+    journal_dir: Optional[str] = None
+    journal_checkpoint_every: int = 512
+    # Transport chaos layer (fleet/transport.py ChaosTransport): wrap
+    # every worker channel in the fault-injectable wrapper so drills can
+    # drive seeded partitions / latency / frame corruption per worker.
+    chaos: bool = False
+    chaos_seed: int = 0
     # Worker lease: silence (no pong, no frames) longer than this declares
     # the worker dead even while its connection stays open. None derives
     # heartbeat_interval_s * heartbeat_miss_threshold. A dead process is
@@ -285,6 +301,22 @@ class FleetRouter:
         n = len(self.config.remote_workers) or self.config.workers
         if n < 1:
             raise ValueError(f"workers must be >= 1, got {n}")
+        # Durable journal: load BEFORE building slots — a journal from a
+        # crashed predecessor may know about workers the static config
+        # does not (elastic scale-ups), and those slots must exist so the
+        # restarted pool matches the pool the autoscaler had built.
+        self._journal = None
+        self._journal_state = None
+        if self.config.journal_dir:
+            from distributed_ghs_implementation_tpu.fleet.journal import (
+                RouterJournal,
+            )
+
+            self._journal = RouterJournal(
+                self.config.journal_dir,
+                checkpoint_every=self.config.journal_checkpoint_every,
+            )
+            self._journal_state = self._journal.load()
         self._workers = [
             _Worker(
                 i, self.config.queue_depth,
@@ -293,13 +325,49 @@ class FleetRouter:
             )
             for i in range(n)
         ]
+        if self._journal_state is not None and self._journal_state.members:
+            for wid in sorted(self._journal_state.members):
+                member = self._journal_state.members[wid]
+                while wid >= len(self._workers):
+                    self._workers.append(_Worker(
+                        len(self._workers), self.config.queue_depth,
+                        addr=member.get("addr"),
+                    ))
+                w = self._workers[wid]
+                if member.get("addr") and w.addr is None:
+                    w.addr = member["addr"]
+                if member.get("retired"):
+                    # A planned departure stays departed across a router
+                    # restart — resurrecting it would undo a scale-down.
+                    w.retired = True
+                    w.alive = False
         self._ring = HashRing(replicas=self.config.ring_replicas)
         # Mesh-owning worker slots (config-derived — stable across
         # incarnations): oversize solves hash onto this subring.
         k = self.config.sharded_lane_workers
-        self._lane_ids = set(range(n if k == -1 else max(0, min(k, n))))
+        # -1 = every worker, including slots a journal restored beyond n.
+        self._lane_ids = set(range(
+            len(self._workers) if k == -1 else max(0, min(k, n))
+        ))
+        if self._journal_state is not None:
+            for wid, member in self._journal_state.members.items():
+                if member.get("lane") is None or wid >= len(self._workers):
+                    continue
+                # Restore the lane subring the crashed router had built —
+                # it is capability-derived for dialed standbys, so config
+                # alone would mis-place them (a -1 config would drag a
+                # lane-less standby onto the oversize ring; a k-bounded
+                # one would drop a lane-capable standby off it).
+                if member["lane"]:
+                    self._lane_ids.add(wid)
+                else:
+                    self._lane_ids.discard(wid)
         self._lane_ring = HashRing(replicas=self.config.ring_replicas)
         self._ring_lock = threading.Lock()
+        # Chaos layer: one standing fault-flag object per worker slot,
+        # shared across its transport incarnations (a partition outlives
+        # a re-dial). Empty unless config.chaos.
+        self._chaos: Dict[int, ChaosState] = {}
         self._sessions: Dict[str, int] = {}  # update-session digest -> worker
         # digest -> worker that LAST answered it ok (the forwarding hop's
         # owner-of-record; survives ring changes that move ownership).
@@ -318,7 +386,11 @@ class FleetRouter:
         # operations are deliberately one-at-a-time — the hysteresis the
         # autoscaler's determinism rests on.
         self._pool_lock = threading.Lock()
-        self.last_scale_decision: Optional[dict] = None
+        self.last_scale_decision: Optional[dict] = (
+            dict(self._journal_state.last_scale)
+            if self._journal_state is not None
+            and self._journal_state.last_scale else None
+        )
 
     # -- lifecycle -----------------------------------------------------
     def start(self) -> "FleetRouter":
@@ -333,6 +405,8 @@ class FleetRouter:
                 pipelined=self.config.pipelined_io,
             )
         for w in self._workers:
+            if w.retired:
+                continue  # journal-restored planned departures stay gone
             if w.addr is not None:
                 threading.Thread(
                     target=self._connect_remote, args=(w,),
@@ -342,6 +416,8 @@ class FleetRouter:
                 self._spawn(w)
         deadline = time.monotonic() + self.config.ready_timeout_s
         for w in self._workers:
+            if w.retired:
+                continue
             if not w.ready.wait(max(0.0, deadline - time.monotonic())):
                 rejections = "; ".join(self._hello_rejections[-3:])
                 self.shutdown(drain=False)
@@ -353,16 +429,114 @@ class FleetRouter:
         now = time.monotonic()
         with self._ring_lock:
             for w in self._workers:
+                if w.retired:
+                    continue
                 w.alive = True
                 w.last_pong = now
                 self._ring.add(w.id)
                 if w.id in self._lane_ids:
                     self._lane_ring.add(w.id)
+        for w in self._workers:
+            if not w.retired:
+                self._journal_ring("add", w)
+        self._adopt_journal_state()
         self._heartbeat = threading.Thread(
             target=self._heartbeat_loop, name="fleet-heartbeat", daemon=True
         )
         self._heartbeat.start()
         return self
+
+    # -- journal hooks (no-ops without a journal_dir) -------------------
+    def _journal_ring(self, action: str, w: _Worker) -> None:
+        if self._journal is not None:
+            try:
+                self._journal.ring(
+                    action, w.id, addr=w.addr,
+                    lane=w.id in self._lane_ids,
+                )
+            except (OSError, TimeoutError):
+                BUS.count("fleet.router.journal.ring_failed")
+
+    def _journal_answer(self, jid, *, ok, worker=None, digest=None) -> None:
+        if self._journal is None or jid is None:
+            return
+        try:
+            self._journal.answer(jid, ok=ok, worker=worker, digest=digest)
+        except (OSError, TimeoutError):
+            # A failed answer append degrades to a spurious (idempotent)
+            # replay after a crash, never to a lost query.
+            BUS.count("fleet.router.journal.answer_failed")
+
+    def _adopt_journal_state(self) -> None:
+        """Restart-with-warm-re-adoption: restore session pins and the
+        forwarding affinity map from the journal (live workers only — a
+        pin on a slot that did not come back would route at a corpse),
+        then re-queue every accepted-but-unanswered entry by digest on a
+        background thread (idempotent: results are content-addressed, so
+        an answer the crashed router never delivered is recomputed or
+        cache-hit, never double-committed)."""
+        state = self._journal_state
+        if state is None or not state.had_state:
+            return
+        self._journal_state = None  # one-shot: adoption happens at boot
+        with self._ring_lock:
+            for digest, wid in state.pins.items():
+                if wid < len(self._workers) and self._workers[wid].alive:
+                    self._sessions[digest] = wid
+            for digest, wid in state.served.items():
+                if wid < len(self._workers) and self._workers[wid].alive:
+                    self._last_served[digest] = wid
+        for w in self._workers:
+            if not w.alive:
+                continue
+            if w.addr is not None:
+                # A --listen worker that outlived the crashed router: the
+                # re-dial found its caches and sessions warm.
+                BUS.count("fleet.router.restart.readopted")
+            else:
+                BUS.count("fleet.router.restart.respawned")
+        orphans = state.unanswered
+        BUS.instant(
+            "fleet.router.restart", cat="fleet",
+            orphans=len(orphans), pins=len(state.pins),
+            served=len(state.served), dropped=state.dropped,
+        )
+        if orphans:
+            threading.Thread(
+                target=self._replay_orphans, args=(list(orphans.values()),),
+                name="fleet-journal-replay", daemon=True,
+            ).start()
+
+    def _replay_orphans(self, orphans: List[dict]) -> None:
+        """Answer the crashed router's accepted-but-unanswered ledger.
+        The original clients are gone (they died with the old router's
+        sockets), so the *answer* here is the durable journal record: the
+        query was accepted, it got executed, nothing was lost — and a
+        client that retries the same content-addressed request gets a
+        warm cache hit."""
+        for entry in orphans:
+            if self._closed:
+                return
+            BUS.count("fleet.router.restart.requeued")
+            p = _Pending(
+                entry.get("req") or {}, entry.get("key"), entry.get("cls"),
+                lane=bool(entry.get("lane")),
+            )
+            err = self._dispatch(p, allow_shed=False)
+            if err is not None:
+                self._journal_answer(entry.get("jid"), ok=False)
+                continue
+            if not p.event.wait(self.config.request_timeout_s):
+                self._forget(p)
+                self._journal_answer(entry.get("jid"), ok=False)
+                continue
+            resp = p.response or {}
+            self._journal_answer(
+                entry.get("jid"), ok=bool(resp.get("ok")),
+                worker=p.worker_id, digest=resp.get("digest"),
+            )
+            if resp.get("ok"):
+                BUS.count("fleet.router.restart.replayed")
 
     def __enter__(self) -> "FleetRouter":
         return self.start()
@@ -502,7 +676,9 @@ class FleetRouter:
                     argv, stdin=subprocess.PIPE, stdout=subprocess.PIPE,
                     env=env,
                 )
-                w.transport = PipeTransport(w.proc.stdin, w.proc.stdout)
+                w.transport = self._wrap_transport(
+                    w, PipeTransport(w.proc.stdin, w.proc.stdout)
+                )
         if not tcp:
             threading.Thread(
                 target=self._reader,
@@ -512,6 +688,68 @@ class FleetRouter:
             ).start()
         # tcp: the reader starts when the worker's dial-in hello arrives
         # (_on_dial_in); until then the slot has no channel.
+
+    # -- chaos wrapping ------------------------------------------------
+    def _wrap_transport(self, w: _Worker, transport: Transport) -> Transport:
+        """With ``config.chaos``, wrap a freshly established channel in
+        the fault-injectable layer, bound to the worker's STANDING flag
+        object — a partition set on the slot applies to every future
+        incarnation until healed."""
+        if not self.config.chaos:
+            return transport
+        state = self._chaos.get(w.id)
+        if state is None:
+            state = self._chaos[w.id] = ChaosState(
+                seed=self.config.chaos_seed, name=str(w.id)
+            )
+        return ChaosTransport(transport, state)
+
+    def partition_worker(self, worker_id: int, *, mode: str = "oneway") -> None:
+        """Partition one worker's link (drills; needs ``config.chaos``).
+
+        ``oneway``: router→worker frames vanish while worker→router still
+        flows — the nastiest shape, because the worker looks alive (its
+        in-flight responses keep arriving) right up until silence expires
+        the lease. ``sym``: both directions drop. Unlike
+        :meth:`close_worker_connection` the socket stays OPEN — detection
+        must come from the lease, not EOF."""
+        if not self.config.chaos:
+            raise RuntimeError("partition_worker needs FleetConfig(chaos=True)")
+        if mode not in ("oneway", "sym"):
+            raise ValueError(f"mode must be 'oneway' or 'sym', got {mode!r}")
+        state = self._chaos.setdefault(worker_id, ChaosState(
+            seed=self.config.chaos_seed, name=str(worker_id)
+        ))
+        state.drop_send = True
+        state.drop_recv = mode == "sym"
+        BUS.count("fleet.chaos.partition")
+        BUS.instant("fleet.chaos.partition", cat="fleet",
+                    worker=worker_id, mode=mode)
+
+    def heal_partition(self, worker_id: int) -> None:
+        """Heal a drill partition: frames flow again and the redial loop's
+        next knock completes — a warm rejoin, never a cold restart."""
+        state = self._chaos.get(worker_id)
+        if state is None:
+            return
+        state.drop_send = False
+        state.drop_recv = False
+        BUS.count("fleet.chaos.heal")
+        BUS.instant("fleet.chaos.heal", cat="fleet", worker=worker_id)
+
+    def set_worker_latency(
+        self, worker_id: int, latency_s: float, jitter_s: float = 0.0
+    ) -> None:
+        """Add seeded latency/jitter to one worker's outbound frames."""
+        if not self.config.chaos:
+            raise RuntimeError(
+                "set_worker_latency needs FleetConfig(chaos=True)"
+            )
+        state = self._chaos.setdefault(worker_id, ChaosState(
+            seed=self.config.chaos_seed, name=str(worker_id)
+        ))
+        state.latency_s = float(latency_s)
+        state.jitter_s = float(jitter_s)
 
     # -- connection establishment (tcp) --------------------------------
     def _on_hello_reject(self, reason: str) -> None:
@@ -536,6 +774,7 @@ class FleetRouter:
                 )
             if w.transport is not None and not w.transport.closed:
                 raise HelloError(f"worker {wid} already connected")
+            transport = self._wrap_transport(w, transport)
             w.transport = transport
             incarnation = w.incarnation
         self._register_hello(w, hello)
@@ -551,6 +790,12 @@ class FleetRouter:
         answers with a valid hello or the ready timeout passes."""
         deadline = time.monotonic() + self.config.ready_timeout_s
         while not self._closed and time.monotonic() < deadline:
+            state = self._chaos.get(w.id)
+            if state is not None and state.partitioned:
+                # A partitioned endpoint cannot complete a dial either —
+                # the redial loop keeps knocking until the drill heals it.
+                time.sleep(0.1)
+                continue
             try:
                 hello, transport = connect_to_worker(
                     w.addr, pipelined=self.config.pipelined_io
@@ -578,6 +823,7 @@ class FleetRouter:
             with w.lock:
                 w.incarnation += 1
                 incarnation = w.incarnation
+                transport = self._wrap_transport(w, transport)
                 w.transport = transport
             self._register_hello(w, hello)
             threading.Thread(
@@ -683,6 +929,14 @@ class FleetRouter:
             self._sessions[digest] = worker_id
             while len(self._sessions) > _SESSION_MAP_CAP:
                 self._sessions.pop(next(iter(self._sessions)))
+        if self._journal is not None:
+            try:
+                self._journal.pin(digest, worker_id, prev=prev)
+            except (OSError, TimeoutError):
+                # A lost pin degrades to one post-restart ring-routed hop
+                # (the session worker answers `no session` / stale and the
+                # chain re-syncs) — never to a lost query.
+                BUS.count("fleet.router.journal.pin_failed")
 
     def _note_served(self, digest: str, worker_id: int) -> None:
         with self._ring_lock:
@@ -758,6 +1012,7 @@ class FleetRouter:
                 # Its warm copies died with it (memory) or became
                 # unreachable (its host-local disk): stop forwarding there.
                 del self._last_served[digest]
+        self._journal_ring("retire" if retiring else "remove", w)
         with w.lock:
             orphans = list(w.pending.values())
             w.pending.clear()
@@ -862,6 +1117,7 @@ class FleetRouter:
                 # ("already at max" while real capacity is below it).
                 with self._ring_lock:
                     w.retired = True
+                self._journal_ring("retire", w)
                 return
             backoff = self._backoff_s(w.id, w.restarts)
             w.restarts += 1
@@ -885,6 +1141,7 @@ class FleetRouter:
                     self._ring.add(w.id)
                     if w.id in self._lane_ids:
                         self._lane_ring.add(w.id)
+                self._journal_ring("add", w)
                 BUS.count("fleet.worker.restart")
                 BUS.instant("fleet.worker.rejoin", cat="fleet", worker=w.id,
                             incarnation=w.incarnation, backoff_s=backoff)
@@ -917,8 +1174,19 @@ class FleetRouter:
 
     def note_scale_decision(self, decision: dict) -> None:
         """Record the latest scale decision (the stats op reports it, so an
-        operator can see WHY the fleet is its current size)."""
-        self.last_scale_decision = dict(decision)
+        operator can see WHY the fleet is its current size). With a
+        journal, the decision — wall-clock stamped — is durable too: a
+        restarted router hands it back to its autoscaler, whose cooldown
+        then spans the crash instead of resetting (a crash-loop must not
+        double-scale a fleet that just scaled)."""
+        decision = dict(decision)
+        decision.setdefault("at", time.time())
+        self.last_scale_decision = decision
+        if self._journal is not None:
+            try:
+                self._journal.scale(decision)
+            except (OSError, TimeoutError):
+                BUS.count("fleet.router.journal.scale_failed")
 
     def add_worker(
         self, *, addr: Optional[str] = None,
@@ -992,6 +1260,7 @@ class FleetRouter:
                     self._lane_ids.add(w.id)
                 if w.id in self._lane_ids:
                     self._lane_ring.add(w.id)
+            self._journal_ring("add", w)
             BUS.count("fleet.scale.up")
             BUS.record("fleet.join.warm_s", warm_s)
             BUS.instant("fleet.join", cat="fleet", worker=w.id,
@@ -1007,6 +1276,7 @@ class FleetRouter:
             w.draining = False
             w.alive = False
             w.ready.clear()
+        self._journal_ring("retire", w)
         with w.lock:
             proc, transport = w.proc, w.transport
         if proc is not None and proc.poll() is None:
@@ -1127,6 +1397,7 @@ class FleetRouter:
                 w.ready.clear()
             if transport is not None:
                 transport.close(flush=False)
+            self._journal_ring("retire", w)
             BUS.count("fleet.scale.down")
             BUS.instant(
                 "fleet.retire", cat="fleet", worker=w.id,
@@ -1345,11 +1616,37 @@ class FleetRouter:
             # empty lane ring and pollute the lane_fallback counter
             # (documented as the all-lane-workers-down signal).
             lane = bool(self._lane_ids) and _request_oversize(request)
+            jid = None
+            if self._journal is not None:
+                # The accept ack is GATED on the durable append: dispatch
+                # happens only after the journal fsync returns, so a
+                # router crash can never lose an acknowledged query. A
+                # journal that cannot append refuses the work — accepting
+                # without durability would be the round-12 router again.
+                try:
+                    jid = self._journal.accept(
+                        request, key=key, cls=cls, lane=lane
+                    )
+                except (OSError, TimeoutError) as e:
+                    BUS.count("fleet.errors")
+                    span.set(ok=False)
+                    err = {"ok": False, "op": op,
+                           "error": f"journal append failed: {e}"}
+                    if self._closed:
+                        # The append lost the race with crash(): the
+                        # query was never acknowledged — clients retry on
+                        # the successor like any crash-window request.
+                        err["router_crashed"] = True
+                    return err
             if self.config.forward_enabled:
                 forwarded = self._forward_probe(request, key, cls, lane)
                 if forwarded is not None:
                     span.set(ok=True, worker=forwarded.get("worker"),
                              forwarded=True)
+                    self._journal_answer(
+                        jid, ok=True, worker=forwarded.get("worker"),
+                        digest=forwarded.get("digest"),
+                    )
                     return forwarded
             p = _Pending(request, key, cls, lane=lane)
             err = self._dispatch(p)
@@ -1359,16 +1656,26 @@ class FleetRouter:
                     BUS.count("fleet.errors")
                 if cls is not None:
                     err.setdefault("slo_class", cls)
+                if not err.get("router_crashed"):
+                    # A crashed router never acknowledged failure — those
+                    # accepts stay unanswered so the restart replays them.
+                    self._journal_answer(jid, ok=False)
                 return err
             if not p.event.wait(self.config.request_timeout_s):
                 BUS.count("fleet.timeout")
                 span.set(ok=False)
                 self._forget(p)
+                self._journal_answer(jid, ok=False)
                 return {"ok": False, "op": op,
                         "error": "request timed out in the fleet"}
             response = dict(p.response)
             span.set(ok=bool(response.get("ok")), worker=p.worker_id,
                      requeues=p.requeues)
+            if not response.get("router_crashed"):
+                self._journal_answer(
+                    jid, ok=bool(response.get("ok")), worker=p.worker_id,
+                    digest=response.get("digest"),
+                )
             response.setdefault("worker", p.worker_id)
             if p.requeues:
                 response.setdefault("requeued", p.requeues)
@@ -1492,9 +1799,54 @@ class FleetRouter:
         join = BUS.histograms().get("fleet.join.warm_s")
         if join and join.get("count"):
             out["join_warm_s"] = join
+        if self._journal is not None:
+            unanswered, next_jid = self._journal.status()
+            out["journal"] = {
+                "dir": self.config.journal_dir,
+                "accepted": next_jid - 1,
+                "unanswered": unanswered,
+            }
         return out
 
     # -- chaos/drill surface -------------------------------------------
+    def crash(self) -> None:
+        """Simulate abrupt router-process death (drills). Everything a
+        real crash would do to the *world* happens — channels hard-close
+        without drain (``--listen`` workers return to accept with their
+        caches warm), in-flight callers get an error, NOTHING more is
+        journaled (a dead process appends nothing) — while the test
+        process survives to boot the successor: a new
+        :class:`FleetRouter` on the same ``journal_dir`` re-adopts the
+        live workers and replays the orphaned accepts."""
+        BUS.count("fleet.router.crash")
+        self._closed = True
+        if self._journal is not None:
+            # Synchronous: an in-flight accept finishes its durable
+            # append before this returns (its owner got a real ack);
+            # everything after raises OSError — a dead process appends
+            # nothing, and a late append would collide with the
+            # successor's sequence numbers. The reference itself stays
+            # set: nulling it would race request threads between their
+            # None-check and the call (AttributeError instead of the
+            # caught OSError -> router_crashed error the clients retry
+            # on).
+            self._journal.close()
+        if self._listener is not None:
+            self._listener.close()
+        for w in self._workers:
+            with w.lock:
+                orphans = list(w.pending.values())
+                w.pending.clear()
+                transport = w.transport
+            for p in orphans:
+                p.response = {
+                    "ok": False, "op": p.request.get("op"),
+                    "error": "router crashed", "router_crashed": True,
+                }
+                p.event.set()
+            if transport is not None:
+                transport.close(flush=False)
+
     def kill_worker(self, worker_id: int) -> None:
         """SIGKILL one worker mid-traffic (drills). Failover is automatic.
         Remote workers have no process handle here — their connection is

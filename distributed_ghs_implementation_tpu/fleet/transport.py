@@ -192,7 +192,9 @@ class PipeTransport(Transport):
         self.frames = 0
 
     def send(self, obj: dict) -> None:
-        data = encode_frame(obj)
+        self.send_bytes(encode_frame(obj))
+
+    def send_bytes(self, data: bytes) -> None:
         with self._lock:
             if self._closed:
                 raise TransportClosed("pipe transport closed")
@@ -275,7 +277,9 @@ class SocketTransport(Transport):
 
     # -- writing -------------------------------------------------------
     def send(self, obj: dict) -> None:
-        data = encode_frame(obj)
+        self.send_bytes(encode_frame(obj))
+
+    def send_bytes(self, data: bytes) -> None:
         if self._pipelined:
             with self._wake:
                 if self._closed:
@@ -362,6 +366,166 @@ class SocketTransport(Transport):
     @property
     def closed(self) -> bool:
         return self._closed
+
+
+# ----------------------------------------------------------------------
+# Chaos layer (round 18): dirty-link fault injection
+# ----------------------------------------------------------------------
+#: Fault-registry sites (``utils.resilience.FAULTS`` / ``GHS_FAULT_*``)
+#: the chaos wrapper consults per frame. All existing drills inject only
+#: CLEAN failures (os._exit, socket close); these are the dirty ones real
+#: cross-host links produce:
+#:
+#: * ``fleet.chaos.drop``    — drop the next N outbound frames (kind
+#:   ``raise``; ``GHS_FAULT_FLEET_CHAOS_DROP=N``) — a transient blackhole.
+#: * ``fleet.chaos.corrupt`` — corrupt the next N outbound frames' bytes
+#:   (kind ``torn``; the peer's framing raises ``FrameError`` and drops
+#:   the channel — the corrupt-prefix-must-not-size-an-allocation path).
+#: * ``fleet.chaos.delay``   — add ``value`` seconds to the next N sends
+#:   (kind ``slow``) — a latency spike.
+CHAOS_DROP_SITE = "fleet.chaos.drop"
+CHAOS_CORRUPT_SITE = "fleet.chaos.corrupt"
+CHAOS_DELAY_SITE = "fleet.chaos.delay"
+
+
+class ChaosState:
+    """One worker's standing fault flags, OWNED BY THE ROUTER and shared
+    across that worker's transport incarnations — a partition outlives a
+    re-dial (the new connection is just as partitioned), which is what
+    makes the partition drill's flap-until-healed behavior honest.
+
+    ``drop_send`` alone is a **one-way partition** (router→worker frames
+    vanish; the worker's responses still arrive, so the router sees a
+    live-but-unreachable peer until its in-flight work drains and the
+    lease expires). ``drop_recv`` too makes it **symmetric**. Latency and
+    jitter model a congested link; jitter is deterministic under
+    ``seed`` (same drill, same delays)."""
+
+    def __init__(self, *, seed: int = 0, name: str = ""):
+        import random
+
+        self.drop_send = False
+        self.drop_recv = False
+        self.latency_s = 0.0
+        self.jitter_s = 0.0
+        self._rng = random.Random(f"{seed}:{name}")
+
+    @property
+    def partitioned(self) -> bool:
+        return self.drop_send or self.drop_recv
+
+    def delay(self) -> float:
+        if self.latency_s <= 0 and self.jitter_s <= 0:
+            return 0.0
+        return self.latency_s + self.jitter_s * self._rng.random()
+
+    def corrupt(self, data: bytes) -> bytes:
+        """Deterministically mangle a frame's bytes. The length prefix is
+        always hit (digit ^ 0x5A = letter): the peer must refuse the
+        header outright — a flip that only grew the declared length would
+        instead wedge its reader waiting for bytes that never come, which
+        is the lease's job to catch, not framing's — plus seeded interior
+        flips so payload-level garbage is exercised too."""
+        if not data:
+            return data
+        buf = bytearray(data)
+        buf[0] ^= 0x5A
+        if len(buf) > 1:
+            # Interior flips start at 1: a flip landing back on byte 0
+            # would XOR-revert the mandatory prefix mangle and ship a
+            # byte-identical "corrupted" frame.
+            for _ in range(max(1, len(buf) // 16)):
+                i = self._rng.randrange(1, len(buf))
+                buf[i] ^= 0x5A
+        return bytes(buf)
+
+
+class ChaosTransport(Transport):
+    """A fault-injectable wrapper around any :class:`Transport`.
+
+    Every outbound frame consults the standing :class:`ChaosState` flags
+    plus the ``fleet.chaos.*`` fault-registry sites; inbound frames honor
+    the symmetric-partition flag by being read and discarded (from the
+    protocol's point of view, identical to the network never delivering
+    them). Dropping is *silent* — exactly like a real partition: the
+    sender learns nothing until silence expires the lease.
+    """
+
+    def __init__(self, inner: Transport, state: ChaosState):
+        self._inner = inner
+        self.state = state
+
+    @property
+    def kind(self) -> str:  # the router keys lease accounting off this
+        return self._inner.kind
+
+    @property
+    def writes(self) -> int:
+        return self._inner.writes
+
+    @property
+    def frames(self) -> int:
+        return self._inner.frames
+
+    @property
+    def peer(self):
+        return getattr(self._inner, "peer", None)
+
+    def send(self, obj: dict) -> None:
+        from distributed_ghs_implementation_tpu.utils.resilience import FAULTS
+
+        data = encode_frame(obj)
+        state = self.state
+        armed_delay = FAULTS.pop(CHAOS_DELAY_SITE)
+        delay = state.delay() + (
+            armed_delay.value if armed_delay is not None else 0.0
+        )
+        if delay > 0:
+            import time
+
+            from distributed_ghs_implementation_tpu.obs.events import BUS
+
+            BUS.record("fleet.chaos.delay_s", delay)
+            time.sleep(delay)
+        # Pop the one-shot drop AND corrupt sites BEFORE the standing-
+        # partition return: short-circuiting would leave an armed shot
+        # unconsumed behind a partition and fire it on the first
+        # post-heal frame instead (a "healed" link that immediately
+        # drops or corrupts would read as a failed warm rejoin).
+        drop_shot = FAULTS.pop(CHAOS_DROP_SITE)
+        corrupt_shot = FAULTS.pop(CHAOS_CORRUPT_SITE)
+        if state.drop_send or drop_shot is not None:
+            from distributed_ghs_implementation_tpu.obs.events import BUS
+
+            BUS.count("fleet.chaos.dropped")
+            return  # a partitioned link swallows the frame silently
+        if corrupt_shot is not None:
+            from distributed_ghs_implementation_tpu.obs.events import BUS
+
+            BUS.count("fleet.chaos.corrupted")
+            data = state.corrupt(data)
+        self._inner.send_bytes(data)
+
+    def recv(self) -> Optional[dict]:
+        while True:
+            frame = self._inner.recv()
+            if frame is None or not self.state.drop_recv:
+                return frame
+            from distributed_ghs_implementation_tpu.obs.events import BUS
+
+            BUS.count("fleet.chaos.dropped")  # symmetric partition: eat it
+
+    def flush(self, timeout_s: float = 5.0) -> None:
+        inner_flush = getattr(self._inner, "flush", None)
+        if inner_flush is not None:
+            inner_flush(timeout_s)
+
+    def close(self, *, flush: bool = True) -> None:
+        self._inner.close(flush=flush)
+
+    @property
+    def closed(self) -> bool:
+        return self._inner.closed
 
 
 # ----------------------------------------------------------------------

@@ -77,6 +77,13 @@ from distributed_ghs_implementation_tpu.fleet.transport import (
 )
 
 CRASH_SITE = "fleet.worker.crash"
+#: Armed with kind="slow", stalls the worker's next request INSIDE its
+#: pool thread for `value` seconds — a deterministic stand-in for a long
+#: oversize solve. The read loop keeps answering pings throughout (pongs
+#: are out-of-band by construction), which is exactly what the
+#: busy-is-not-dead lease test pins: `fleet.lease.expired` must never
+#: fire on a healthy-but-busy worker.
+SLOW_SITE = "fleet.worker.slow"
 CRASH_EXIT_CODE = 17  # distinguishable from drain (0) and tracebacks (1)
 
 
@@ -217,6 +224,12 @@ def _serve_connection(transport: Transport, service, pool) -> str:
         shot = FAULTS.pop(CRASH_SITE)
         if shot is not None and shot.remaining == 0:
             os._exit(CRASH_EXIT_CODE)  # a real crash: no response, no flush
+        slow = FAULTS.pop(SLOW_SITE)
+        if slow is not None and slow.kind == "slow":
+            # A long solve, without needing a graph big enough to be one:
+            # the stall happens on a pool thread, so the read loop's
+            # inline pongs keep flowing — busy, not dead.
+            time.sleep(slow.value)
         t0 = time.perf_counter()
         try:
             response = service.handle(request)

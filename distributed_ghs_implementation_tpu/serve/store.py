@@ -32,7 +32,6 @@ import collections
 import contextlib
 import os
 import threading
-import time
 from typing import Optional
 
 import numpy as np
@@ -45,6 +44,10 @@ except ImportError:  # pragma: no cover — non-POSIX: single-writer only
 from distributed_ghs_implementation_tpu.api import MSTResult
 from distributed_ghs_implementation_tpu.graphs.edgelist import Graph
 from distributed_ghs_implementation_tpu.obs.events import BUS
+from distributed_ghs_implementation_tpu.utils.locking import (
+    LOCK_TIMEOUT_S,
+    flocked,
+)
 
 
 def solve_cache_key(graph: Graph, *, backend: str = "device") -> str:
@@ -73,63 +76,16 @@ def _disk_path(disk_dir: str, key: str) -> str:
     return os.path.join(disk_dir, key.replace(":", "_") + ".npz")
 
 
-#: How long a writer waits for a contended per-key lock before giving up
-#: (the write-behind is best-effort; a timeout is a skipped cache fill,
-#: never a failed request).
-_LOCK_TIMEOUT_S = 2.0
-_LOCK_POLL_S = 0.005
+#: Advisory per-key write locking now lives in ``utils/locking.py`` (the
+#: router journal needs it without the serve stack on its import path);
+#: ``_flocked`` stays as the public-in-practice alias the stream log and
+#: the fleet docs reference, with the historical timeout + counter names.
+_LOCK_TIMEOUT_S = LOCK_TIMEOUT_S
 
 
-@contextlib.contextmanager
 def _flocked(path: str, timeout_s: float = _LOCK_TIMEOUT_S):
-    """Advisory per-key write lock (``<path>.lock``, ``fcntl.flock``).
-
-    Fleet workers share one ``disk_dir``; without this, two processes
-    publishing the same digest can interleave the ``.bak`` rotation inside
-    :func:`~...utils.checkpoint.atomic_write_npz` (rotate, rotate, rename,
-    rename) and momentarily leave BOTH generations holding the same bytes —
-    or rotate a half-published primary over the last good ``.bak``. The
-    lock serializes *writers only*: the read path stays lock-free (rename
-    is atomic and reads re-validate digests), so lookups never block on a
-    slow writer. Raises ``TimeoutError`` past ``timeout_s``; holding
-    processes that die release the lock automatically (flock is
-    fd-scoped, the kernel drops it on process exit).
-    """
-    if fcntl is None:
-        yield
-        return
-    # The lock file precedes the npz (the writer beneath us creates the
-    # directory lazily — the lock must not fail on a fresh disk_dir).
-    os.makedirs(os.path.dirname(os.path.abspath(path)) or ".", exist_ok=True)
-    lock_path = path + ".lock"
-    deadline = time.monotonic() + timeout_s
-    while True:
-        fd = os.open(lock_path, os.O_CREAT | os.O_RDWR, 0o644)
-        try:
-            try:
-                fcntl.flock(fd, fcntl.LOCK_EX | fcntl.LOCK_NB)
-            except OSError:
-                if time.monotonic() >= deadline:
-                    BUS.count("serve.store.lock_timeout")
-                    raise TimeoutError(
-                        f"store write lock busy > {timeout_s}s: {path}"
-                    ) from None
-                time.sleep(_LOCK_POLL_S)
-                continue
-            # Re-validate after acquiring: the sweep may have unlinked this
-            # lock file between our open and our flock, in which case we
-            # hold a lock on an anonymous inode while a newer writer holds
-            # one on the recreated file — retry on the current file.
-            try:
-                current_ino = os.stat(lock_path).st_ino
-            except FileNotFoundError:
-                current_ino = -1
-            if os.fstat(fd).st_ino != current_ino:
-                continue  # stale inode: reopen and re-acquire
-            yield
-            return
-        finally:
-            os.close(fd)  # closing the fd releases the flock
+    """Advisory per-key write lock (see :func:`utils.locking.flocked`)."""
+    return flocked(path, timeout_s, counter="serve.store.lock_timeout")
 
 
 class ResultStore:

@@ -15,8 +15,11 @@ full fresh solve. This module gives each stream a directory under the
   (``ghs-stream-wal-v1``: seq, prev/new digest, the raw updates). Appends
   are flushed + fsynced and serialized across processes by the same
   advisory per-path flock the shared result store uses
-  (``serve.store._flocked``) — the two-process hammer test drives exactly
-  that interleaving.
+  (``utils/locking.py``) — the two-process hammer test drives exactly
+  that interleaving. The append/seal/read/compact mechanics live in the
+  reusable :class:`utils.wal.JsonlWal` core (factored out in round 18 so
+  the router's accepted-work journal shares them); this module keeps the
+  stream-specific *chain* semantics on top.
 
 **Replay** (:meth:`UpdateLog.load`) is snapshot-then-deltas: the newest
 loadable snapshot generation (primary, else ``.bak``) plus every WAL entry
@@ -47,17 +50,8 @@ from distributed_ghs_implementation_tpu.obs.events import BUS
 from distributed_ghs_implementation_tpu.utils.checkpoint import (
     atomic_write_npz,
 )
-
-
-def _flocked(path: str):
-    """The shared advisory per-path write lock (``serve.store._flocked``),
-    imported lazily: ``serve`` imports ``stream`` for the service verbs,
-    so a module-level import here would close an import cycle."""
-    from distributed_ghs_implementation_tpu.serve.store import (
-        _flocked as flocked,
-    )
-
-    return flocked(path)
+from distributed_ghs_implementation_tpu.utils.locking import flocked as _flocked
+from distributed_ghs_implementation_tpu.utils.wal import JsonlWal
 
 WAL_SCHEMA = "ghs-stream-wal-v1"
 FAULT_SITE = "stream.log.save"
@@ -78,6 +72,17 @@ class ChainBreak(ValueError):
         super().__init__(msg)
         self.seq = seq
         self.digest = digest
+
+
+def _wal_entry(rec: dict) -> dict:
+    """One schema-checked WAL record -> the replay entry shape (raising
+    marks the line unparsable, exactly like non-JSON bytes)."""
+    return {
+        "seq": int(rec["seq"]),
+        "prev": rec["prev"],
+        "digest": rec["digest"],
+        "updates": rec["updates"],
+    }
 
 
 def stream_dir(root: str, stream_id: str) -> str:
@@ -104,6 +109,15 @@ class UpdateLog:
         self.dir = stream_dir(root, stream_id)
         self.snap_path = os.path.join(self.dir, "snapshot.npz")
         self.wal_path = os.path.join(self.dir, "wal.jsonl")
+        # The shared append/seal/read/compact mechanics (utils/wal.py);
+        # chain semantics — what makes an entry FOLLOW its predecessor —
+        # stay here.
+        self._wal = JsonlWal(
+            self.wal_path,
+            schema=WAL_SCHEMA,
+            counter_prefix="stream.log",
+            validate=_wal_entry,
+        )
 
     # -- writing -------------------------------------------------------
     def append(
@@ -120,14 +134,7 @@ class UpdateLog:
         matched) after another worker already committed past it.
         """
         os.makedirs(self.dir, exist_ok=True)
-        line = json.dumps({
-            "schema": WAL_SCHEMA,
-            "seq": int(seq),
-            "prev": prev_digest,
-            "digest": digest,
-            "updates": updates,
-        })
-        with _flocked(self.wal_path):
+        with self._wal.lock():
             tail = self._durable_head()
             if tail is not None and (
                 int(seq) != tail[0] + 1 or prev_digest != tail[1]
@@ -140,24 +147,18 @@ class UpdateLog:
                     seq=tail[0],
                     digest=tail[1],
                 )
-            # Seal a torn tail first: a crash mid-append leaves a partial
-            # line with no trailing newline, and writing straight after it
-            # would fuse this (durably committed) record onto the garbage,
-            # making it unparsable on replay.
-            seal = b""
-            try:
-                with open(self.wal_path, "rb") as rf:
-                    rf.seek(-1, os.SEEK_END)
-                    if rf.read(1) != b"\n":
-                        seal = b"\n"
-                        BUS.count("stream.log.sealed_torn")
-            except (FileNotFoundError, OSError):
-                pass  # empty or missing: nothing to seal
-            with open(self.wal_path, "ab") as f:
-                f.write(seal + (line + "\n").encode())
-                f.flush()
-                os.fsync(f.fileno())
-        BUS.count("stream.log.append")
+            # The core seals any torn tail before the write, so a crashed
+            # predecessor cannot make this (durably committed) record
+            # unparsable on replay.
+            self._wal.append(
+                {
+                    "seq": int(seq),
+                    "prev": prev_digest,
+                    "digest": digest,
+                    "updates": updates,
+                },
+                locked=True,
+            )
 
     def snapshot(
         self,
@@ -190,18 +191,12 @@ class UpdateLog:
         """Drop WAL entries the snapshot already covers (tmp + rename; a
         crash anywhere leaves entries replay skips by sequence number)."""
         try:
-            with _flocked(self.wal_path):
+            with self._wal.lock():
                 entries, _torn = self._read_wal()
                 keep = [e for e in entries if e["seq"] > covered_seq]
                 if len(keep) == len(entries):
                     return
-                tmp = self.wal_path + ".tmp"
-                with open(tmp, "w") as f:
-                    for e in keep:
-                        f.write(json.dumps({"schema": WAL_SCHEMA, **e}) + "\n")
-                    f.flush()
-                    os.fsync(f.fileno())
-                os.replace(tmp, self.wal_path)
+                self._wal.rewrite(keep, locked=True)
             BUS.count("stream.log.compact")
         except (OSError, TimeoutError):
             pass  # compaction is best-effort; replay skips covered entries
@@ -211,7 +206,7 @@ class UpdateLog:
         append, else the newest loadable snapshot head; ``None`` when
         neither exists (a bare log). Callers hold the WAL flock; reads
         here must not re-enter it."""
-        tail = self._tail_entry()
+        tail = self._wal.tail()
         if tail is not None:
             return tail["seq"], tail["digest"]
         for candidate in (self.snap_path, self.snap_path + ".bak"):
@@ -223,85 +218,12 @@ class UpdateLog:
         return None
 
     # -- reading -------------------------------------------------------
-    @staticmethod
-    def _parse_line(line: str) -> Optional[dict]:
-        """One WAL line -> entry dict, or ``None`` for anything torn,
-        unparsable, or schema-mismatched."""
-        try:
-            rec = json.loads(line)
-            if rec.get("schema") != WAL_SCHEMA:
-                raise ValueError(f"bad schema {rec.get('schema')!r}")
-            return {
-                "seq": int(rec["seq"]),
-                "prev": rec["prev"],
-                "digest": rec["digest"],
-                "updates": rec["updates"],
-            }
-        except (ValueError, KeyError, TypeError):
-            return None
-
-    def _tail_entry(self) -> Optional[dict]:
-        """Last complete, parsable WAL entry, found by a backwards chunked
-        scan of the file tail. ``append`` calls this under the flock on
-        every publish: compaction is best-effort, so the WAL can grow
-        without bound when snapshots keep failing, and reading the whole
-        file there would make each commit O(total WAL)."""
-        try:
-            size = os.path.getsize(self.wal_path)
-        except OSError:
-            return None
-        buf = b""
-        with open(self.wal_path, "rb") as f:
-            pos = size
-            while pos > 0:
-                step = min(65536, pos)
-                pos -= step
-                f.seek(pos)
-                buf = f.read(step) + buf
-                lines = buf.decode("utf-8", errors="replace").split("\n")
-                # lines[-1] is a torn tail (or empty past the final
-                # newline); lines[0] may be a mid-line fragment unless
-                # the scan reached the start of the file.
-                first = 0 if pos == 0 else 1
-                for line in reversed(lines[first:-1]):
-                    if not line.strip():
-                        continue
-                    entry = self._parse_line(line)
-                    if entry is not None:
-                        return entry
-        return None
-
     def _read_wal(self, count: bool = True) -> Tuple[List[dict], int]:
-        """Parse the WAL; returns ``(entries, torn_skipped)``. A partial
-        final line (torn append) is skipped; an unparsable line anywhere
-        else is also skipped (a sealed torn record from a retried append
-        sits mid-file) — whether the log is still usable past it is
-        decided by :meth:`load`'s chain validation, which stops at any
-        real gap."""
-        if not os.path.exists(self.wal_path):
-            return [], 0
-        with open(self.wal_path) as f:
-            raw = f.read()
-        entries: List[dict] = []
-        torn = 0
-        lines = raw.split("\n")
-        complete = lines[:-1]  # text after the final newline is a torn tail
-        if lines[-1]:
-            torn += 1
-        for i, line in enumerate(complete):
-            if not line.strip():
-                continue
-            entry = self._parse_line(line)
-            if entry is None:
-                if i == len(complete) - 1:
-                    torn += 1  # torn mid-record on the last complete line
-                elif count:
-                    BUS.count("stream.log.corrupt_line")
-                continue
-            entries.append(entry)
-        if torn and count:
-            BUS.count("stream.log.torn_skipped", torn)
-        return entries, torn
+        """Parse the WAL; returns ``(entries, torn_skipped)`` — the core's
+        tolerant read (torn tail and unparsable mid-log lines skipped);
+        whether the log is still usable past a skip is decided by
+        :meth:`load`'s chain validation, which stops at any real gap."""
+        return self._wal.read(count=count)
 
     def load_snapshot(self) -> Tuple[Optional[dict], List[Tuple[str, str]]]:
         """Newest loadable snapshot generation (primary, else ``.bak``);
@@ -382,7 +304,7 @@ class UpdateLog:
         clobbered. Best-effort like compaction: a failed rewrite leaves
         the pre-repair state."""
         try:
-            with _flocked(self.wal_path):
+            with self._wal.lock():
                 state, _notes = self.load_snapshot()
                 if state is None:
                     return
@@ -398,15 +320,7 @@ class UpdateLog:
                     seq, head = entry["seq"], entry["digest"]
                 if len(keep) == len(entries):
                     return
-                tmp = self.wal_path + ".tmp"
-                with open(tmp, "w") as f:
-                    for e in keep:
-                        f.write(
-                            json.dumps({"schema": WAL_SCHEMA, **e}) + "\n"
-                        )
-                    f.flush()
-                    os.fsync(f.fileno())
-                os.replace(tmp, self.wal_path)
+                self._wal.rewrite(keep, locked=True)
             BUS.count("stream.log.chain_truncated")
         except (OSError, TimeoutError):
             pass

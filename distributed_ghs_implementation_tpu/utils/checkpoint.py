@@ -26,6 +26,7 @@ from typing import List, Optional, Tuple
 import numpy as np
 
 from distributed_ghs_implementation_tpu.graphs.edgelist import Graph
+from distributed_ghs_implementation_tpu.utils.locking import fsync_dir
 from distributed_ghs_implementation_tpu.utils.resilience import FAULTS, InjectedFault
 
 
@@ -65,6 +66,17 @@ def atomic_write_npz(
     (or the armed ``fault_site``) leaves torn. Shared by solver checkpoints
     and the serve result store (``serve/store.py``, fault site
     ``serve.store.save``).
+
+    Durability regression note (round 18): the tmp file is fsynced before
+    the rename and the PARENT DIRECTORY is fsynced after it. The original
+    "atomic dance" stopped at ``os.replace``, which only orders the
+    rename against other metadata ops — on a journaling filesystem a host
+    crash (power loss, not process death) shortly after the rename could
+    replay the directory without the new entry, or land the entry while
+    the file's blocks were still unwritten, losing the checkpoint despite
+    the atomic rename. rename-without-dirfsync is durable *eventually*,
+    not at return — and every caller here (serve store publishes, stream
+    snapshots, checkpoint saves) treats return as the commit point.
     """
     d = os.path.dirname(os.path.abspath(path)) or "."
     os.makedirs(d, exist_ok=True)
@@ -73,6 +85,8 @@ def atomic_write_npz(
     try:
         with open(tmp, "wb") as f:
             np.savez_compressed(f, **{k: np.asarray(v) for k, v in arrays.items()})
+            f.flush()
+            os.fsync(f.fileno())
         if retain_previous and os.path.exists(path):
             import zipfile
 
@@ -94,6 +108,7 @@ def atomic_write_npz(
                     f.write(blob[: max(1, len(blob) // 2)])
             raise InjectedFault(f"injected fault at {fault_site} ({armed.kind})")
         os.replace(tmp, path)
+        fsync_dir(d)
     finally:
         if os.path.exists(tmp):
             os.unlink(tmp)

@@ -744,3 +744,328 @@ def test_router_rejects_wrong_protocol_version_with_clear_error():
     with pytest.raises(TimeoutError, match="protocol version mismatch"):
         router.start()
     router.shutdown(drain=False)
+
+
+# ----------------------------------------------------------------------
+# Router survivability (round 18): journal, crash, warm re-adoption
+# ----------------------------------------------------------------------
+def _listen_fleet_config(addrs, tmp_path=None, **overrides):
+    kwargs = dict(
+        remote_workers=tuple(addrs), transport="tcp", test_echo=True,
+        heartbeat_interval_s=0.1, restart_backoff_base_s=0.02,
+        restart_backoff_cap_s=0.2, ready_timeout_s=30.0,
+        request_timeout_s=30.0,
+    )
+    kwargs.update(overrides)
+    return FleetConfig(**kwargs)
+
+
+def test_router_crash_restart_readopts_workers_and_replays_journal(tmp_path):
+    # The round-18 contract end to end: a router crash with accepted work
+    # outstanding loses NOTHING — the successor on the same journal
+    # re-dials the still-live --listen workers (warm: handled counts
+    # persist), rebuilds pins/affinity, and re-queues the orphaned accept.
+    import threading
+
+    procs, addrs = zip(*[
+        _spawn_listening_worker(worker_id=i) for i in range(2)
+    ])
+    jdir = str(tmp_path / "journal")
+    try:
+        cfg = _listen_fleet_config(addrs, journal_dir=jdir)
+        r1 = FleetRouter(cfg).start()
+        for i in range(4):
+            assert r1.handle({"op": "solve", "digest": f"j{i}"})["ok"]
+        upd = r1.handle({"op": "update", "digest": "j0",
+                         "updates": [{"k": 1}]})
+        assert upd["ok"]
+        pin_digest, pin_worker = upd["digest"], upd["worker"]
+        pre_handled = r1.handle({"op": "stats"})["counters"]["echo.handled"]
+
+        results = []
+        t = threading.Thread(target=lambda: results.append(r1.handle(
+            {"op": "solve", "digest": "orphan", "sleep_s": 0.8}
+        )))
+        t.start()
+        time.sleep(0.25)  # the accept is journaled and in flight
+        r1.crash()
+        t.join(timeout=10)
+        assert results and results[0].get("router_crashed")
+
+        r2 = FleetRouter(cfg).start()
+        try:
+            deadline = time.monotonic() + 20
+            while time.monotonic() < deadline:
+                stats = r2.handle({"op": "stats"})
+                if stats["journal"]["unanswered"] == 0:
+                    break
+                time.sleep(0.1)
+            # Every journaled accept is answered after replay...
+            assert stats["journal"]["unanswered"] == 0
+            # ...the workers were re-adopted WARM (same processes: the
+            # pre-crash handled counts persist and keep growing)...
+            assert stats["counters"]["echo.handled"] > pre_handled
+            counters = BUS.counters()
+            assert counters.get("fleet.router.crash") == 1
+            assert counters.get("fleet.router.restart.readopted") == 2
+            assert counters.get("fleet.router.restart.requeued", 0) >= 1
+            assert counters.get("fleet.router.restart.replayed", 0) >= 1
+            # ...and the session pin survived: the chain continues on the
+            # worker holding the materialized session.
+            upd2 = r2.handle({"op": "update", "digest": pin_digest,
+                              "updates": [{"k": 2}]})
+            assert upd2["ok"] and upd2["worker"] == pin_worker
+        finally:
+            r2.shutdown()
+        for p in procs:
+            assert p.wait(timeout=20) == 0  # drained, exit 0
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+
+
+def test_journal_restores_scale_cooldown_across_restart(tmp_path):
+    # A restarted router must not double-scale: the journaled (wall-clock
+    # stamped) scale decision restores the autoscaler's cooldown window.
+    from distributed_ghs_implementation_tpu.fleet.autoscaler import (
+        Autoscaler,
+        ElasticPolicy,
+    )
+
+    jdir = str(tmp_path / "journal")
+    cfg = FleetConfig(workers=1, test_echo=True, journal_dir=jdir,
+                      ready_timeout_s=120.0)
+    with FleetRouter(cfg) as r1:
+        r1.note_scale_decision({"action": "up", "pool": 2, "reason": "x"})
+    r2 = FleetRouter(cfg)
+    try:
+        assert r2.last_scale_decision["action"] == "up"
+        scaler = Autoscaler(r2, ElasticPolicy(cooldown_s=3600.0))
+        # The cooldown clock survived the crash: a fresh autoscaler is
+        # already cooling, not free to immediately scale again.
+        assert scaler._last_scale_done > float("-inf")
+    finally:
+        r2.shutdown(drain=False)
+
+
+def test_busy_worker_answers_pongs_out_of_band_and_keeps_its_lease():
+    # Satellite: a long solve must NEVER trip the lease — pings are
+    # answered inline from the worker's read loop while the solve stalls
+    # a pool thread (fleet.worker.slow, the deterministic slow-solve
+    # hook). Lease 0.4s, solve 1.2s: three leases elapse while busy.
+    cfg = FleetConfig(
+        workers=2, test_echo=True, transport="tcp", worker_threads=1,
+        heartbeat_interval_s=0.1, lease_s=0.4, ready_timeout_s=120.0,
+        request_timeout_s=30.0,
+    )
+    with FleetRouter(cfg) as r:
+        victim = r.handle({"op": "solve", "digest": "busy-probe"})["worker"]
+        assert r.arm_worker_fault(
+            victim, site="fleet.worker.slow", kind="slow", value=1.2
+        )
+        resp = r.handle({"op": "solve", "digest": "busy-probe",
+                         "slo_class": "x"})
+        # Answered by the SAME worker after the stall — never re-queued,
+        # never declared dead mid-solve.
+        assert resp["ok"] and resp["worker"] == victim
+        assert "requeued" not in resp
+        counters = BUS.counters()
+        assert counters.get("fleet.lease.expired", 0) == 0
+        assert counters.get("fleet.heartbeat.miss", 0) == 0
+        assert counters.get("fleet.worker.dead", 0) == 0
+
+
+# ----------------------------------------------------------------------
+# Transport chaos layer (round 18)
+# ----------------------------------------------------------------------
+def test_oneway_partition_expires_lease_then_heals_with_warm_rejoin():
+    import threading
+
+    procs, addrs = zip(*[
+        _spawn_listening_worker(worker_id=i) for i in range(2)
+    ])
+    try:
+        cfg = _listen_fleet_config(addrs, chaos=True, lease_s=0.5)
+        with FleetRouter(cfg) as r:
+            for i in range(6):
+                assert r.handle({"op": "solve", "digest": f"p{i}"})["ok"]
+            pre = r.handle({"op": "stats"})["counters"]["echo.handled"]
+            victim = 0
+            results = []
+            t = threading.Thread(target=lambda: results.append(r.handle(
+                {"op": "solve", "digest": "pp", "sleep_s": 0.8,
+                 "slo_class": "x"}
+            )))
+            t.start()
+            time.sleep(0.2)
+            r.partition_worker(victim, mode="oneway")
+            t.join(timeout=30)
+            # The in-flight query is answered exactly once — either its
+            # response slipped out before the drop (one-way: worker->router
+            # still flows) or the lease expired and it re-queued. Never
+            # lost, never duplicated to the client.
+            assert results and results[0]["ok"]
+            # With nothing in flight the victim goes silent: the lease
+            # expires (the one-way partition's signature — the socket
+            # never EOFs) and the pool keeps serving on the survivor.
+            deadline = time.monotonic() + 10
+            while (time.monotonic() < deadline
+                   and BUS.counters().get("fleet.lease.expired", 0) < 1):
+                time.sleep(0.05)
+            assert BUS.counters().get("fleet.lease.expired", 0) >= 1
+            assert r.handle({"op": "solve", "digest": "during"})["ok"]
+            r.heal_partition(victim)
+            deadline = time.monotonic() + 20
+            while (time.monotonic() < deadline
+                   and not r._workers[victim].alive):
+                time.sleep(0.05)
+            assert r._workers[victim].alive, "no rejoin after heal"
+            post = r.handle({"op": "stats"})
+            # Warm rejoin: same process, pre-partition handled persists.
+            assert post["counters"]["echo.handled"] >= pre
+            # The healthy side never tripped: survivor neither died nor
+            # restarted (its restarts counter stays 0).
+            assert post["workers"]["1"]["restarts"] == 0
+            counters = BUS.counters()
+            assert counters.get("fleet.chaos.partition") == 1
+            assert counters.get("fleet.chaos.heal") == 1
+            assert counters.get("fleet.chaos.dropped", 0) >= 1
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+
+
+def test_corrupt_frame_injection_drops_channel_and_requeues():
+    # fleet.chaos.corrupt mangles the next outbound frame's bytes (length
+    # prefix included): the worker's framing raises FrameError, the
+    # channel drops, the accepted request re-queues, and the redial is a
+    # warm rejoin — corruption is detected, never mis-parsed.
+    from distributed_ghs_implementation_tpu.utils.resilience import FAULTS
+
+    procs, addrs = zip(*[
+        _spawn_listening_worker(worker_id=i) for i in range(2)
+    ])
+    try:
+        # Heartbeat slowed way down: the armed corrupt shot must land on
+        # the SOLVE frame, not race a ping to an arbitrary worker.
+        cfg = _listen_fleet_config(addrs, chaos=True,
+                                   heartbeat_interval_s=5.0)
+        with FleetRouter(cfg) as r:
+            assert r.handle({"op": "solve", "digest": "c0"})["ok"]
+            pre = r.handle({"op": "stats"})["counters"]["echo.handled"]
+            FAULTS.arm("fleet.chaos.corrupt", times=1)
+            resp = r.handle({"op": "solve", "digest": "c1",
+                             "slo_class": "x"})
+            assert resp["ok"] and resp.get("requeued", 0) >= 1
+            assert BUS.counters().get("fleet.chaos.corrupted") == 1
+            deadline = time.monotonic() + 20
+            while time.monotonic() < deadline and not all(
+                w.alive for w in r._workers
+            ):
+                time.sleep(0.05)
+            assert all(w.alive for w in r._workers)
+            post = r.handle({"op": "stats"})["counters"]["echo.handled"]
+            assert post >= pre  # warm rejoin, not a cold restart
+    finally:
+        FAULTS.reset()
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+
+
+def test_chaos_latency_injection_is_seeded_and_bounded():
+    from distributed_ghs_implementation_tpu.fleet.transport import ChaosState
+
+    a = ChaosState(seed=7, name="0")
+    b = ChaosState(seed=7, name="0")
+    c = ChaosState(seed=8, name="0")
+    for s in (a, b, c):
+        s.latency_s, s.jitter_s = 0.01, 0.02
+    seq_a = [a.delay() for _ in range(16)]
+    seq_b = [b.delay() for _ in range(16)]
+    seq_c = [c.delay() for _ in range(16)]
+    assert seq_a == seq_b          # deterministic under the seed
+    assert seq_a != seq_c          # the seed actually moves the schedule
+    assert all(0.01 <= d <= 0.03 for d in seq_a)
+    # Corruption is deterministic too (same seed, same mangled bytes).
+    data = b"37\n" + b"x" * 37 + b"\n"
+    assert ChaosState(seed=7, name="0").corrupt(data) == \
+        ChaosState(seed=7, name="0").corrupt(data)
+    assert ChaosState(seed=7, name="0").corrupt(data) != data
+
+
+# ----------------------------------------------------------------------
+# Satellite: framing + hello fuzz — typed rejection, never a hang or an
+# oversize allocation or an uncaught exception
+# ----------------------------------------------------------------------
+def test_framing_fuzz_random_bytes_always_typed_outcome():
+    import numpy as np
+
+    rng = np.random.default_rng(1234)
+    for trial in range(300):
+        n = int(rng.integers(0, 200))
+        blob = bytes(rng.integers(0, 256, size=n, dtype=np.uint8))
+        stream = io.BytesIO(blob)
+        try:
+            frame = read_frame(stream, max_bytes=1 << 16)
+            assert frame is None or isinstance(frame, dict)
+        except FrameError:
+            pass  # the ONLY acceptable exception type
+        # Bounded consumption: nothing read past the blob (no hang states
+        # are representable on BytesIO, but a seek past EOF would show a
+        # runaway header/payload hunt).
+        assert stream.tell() <= len(blob)
+
+
+def test_framing_fuzz_truncations_of_valid_frames():
+    payload = {"id": 7, "req": {"op": "solve", "edges": [[0, 1, 2]] * 40}}
+    buf = io.BytesIO()
+    write_frame(buf, payload)
+    wire = buf.getvalue()
+    for cut in range(len(wire) - 1):
+        stream = io.BytesIO(wire[:cut])
+        try:
+            frame = read_frame(stream)
+            # A truncation can only "succeed" as clean EOF (cut == 0).
+            assert frame is None and cut == 0
+        except FrameError:
+            pass
+    # And the untouched frame still round-trips.
+    assert read_frame(io.BytesIO(wire)) == payload
+
+
+def test_framing_fuzz_never_allocates_from_corrupt_declarations():
+    # Headers declaring absurd lengths must be refused before the read:
+    # the reader may never size a buffer from garbage-controlled bytes.
+    for declared in (10**9, 10**12, 10**17):
+        stream = io.BytesIO(b"%d\n" % declared + b"x" * 64)
+        with pytest.raises(FrameError, match="outside"):
+            read_frame(stream, max_bytes=1 << 20)
+        assert stream.tell() < 64  # the payload was never consumed
+
+
+def test_hello_fuzz_random_dicts_always_hello_error_or_valid():
+    import numpy as np
+
+    rng = np.random.default_rng(99)
+    keys = ["ready", "proto", "worker", "pid", "caps", "token", "lease_s"]
+    values = [True, False, None, 0, 1, PROTO_VERSION, -3, "x", [], {},
+              {"lane": True}, 2**63, "😈", b"bytes".decode("utf-8",
+                                                           "ignore")]
+    for trial in range(300):
+        frame = {
+            keys[int(rng.integers(0, len(keys)))]:
+                values[int(rng.integers(0, len(values)))]
+            for _ in range(int(rng.integers(0, 6)))
+        }
+        try:
+            hello = check_hello(dict(frame))
+            # Anything accepted really is a hello: right version, an
+            # identity, caps normalized to a dict.
+            assert hello["proto"] == PROTO_VERSION
+            assert hello.get("worker") is not None
+            assert isinstance(hello["caps"], dict)
+        except HelloError:
+            pass  # the ONLY acceptable exception type

@@ -1,0 +1,259 @@
+"""Router journal + shared WAL core: durability, corruption recovery,
+compaction, and the replay state machine (all jax-free).
+
+The corruption matrix mirrors the ``stream/log.py`` test patterns the
+core was factored from: a torn tail costs at most the torn record, a
+corrupt mid-log line stops the chain there (longest valid prefix — never
+a splice across the gap), and whatever the prefix says was accepted but
+not answered is exactly what a restarted router must re-queue.
+"""
+
+import json
+import os
+
+import pytest
+
+from distributed_ghs_implementation_tpu.fleet.journal import (
+    JOURNAL_SCHEMA,
+    RouterJournal,
+)
+from distributed_ghs_implementation_tpu.obs.events import BUS
+from distributed_ghs_implementation_tpu.utils.wal import JsonlWal
+
+
+@pytest.fixture(autouse=True)
+def _clean_global_bus():
+    BUS.enable()
+    BUS.clear()
+    yield
+    BUS.enable()
+    BUS.clear()
+
+
+# ----------------------------------------------------------------------
+# JsonlWal: the factored core
+# ----------------------------------------------------------------------
+def _wal(tmp_path, name="w.jsonl"):
+    return JsonlWal(
+        str(tmp_path / name), schema="test-wal-v1", counter_prefix="test.wal"
+    )
+
+
+def test_wal_append_read_round_trip(tmp_path):
+    wal = _wal(tmp_path)
+    for i in range(5):
+        wal.append({"seq": i, "payload": f"p{i}"})
+    entries, torn = wal.read()
+    assert torn == 0
+    assert [e["seq"] for e in entries] == list(range(5))
+    assert wal.tail()["payload"] == "p4"
+
+
+def test_wal_seals_torn_tail_before_next_append(tmp_path):
+    wal = _wal(tmp_path)
+    wal.append({"seq": 0})
+    with open(wal.path, "ab") as f:
+        f.write(b'{"schema": "test-wal-v1", "seq": 1, "tru')  # crash mid-append
+    wal.append({"seq": 2})
+    entries, _torn = wal.read()
+    # The torn record is skipped; the sealed append after it parses fine.
+    assert [e["seq"] for e in entries] == [0, 2]
+    assert BUS.counters().get("test.wal.sealed_torn") == 1
+
+
+def test_wal_skips_corrupt_midlog_lines_and_counts(tmp_path):
+    wal = _wal(tmp_path)
+    for i in range(4):
+        wal.append({"seq": i})
+    lines = open(wal.path).read().splitlines()
+    lines[1] = "garbage{{{not json"
+    lines[2] = json.dumps({"schema": "some-other-schema", "seq": 2})
+    with open(wal.path, "w") as f:
+        f.write("\n".join(lines) + "\n")
+    entries, torn = wal.read()
+    assert [e["seq"] for e in entries] == [0, 3]
+    assert BUS.counters().get("test.wal.corrupt_line") == 2
+    assert torn == 0
+
+
+def test_wal_rewrite_is_atomic_replacement(tmp_path):
+    wal = _wal(tmp_path)
+    for i in range(6):
+        wal.append({"seq": i})
+    wal.rewrite([{"seq": 9}])
+    entries, _ = wal.read()
+    assert [e["seq"] for e in entries] == [9]
+    assert not os.path.exists(wal.path + ".tmp")
+
+
+# ----------------------------------------------------------------------
+# RouterJournal: the replay state machine
+# ----------------------------------------------------------------------
+def test_journal_round_trip_rebuilds_router_state(tmp_path):
+    j = RouterJournal(str(tmp_path))
+    jid1 = j.accept({"op": "solve", "digest": "a"}, key="a", cls="hit")
+    jid2 = j.accept({"op": "update", "digest": "b"}, key="b", cls=None,
+                    lane=True)
+    j.ring("add", 0)
+    j.ring("add", 1, addr="127.0.0.1:9")
+    j.answer(jid1, ok=True, worker=1, digest="a")
+    j.pin("b2", 0, prev="b")
+    j.scale({"action": "up", "at": 123.0})
+
+    state = RouterJournal(str(tmp_path)).load()
+    assert state.had_state
+    assert list(state.unanswered) == [jid2]
+    assert state.unanswered[jid2]["req"]["op"] == "update"
+    assert state.unanswered[jid2]["lane"] is True
+    assert state.pins == {"b2": 0}
+    assert state.served == {"a": 1}
+    assert state.members[1]["addr"] == "127.0.0.1:9"
+    assert state.last_scale["action"] == "up"
+    assert state.next_jid == jid2 + 1
+
+
+def test_journal_ring_remove_drops_dead_workers_pins_and_affinity(tmp_path):
+    j = RouterJournal(str(tmp_path))
+    a = j.accept({"op": "solve"}, key="a", cls=None)
+    j.answer(a, ok=True, worker=0, digest="a")
+    j.pin("s", 0)
+    j.pin("t", 1)
+    j.ring("remove", 0)  # worker 0 died: its warm copies died with it
+    state = RouterJournal(str(tmp_path)).load()
+    assert state.pins == {"t": 1}
+    assert state.served == {}
+    assert not state.members[0]["retired"]  # dead, not retired: restartable
+    j.ring("retire", 1)
+    state = RouterJournal(str(tmp_path)).load()
+    assert state.members[1]["retired"]
+    assert state.pins == {}
+
+
+def test_journal_accept_is_durable_before_return(tmp_path):
+    # The gating property: once accept() returns, a fresh process sees it.
+    j = RouterJournal(str(tmp_path))
+    jid = j.accept({"op": "solve", "digest": "q"}, key="q", cls="gold")
+    state = RouterJournal(str(tmp_path)).load()
+    assert jid in state.unanswered
+    assert state.unanswered[jid]["cls"] == "gold"
+
+
+def test_journal_checkpoint_compacts_but_keeps_unanswered(tmp_path):
+    j = RouterJournal(str(tmp_path), checkpoint_every=8)
+    keep = j.accept({"op": "solve", "digest": "keep"}, key="keep", cls=None)
+    for i in range(12):  # crosses the checkpoint cadence
+        jid = j.accept({"op": "solve", "digest": f"d{i}"}, key=f"d{i}",
+                       cls=None)
+        j.answer(jid, ok=True, worker=0, digest=f"d{i}")
+    lines = open(j.path).read().splitlines()
+    assert len(lines) < 12  # compacted: answered accepts are gone
+    assert json.loads(lines[0])["t"] == "checkpoint"
+    state = RouterJournal(str(tmp_path)).load()
+    assert keep in state.unanswered  # the orphan rode inside the checkpoint
+    assert state.served["d11"] == 0
+    assert BUS.counters().get("fleet.router.journal.compact", 0) >= 1
+
+
+# ----------------------------------------------------------------------
+# Satellite: the torn-tail and mid-log corruption matrix
+# ----------------------------------------------------------------------
+def _journal_with_orphans(tmp_path, n=6):
+    """n accepts, even jids answered — so odd ones are the re-queue set."""
+    j = RouterJournal(str(tmp_path))
+    jids = []
+    for i in range(n):
+        jid = j.accept({"op": "solve", "digest": f"g{i}"}, key=f"g{i}",
+                       cls=None)
+        jids.append(jid)
+        if i % 2 == 0:
+            j.answer(jid, ok=True, worker=i % 3, digest=f"g{i}")
+    return j, jids
+
+
+@pytest.mark.parametrize("cut", [1, 7, 23])
+def test_journal_torn_tail_recovers_all_but_the_torn_record(tmp_path, cut):
+    j, jids = _journal_with_orphans(tmp_path)
+    raw = open(j.path, "rb").read()
+    lines = raw.splitlines(keepends=True)
+    # Crash mid-append: the last record is cut `cut` bytes in.
+    torn = b"".join(lines[:-1]) + lines[-1][: min(cut, len(lines[-1]) - 1)]
+    with open(j.path, "wb") as f:
+        f.write(torn)
+    state = RouterJournal(str(tmp_path)).load()
+    # The last record was `answer(jid 5 is odd -> no)`... recompute: the
+    # final line is whatever _journal_with_orphans wrote last (an answer
+    # for jid 5? jids are 1-based and i=5 is odd: an accept). Torn = that
+    # accept never happened; everything before it replays.
+    assert state.had_state
+    assert state.dropped == 0  # a torn tail is not a chain break
+    full = RouterJournal(str(tmp_path))
+    # The journal stays appendable after recovery (seal + chain continue).
+    full.load()
+    jid = full.accept({"op": "solve", "digest": "post"}, key="post", cls=None)
+    state2 = RouterJournal(str(tmp_path)).load()
+    assert jid in state2.unanswered
+
+
+def test_journal_midlog_corruption_recovers_longest_valid_prefix(tmp_path):
+    j, jids = _journal_with_orphans(tmp_path, n=6)
+    lines = open(j.path).read().splitlines()
+    # Corrupt the 4th record: everything from there on is untrusted.
+    lines[3] = lines[3][: len(lines[3]) // 2] + "#corrupt#"
+    with open(j.path, "w") as f:
+        f.write("\n".join(lines) + "\n")
+    state = RouterJournal(str(tmp_path)).load()
+    assert state.had_state
+    assert state.dropped > 0
+    assert BUS.counters().get("fleet.router.journal.chain_broken") == 1
+    # The prefix (records 0-2: accept g0, answer g0, accept g1) replays;
+    # the unanswered set from the prefix is exactly the re-queue set.
+    assert state.served == {"g0": 0}
+    assert jids[1] in state.unanswered
+    # Nothing past the break leaked into the state.
+    assert all(a["req"]["digest"] != "g5" for a in state.unanswered.values())
+
+
+def test_journal_non_utf8_corruption_is_a_gap_not_a_crash(tmp_path):
+    # Bitrot bytes >= 0x80 must decode as replacement garbage (an
+    # unparsable, chain-breaking line), never raise UnicodeDecodeError
+    # out of load() — that would make the VALID prefix unrecoverable too.
+    j, jids = _journal_with_orphans(tmp_path, n=6)
+    raw = open(j.path, "rb").read()
+    lines = raw.split(b"\n")
+    lines[3] = lines[3][:4] + b"\xff\xfe\x80" + lines[3][7:]
+    with open(j.path, "wb") as f:
+        f.write(b"\n".join(lines))
+    state = RouterJournal(str(tmp_path)).load()
+    assert state.had_state and state.dropped > 0
+    assert state.served == {"g0": 0}  # the prefix before the rot replays
+
+
+def test_journal_close_refuses_appends_synchronously(tmp_path):
+    # crash() closes the journal: an append after close raises OSError
+    # (the router turns it into a retryable router_crashed error) rather
+    # than racing a successor that already loaded the file.
+    j = RouterJournal(str(tmp_path))
+    j.accept({"op": "solve"}, key="a", cls=None)
+    j.close()
+    with pytest.raises(OSError, match="closed"):
+        j.accept({"op": "solve"}, key="b", cls=None)
+    state = RouterJournal(str(tmp_path)).load()
+    assert len(state.unanswered) == 1  # only the pre-close accept exists
+
+
+def test_journal_seq_gap_is_a_chain_break(tmp_path):
+    j, _jids = _journal_with_orphans(tmp_path, n=6)
+    lines = open(j.path).read().splitlines()
+    del lines[2]  # a vanished record: the suffix no longer follows
+    with open(j.path, "w") as f:
+        f.write("\n".join(lines) + "\n")
+    state = RouterJournal(str(tmp_path)).load()
+    assert state.dropped == len(lines) - 2
+    assert BUS.counters().get("fleet.router.journal.chain_broken") == 1
+
+
+def test_journal_schema_stamp(tmp_path):
+    j = RouterJournal(str(tmp_path))
+    j.accept({"op": "solve"}, key=None, cls=None)
+    rec = json.loads(open(j.path).read().splitlines()[0])
+    assert rec["schema"] == JOURNAL_SCHEMA

@@ -128,6 +128,17 @@ KINDS = {
     "elastic_scale_up": "exact",
     "elastic_scale_down": "exact",
     "elastic_unplanned_deaths": "exact",
+    # gate-fleet-router-v1 (tools/load_drill.py --kill-router): the router
+    # survivability contract is exact — ONE deliberate mid-flight router
+    # crash, a journal replay that must drain to zero unanswered accepts,
+    # every --listen worker re-adopted warm, and zero fresh solves on the
+    # re-adopted sessions. A changed count means the journal/replay/
+    # re-adoption logic changed, never jitter (router_restart_s gates
+    # loosely via its _s suffix; the downtime-window retry counts are
+    # deliberately report-only — see the drill).
+    "router_crashes": "exact",
+    "journal_unanswered": "exact",
+    "workers_readopted": "exact",
     # gate-stream-bench-v1 (bench.py --update-stream): the windowed-vs-
     # sequential ratio is a wall-clock pair — gate as a throughput floor.
     "window_speedup": "throughput",
