@@ -629,6 +629,140 @@ def run_fleet_tcp_bench(args) -> int:
     return 0
 
 
+def run_verify_bench(args) -> int:
+    """Certificate-checker overhead metrics (``gate-verify-bench-v1``):
+    what one MST certificate costs, per engine, at interactive and bulk
+    scale — the price list behind the ``verify=off|sample|full`` policy
+    (``docs/VERIFICATION.md``).
+
+    * **verify_overhead_p50_s** — p50 wall time of one inline certificate
+      on the interactive-sized pool, default (auto) engine: the per-
+      request tax a ``verify=full`` class pays.
+    * **certify_np_p50_s / certify_xla_p50_s** — the same check on each
+      engine explicitly (the NumPy engine is what the jax-free router
+      runs on forwarded payloads; the XLA engine is the jitted path that
+      cross-checks Pallas-routed solves).
+    * **certify_bulk_s** — one certificate at RMAT-14 scale (the bulk
+      class's inline cost).
+    * **mutation_rejected** — EXACT: every adversarial mutation (swapped
+      tree edge, duplicated edge id, dropped edge) must be rejected; a
+      changed count means the checker's power regressed, never jitter.
+    * **mst_weight** — EXACT, as everywhere.
+    """
+    import numpy as np
+
+    from distributed_ghs_implementation_tpu.api import minimum_spanning_forest
+    from distributed_ghs_implementation_tpu.graphs.generators import (
+        gnm_random_graph,
+        rmat_graph,
+    )
+    from distributed_ghs_implementation_tpu.obs.events import BUS, quantile
+    from distributed_ghs_implementation_tpu.verify.certify import (
+        certify_edge_ids,
+        certify_result,
+    )
+
+    BUS.enable()
+    small = [gnm_random_graph(256, 1024, seed=60 + i) for i in range(8)]
+    bulk = rmat_graph(14, 8, seed=61)
+    results = [
+        minimum_spanning_forest(g, backend="host") for g in small
+    ]
+    bulk_result = minimum_spanning_forest(bulk, backend="host")
+
+    # Warm both engines (the XLA engine's first call pays a jit compile
+    # that serving pays once per scale bucket, not per request).
+    for engine in ("np", "xla", "auto"):
+        cert = certify_result(results[0], engine=engine)
+        if not cert.ok:
+            print(f"VERIFY BENCH FAILED: clean result rejected "
+                  f"({engine}: {cert.reason})", file=sys.stderr)
+            return 1
+
+    timings = {"auto": [], "np": [], "xla": []}
+    failed_clean = 0
+    for _ in range(args.repeats):
+        for engine in timings:
+            for r in results:
+                cert = certify_result(r, engine=engine)
+                if not cert.ok:
+                    failed_clean += 1
+                timings[engine].append(cert.check_s)
+    certify_result(bulk_result)  # warm the bulk shape's jit compile
+    t0 = time.perf_counter()
+    bulk_cert = certify_result(bulk_result)
+    certify_bulk_s = time.perf_counter() - t0
+    if not bulk_cert.ok:
+        failed_clean += 1
+
+    # Adversarial mutations: each must be rejected (exact count).
+    rejected = 0
+    mutations = 0
+    for r in results:
+        g = r.graph
+        ids = np.asarray(r.edge_ids)
+        in_tree = np.zeros(g.num_edges, dtype=bool)
+        in_tree[ids] = True
+        nt = np.nonzero(~in_tree)[0]
+        order = np.argsort(g.w, kind="stable")
+        rank = np.empty(g.num_edges, dtype=np.int64)
+        rank[order] = np.arange(g.num_edges)
+        cases = [
+            np.concatenate([ids[1:], ids[:1]])[:-1],      # dropped edge
+            np.concatenate([ids[:-1], ids[:1]]),          # duplicated id
+        ]
+        if nt.size:
+            swapped = ids.copy()
+            swapped[int(np.argmin(rank[ids]))] = int(nt[np.argmax(rank[nt])])
+            cases.append(swapped)                         # heavier swap-in
+        for bad in cases:
+            mutations += 1
+            if not certify_edge_ids(g, bad, engine="np").ok:
+                rejected += 1
+    if rejected != mutations:
+        print(f"VERIFY BENCH FAILED: {mutations - rejected} adversarial "
+              f"mutations ACCEPTED", file=sys.stderr)
+        return 1
+
+    weight = int(sum(r.total_weight for r in results)
+                 + bulk_result.total_weight)
+    out = {
+        "metric": f"MST certificate, {len(small)} x gnm(256,1024) + "
+        f"rmat-14, {args.repeats} repeats",
+        "value": round(quantile(timings["auto"], 0.5) * 1e3, 3),
+        "unit": "ms (auto-engine certify p50)",
+        "verify_overhead_p50_s": round(quantile(timings["auto"], 0.5), 6),
+        "certify_np_p50_s": round(quantile(timings["np"], 0.5), 6),
+        "certify_xla_p50_s": round(quantile(timings["xla"], 0.5), 6),
+        "certify_bulk_s": round(certify_bulk_s, 6),
+        "mutation_rejected": rejected,
+        "mst_weight": weight,
+    }
+    print(json.dumps(out))
+    if args.metrics_out:
+        metrics = {
+            "verify_overhead_p50_s": quantile(timings["auto"], 0.5),
+            "certify_np_p50_s": quantile(timings["np"], 0.5),
+            "certify_xla_p50_s": quantile(timings["xla"], 0.5),
+            "certify_bulk_s": certify_bulk_s,
+            "mutation_rejected": rejected,
+            "verify_failed_clean": failed_clean,
+            "mst_weight": weight,
+        }
+        with open(args.metrics_out, "w") as f:
+            json.dump(
+                {
+                    "schema": "ghs-bench-metrics-v1",
+                    "config": {"workload": "gate-verify-bench-v1"},
+                    "metrics": metrics,
+                },
+                f,
+                indent=2,
+            )
+            f.write("\n")
+    return 0 if failed_clean == 0 else 1
+
+
 def run_sharded_bench(args) -> int:
     """Oversize-lane serving metrics: cold staging vs warm device-resident
     re-solve on the mesh (``parallel/lane.py``), plus the donated-buffer
@@ -842,6 +976,13 @@ def main(argv=None) -> int:
     p.add_argument("--stream-window", type=int, default=64,
                    help="updates per committed window (the batching unit)")
     p.add_argument(
+        "--verify", action="store_true",
+        help="certificate-checker overhead bench (gate-verify-bench-v1): "
+        "per-engine certify p50 at interactive + bulk scale, adversarial "
+        "mutation rejection exact (docs/VERIFICATION.md). Unrelated to "
+        "--no-verify, which skips the RMAT run's oracle check",
+    )
+    p.add_argument(
         "--kernel", choices=["auto", "pallas", "xla"], default=None,
         help="per-level solver kernel (docs/KERNELS.md): 'pallas' = fused "
         "Pallas TPU kernels, 'xla' = the plain two-step path, 'auto' "
@@ -858,6 +999,8 @@ def main(argv=None) -> int:
         )
 
         set_default_kernel(args.kernel)
+    if args.verify:
+        return run_verify_bench(args)
     if args.fleet_tcp:
         return run_fleet_tcp_bench(args)
     if args.update_stream:

@@ -410,6 +410,7 @@ def _cmd_serve(args) -> int:
             sharded_lane_workers=args.sharded_lane,
             stream_dir=args.stream_dir,
             stream_snapshot_every=args.stream_snapshot_every,
+            verify=args.verify_policy,
         )
         autoscaler = None
         if args.fleet_elastic:
@@ -503,6 +504,7 @@ def _cmd_serve(args) -> int:
                       else max(0, args.sharded_lane)),
         stream_dir=args.stream_dir,
         stream_snapshot_every=args.stream_snapshot_every,
+        verify=args.verify_policy,
     )
     if service.warmup_report is not None:
         print(f"warmup: {json.dumps(service.warmup_report)}", file=sys.stderr)
@@ -745,6 +747,16 @@ def build_parser() -> argparse.ArgumentParser:
         "--warmup-stream-buckets",
         help="AOT-warm the windowed-maintenance kernels for subscribed "
         "graphs of these RAW NODESxEDGES sizes before serving",
+    )
+    srv.add_argument(
+        "--verify", dest="verify_policy", default=None, metavar="SPEC",
+        help="result verification policy (docs/VERIFICATION.md): 'off', "
+        "'sample[:N]', 'full', or per-class "
+        "'bulk=full,interactive=sample,default=off'. 'full' classes "
+        "certify every answer inline (O(m log n) MST certificate, "
+        "independent code path) with transparent correction on failure; "
+        "'sample' classes audit on a background thread. Fleet mode "
+        "passes the spec to every worker",
     )
     srv.add_argument(
         "--kernel", choices=["auto", "pallas", "xla"], default=None,

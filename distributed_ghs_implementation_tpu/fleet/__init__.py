@@ -18,8 +18,12 @@ accepted-work journal gates every accept on an fsynced append, so a
 restarted router re-adopts live workers warm and replays orphaned work;
 a transport chaos layer (``ChaosTransport``) drills the dirty-link
 failures — partitions, latency, frame corruption — clean kills never
-exercised. ``docs/FLEET.md`` covers topology, failure modes, and drill
-recipes.
+exercised. Round 19 adds the trust layer: frames carry crc32 payload
+checksums (version-gated via the hello ``crc`` capability), and the
+router CERTIFIES cross-host forwarded payloads — and, in
+``verify_responses`` mode, every verifiable solve response — against the
+``verify/`` MST certificate before serving them (``docs/FLEET.md``,
+``docs/VERIFICATION.md``).
 """
 
 from distributed_ghs_implementation_tpu.fleet.autoscaler import (

@@ -5,7 +5,8 @@ line, ``serve/service.py``); the fleet cannot: a worker's channel carries
 *interleaved* responses written by concurrent request threads, and a torn
 line would silently merge two frames. Each frame is therefore::
 
-    <payload-byte-length>\\n<payload>\\n
+    <payload-byte-length>\\n<payload>\\n                  # legacy (v1)
+    <payload-byte-length> <crc32-hex>\\n<payload>\\n      # checksummed
 
 — the reader knows exactly how many bytes belong to the frame before it
 parses a single one, a short read is detected (not mis-parsed), and the
@@ -13,24 +14,37 @@ trailing newline keeps frames greppable in a captured channel dump. The
 same framing runs over OS pipes (the single-host fleet) and TCP sockets
 (``fleet/transport.py``) — a frame is a frame on either medium.
 
+**Payload checksums** (round 19): the optional second header token is the
+crc32 of the payload bytes. Length-prefixing alone detects *truncation*
+but not *mutation* — a bit-flipped byte inside the payload either breaks
+the JSON (caught late, after buffering) or, worse, survives as valid JSON
+with a different value. With the checksum, every flipped payload is
+rejected at the frame boundary as a typed :class:`FrameError`. Readers
+accept both forms unconditionally; writers emit checksums only toward
+peers that advertised the ``crc`` capability in their hello (or whose own
+frames carried checksums) — the version gate that keeps a mixed-build
+fleet compatible (``fleet/transport.py``, ``docs/FLEET.md``).
+
 Error surface: :func:`read_frame` returns ``None`` only on a *clean* EOF
 at a frame boundary (the peer closed in between frames — drain, or death)
 and raises :class:`FrameError` on everything garbled: a non-numeric or
 over-long length prefix, a length past ``max_bytes`` (a corrupt prefix
 must not become a multi-gigabyte allocation — the reader sizes its buffer
 from attacker/garbage-controlled bytes), a payload the stream could not
-complete, or bytes that are not one JSON object. ``FrameError`` subclasses
-``ValueError``, so callers that treated every framing problem as
-peer-death (the router's reader catches ``(OSError, ValueError)``) keep
-doing so unchanged — the typed error exists for callers that want to
-*distinguish* a corrupt peer from a closed one (tests, the drills, the
-dial-in hello validation). Writes must be serialized by the caller (the
-transports hold a per-connection write lock).
+complete, a payload failing its declared checksum, or bytes that are not
+one JSON object. ``FrameError`` subclasses ``ValueError``, so callers
+that treated every framing problem as peer-death (the router's reader
+catches ``(OSError, ValueError)``) keep doing so unchanged — the typed
+error exists for callers that want to *distinguish* a corrupt peer from a
+closed one (tests, the drills, the dial-in hello validation). Writes must
+be serialized by the caller (the transports hold a per-connection write
+lock).
 """
 
 from __future__ import annotations
 
 import json
+import zlib
 from typing import IO, Optional
 
 #: A frame larger than this is a protocol violation (a runaway edges_out
@@ -39,35 +53,48 @@ from typing import IO, Optional
 #: their own ``max_bytes``.
 MAX_FRAME_BYTES = 256 * 1024 * 1024
 
-#: The length prefix of MAX_FRAME_BYTES is 9 digits + newline; anything
-#: longer is garbage, and an unbounded ``readline`` on a corrupt stream
-#: would buffer until memory runs out.
+#: The longest legal header is 9 length digits + space + 8 crc hex digits
+#: + newline (19 bytes); anything longer is garbage, and an unbounded
+#: ``readline`` on a corrupt stream would buffer until memory runs out.
 _MAX_HEADER_BYTES = 20
 
 
 class FrameError(ValueError):
     """A garbled frame: corrupt length prefix, oversize declaration,
-    truncated payload, or non-JSON bytes. The channel can no longer be
-    trusted to be frame-aligned — the only safe response is to drop it."""
+    truncated payload, checksum mismatch, or non-JSON bytes. The channel
+    can no longer be trusted to be frame-aligned — the only safe response
+    is to drop it."""
 
 
-def encode_frame(obj: dict) -> bytes:
-    """``obj`` as one wire-ready frame (length prefix + payload + LF)."""
+def encode_frame(obj: dict, *, crc: bool = False) -> bytes:
+    """``obj`` as one wire-ready frame; ``crc=True`` emits the checksummed
+    header form (send it only to peers known to parse it)."""
     payload = json.dumps(obj, separators=(",", ":")).encode("utf-8")
+    if crc:
+        return (
+            b"%d %08x\n" % (len(payload), zlib.crc32(payload))
+            + payload + b"\n"
+        )
     return b"%d\n" % len(payload) + payload + b"\n"
 
 
-def write_frame(stream: IO[bytes], obj: dict) -> None:
+def write_frame(stream: IO[bytes], obj: dict, *, crc: bool = False) -> None:
     """Serialize ``obj`` as one length-prefixed frame and flush."""
-    stream.write(encode_frame(obj))
+    stream.write(encode_frame(obj, crc=crc))
     stream.flush()
 
 
 def read_frame(
-    stream: IO[bytes], *, max_bytes: int = MAX_FRAME_BYTES
+    stream: IO[bytes],
+    *,
+    max_bytes: int = MAX_FRAME_BYTES,
+    meta: Optional[dict] = None,
 ) -> Optional[dict]:
     """Read one frame; ``None`` on clean EOF, :class:`FrameError` on
-    anything garbled (see module docstring for the contract)."""
+    anything garbled (see module docstring for the contract). ``meta``
+    (when given) reports ``{"crc": bool}`` — whether the frame carried a
+    checksum, which is how a transport learns its peer speaks the
+    checksummed form."""
     header = stream.readline(_MAX_HEADER_BYTES)
     if not header:
         return None
@@ -76,10 +103,21 @@ def read_frame(
             f"frame header not newline-terminated within "
             f"{_MAX_HEADER_BYTES} bytes: {header[:32]!r}"
         )
+    parts = header.split()
+    if not parts or len(parts) > 2:
+        raise FrameError(f"malformed frame header: {header!r}")
     try:
-        n = int(header)
+        n = int(parts[0])
     except ValueError:
         raise FrameError(f"non-numeric frame length prefix: {header!r}") from None
+    want_crc: Optional[int] = None
+    if len(parts) == 2:
+        try:
+            want_crc = int(parts[1], 16)
+        except ValueError:
+            raise FrameError(
+                f"non-hex frame checksum token: {header!r}"
+            ) from None
     if n < 0 or n > max_bytes:
         raise FrameError(
             f"declared frame length {n} outside [0, {max_bytes}]"
@@ -91,6 +129,13 @@ def read_frame(
             f"got {0 if payload is None else len(payload)}"
         )
     stream.read(1)  # the trailing newline (EOF here still parsed a frame)
+    if want_crc is not None and zlib.crc32(payload) != want_crc:
+        raise FrameError(
+            f"frame payload checksum mismatch: declared {want_crc:08x}, "
+            f"computed {zlib.crc32(payload):08x} over {n} bytes"
+        )
+    if meta is not None:
+        meta["crc"] = want_crc is not None
     try:
         obj = json.loads(payload)
     except ValueError:

@@ -148,6 +148,19 @@ class FleetConfig:
     # on for TCP fleets without a shared disk store (the topology where a
     # deviating dispatch would otherwise re-solve), off elsewhere.
     forward_cache: Optional[bool] = None
+    # -- verification (round 19, docs/VERIFICATION.md) -------------------
+    # verify: the per-class off|sample|full policy spec every spawned
+    # real-service worker boots with (--verify). verify_forward: certify
+    # cross-host forward.hit payloads AT THE ROUTER before serving them —
+    # mandatory by default: a forwarded result crosses an extra process
+    # and an extra link that the owning worker's own verification never
+    # saw. verify_responses: certify EVERY verifiable solve response
+    # (request carried edges, response carried mst_edges) and re-dispatch
+    # once on a failed certificate — the net the corruption drill arms
+    # fleet.chaos.payload against.
+    verify: Optional[str] = None
+    verify_forward: bool = True
+    verify_responses: bool = False
     # -- survivability (round 18, docs/FLEET.md "Router survivability") --
     # Durable accepted-work journal (fleet/journal.py): every accept is
     # fsynced before dispatch, answers/pins/ring/scale changes follow, so
@@ -628,6 +641,8 @@ class FleetRouter:
             argv += ["--sharded-lane", "-1"]
             if cfg.warmup_mesh_buckets:
                 argv += ["--warmup-mesh-buckets", cfg.warmup_mesh_buckets]
+        if cfg.verify:
+            argv += ["--verify", cfg.verify]
         if cfg.compile_cache_dir:
             argv += ["--compile-cache-dir", cfg.compile_cache_dir]
         if cfg.no_compile_cache:
@@ -837,6 +852,11 @@ class FleetRouter:
     def _register_hello(self, w: _Worker, hello: dict) -> None:
         w.caps = dict(hello.get("caps") or {})
         w.lane_advertised = bool(w.caps.get("lane"))
+        if w.caps.get("crc") and w.transport is not None:
+            # The worker parses checksummed frames: emit them. The worker
+            # side flips on by echo — its first checksummed inbound frame
+            # (fleet/transport.py, "CRC negotiation").
+            w.transport.enable_crc()
         w.last_pong = time.monotonic()
         w.ready.set()
 
@@ -1533,25 +1553,64 @@ class FleetRouter:
             BUS.sample(f"fleet.queue.depth.{w.id}", len(w.pending))
             return None
 
+    # -- payload verification (round 19, docs/VERIFICATION.md) ----------
+    @staticmethod
+    def _certify_solve_response(request: dict, response: dict):
+        """Certify a solve response against the request it answers —
+        ``None`` when the pair carries no verifiable claim (echo fleets,
+        digest-only requests, responses without ``mst_edges``), else the
+        :class:`verify.certify.Certificate`. NumPy engine: the router is
+        jax-free by design and the claim arrives as plain JSON anyway."""
+        if request.get("op") != "solve" or "edges" not in request \
+                or "num_nodes" not in request:
+            return None
+        if not isinstance(response.get("mst_edges"), list):
+            return None
+        from distributed_ghs_implementation_tpu.verify.certify import (
+            Certificate,
+            certify_claim,
+        )
+
+        try:
+            return certify_claim(
+                request["num_nodes"], request["edges"],
+                response["mst_edges"],
+                total_weight=response.get("total_weight"), engine="np",
+            )
+        except Exception as e:  # noqa: BLE001 — a crash here would turn
+            # the designed reject-and-re-solve path into an unhandled
+            # error on exactly the adversarial payloads it exists for.
+            return Certificate(
+                ok=False, reason="malformed_claim",
+                detail=f"{type(e).__name__}: {e}", engine="np",
+            )
+
     # -- cache-miss forwarding -----------------------------------------
     def _forward_probe(
         self, request: dict, key: Optional[str], cls: Optional[str],
         lane: bool,
-    ) -> Optional[dict]:
+    ) -> Tuple[Optional[dict], bool]:
         """The cross-host affinity hop: when a solve is about to land on a
         worker that is NOT the digest's owner-of-record, ask the owner
         first with a tiny ``cached_only`` frame (digest + backend — never
         the edge list). A hit returns the owner's cached result without
         any local solve (``fleet.forward.hit``); a miss falls through to
         the normal dispatch, which solves locally
-        (``fleet.forward.miss``). ``None`` = no probe applies."""
+        (``fleet.forward.miss``). Returns ``(response_or_None,
+        rejected)``: when the request carries its edge list the probe asks
+        for the owner's MST edges too and the hit payload is CERTIFIED
+        before it is served (``verify_forward``, mandatory by default) — a
+        failed certificate drops the poisoned forwarding affinity, counts
+        ``fleet.forward.rejected`` + ``verify.failed``, and reports
+        ``rejected=True`` so the caller counts the local re-solve as
+        ``verify.corrected``."""
         if key is None or request.get("op") != "solve":
-            return None
+            return None, False
         if request.get("cached_only"):
-            return None  # already a probe: no recursion
+            return None, False  # already a probe: no recursion
         target = self._route(key, lane=lane, count=False)  # peek only
         if target is None:
-            return None
+            return None, False
         with self._ring_lock:
             owner = self._last_served.get(key)
             if owner is None and lane:
@@ -1564,11 +1623,20 @@ class FleetRouter:
                 except LookupError:
                     owner = None
         if owner is None or owner == target.id:
-            return None
+            return None, False
         ow = self._workers[owner]
         if not (ow.alive and ow.ready.is_set() and not ow.draining):
-            return None  # a draining owner is leaving: don't queue on it
+            return None, False  # a draining owner is leaving: don't queue on it
         probe = {"op": "solve", "digest": key, "cached_only": True}
+        verifiable = (
+            self.config.verify_forward
+            and "edges" in request and "num_nodes" in request
+        )
+        if verifiable:
+            # The certificate needs the claimed edge set; the probe is no
+            # longer "tiny" for verifiable requests, but the response was
+            # always the full result — this only sizes the hit payload.
+            probe["edges_out"] = True
         if "backend" in request:
             probe["backend"] = request["backend"]
         resp = self._request_worker(
@@ -1581,15 +1649,36 @@ class FleetRouter:
             slot_timeout_s=_FORWARD_PROBE_SLOT_TIMEOUT_S,
         )
         if resp and resp.get("ok"):
+            if verifiable:
+                cert = self._certify_solve_response(request, resp)
+                if cert is not None and not cert.ok:
+                    # The owner's payload is wrong (corrupted cache, bad
+                    # link, lying peer): never serve it. Drop the
+                    # affinity so the next query doesn't re-probe the
+                    # same poison, and fall through to a local solve.
+                    BUS.count("verify.failed")
+                    BUS.count("fleet.forward.rejected")
+                    BUS.instant(
+                        "fleet.forward.reject", cat="fleet",
+                        worker=owner, reason=cert.reason,
+                    )
+                    with self._ring_lock:
+                        if self._last_served.get(key) == owner:
+                            del self._last_served[key]
+                    return None, True
+                if cert is not None:
+                    BUS.count("fleet.forward.verified")
             BUS.count("fleet.forward.hit")
             out = dict(resp)
+            if verifiable and not request.get("edges_out"):
+                out.pop("mst_edges", None)  # the probe asked, not the client
             out["forwarded_from"] = owner
             out.setdefault("worker", owner)
             if cls is not None:
                 out.setdefault("slo_class", cls)
-            return out
+            return out, False
         BUS.count("fleet.forward.miss")
-        return None
+        return None, False
 
     # -- the service surface -------------------------------------------
     def handle(self, request: dict) -> dict:
@@ -1638,8 +1727,12 @@ class FleetRouter:
                         # the successor like any crash-window request.
                         err["router_crashed"] = True
                     return err
+            corrected = False  # a verification rejection forced a re-solve
             if self.config.forward_enabled:
-                forwarded = self._forward_probe(request, key, cls, lane)
+                forwarded, rejected = self._forward_probe(
+                    request, key, cls, lane
+                )
+                corrected = rejected
                 if forwarded is not None:
                     span.set(ok=True, worker=forwarded.get("worker"),
                              forwarded=True)
@@ -1648,27 +1741,74 @@ class FleetRouter:
                         digest=forwarded.get("digest"),
                     )
                     return forwarded
-            p = _Pending(request, key, cls, lane=lane)
-            err = self._dispatch(p)
-            if err is not None:
-                span.set(ok=False, shed=bool(err.get("shed")))
-                if not err.get("shed"):
-                    BUS.count("fleet.errors")
-                if cls is not None:
-                    err.setdefault("slo_class", cls)
-                if not err.get("router_crashed"):
-                    # A crashed router never acknowledged failure — those
-                    # accepts stay unanswered so the restart replays them.
+            for attempt in (0, 1):
+                p = _Pending(request, key, cls, lane=lane)
+                err = self._dispatch(p)
+                if err is not None:
+                    span.set(ok=False, shed=bool(err.get("shed")))
+                    if not err.get("shed"):
+                        BUS.count("fleet.errors")
+                    if cls is not None:
+                        err.setdefault("slo_class", cls)
+                    if not err.get("router_crashed"):
+                        # A crashed router never acknowledged failure —
+                        # those accepts stay unanswered so the restart
+                        # replays them.
+                        self._journal_answer(jid, ok=False)
+                    return err
+                if not p.event.wait(self.config.request_timeout_s):
+                    BUS.count("fleet.timeout")
+                    span.set(ok=False)
+                    self._forget(p)
                     self._journal_answer(jid, ok=False)
-                return err
-            if not p.event.wait(self.config.request_timeout_s):
-                BUS.count("fleet.timeout")
-                span.set(ok=False)
-                self._forget(p)
-                self._journal_answer(jid, ok=False)
-                return {"ok": False, "op": op,
-                        "error": "request timed out in the fleet"}
-            response = dict(p.response)
+                    return {"ok": False, "op": op,
+                            "error": "request timed out in the fleet"}
+                response = dict(p.response)
+                if (
+                    attempt == 0
+                    and self.config.verify_responses
+                    and response.get("ok")
+                ):
+                    # Round 19: certify verifiable solve responses before
+                    # they leave the router — the fleet.chaos.payload net.
+                    # ONE re-dispatch on failure: the worker's own copy is
+                    # good (in-flight corruption) or the worker's own
+                    # verification corrects it (cache corruption). The
+                    # replacement is re-certified below before it earns
+                    # the corrected counter — a second consecutive bad
+                    # answer is systemic and is refused, never served.
+                    cert = self._certify_solve_response(request, response)
+                    if cert is not None and not cert.ok:
+                        BUS.count("verify.failed")
+                        BUS.count("fleet.response.rejected")
+                        BUS.instant(
+                            "fleet.response.reject", cat="fleet",
+                            worker=p.worker_id, reason=cert.reason,
+                        )
+                        corrected = True
+                        continue
+                break
+            if corrected and response.get("ok"):
+                # The replacement must EARN the corrected counter: when
+                # it is verifiable, re-certify it — a second consecutive
+                # bad answer (systemic corruption) is refused loudly, not
+                # served while the counters read "corrected".
+                recheck = self._certify_solve_response(request, response)
+                if recheck is not None and not recheck.ok:
+                    BUS.count("verify.failed")
+                    BUS.count("verify.unrecoverable")
+                    span.set(ok=False)
+                    self._journal_answer(jid, ok=False)
+                    err = {
+                        "ok": False, "op": op,
+                        "error": "result failed verification even after "
+                                 f"re-dispatch ({recheck.reason}: "
+                                 f"{recheck.detail}) — refusing to serve",
+                    }
+                    if cls is not None:
+                        err["slo_class"] = cls
+                    return err
+                BUS.count("verify.corrected")
             span.set(ok=bool(response.get("ok")), worker=p.worker_id,
                      requeues=p.requeues)
             if not response.get("router_crashed"):

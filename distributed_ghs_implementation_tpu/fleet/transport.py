@@ -99,6 +99,14 @@ def build_hello(
         "pid": os.getpid(),
         "caps": dict(caps or {}),
     }
+    # Frame-checksum capability (round 19): this build parses the
+    # checksummed header form, so the router may enable CRC toward us.
+    # The hello itself always goes out in the legacy form — it is the
+    # message that NEGOTIATES the capability (GHS_FLEET_CRC=0 opts a
+    # worker out, for mixed-build compatibility drills).
+    hello["caps"].setdefault(
+        "crc", os.environ.get("GHS_FLEET_CRC", "1") != "0"
+    )
     if warmed is not None:
         hello["caps"]["warmed"] = bool(warmed)
     if token is not None:
@@ -154,9 +162,26 @@ class Transport:
     """One framed channel to a peer. ``send`` may buffer (socket
     coalescing); ``recv`` blocks for one frame and returns ``None`` when
     the channel is gone — a garbled frame also ends the channel (the
-    stream is no longer frame-aligned), after counting it."""
+    stream is no longer frame-aligned), after counting it.
+
+    **CRC negotiation**: ``enable_crc()`` switches outbound frames to the
+    checksummed header form (``fleet/framing.py``). The router calls it
+    for workers whose hello advertised the ``crc`` capability; a worker —
+    which never sees a router hello — enables it by *echo-on-receipt*:
+    the first inbound frame carrying a checksum proves the peer both
+    emits and (being the same build) parses the form. Either way, no
+    checksummed frame is ever sent at a peer that might not parse it.
+    """
 
     kind = "abstract"
+    crc_out = False  # emit checksummed frames (set via enable_crc)
+
+    def enable_crc(self) -> None:
+        self.crc_out = True
+
+    def _note_recv_meta(self, meta: dict) -> None:
+        if meta.get("crc") and not self.crc_out:
+            self.crc_out = True  # peer speaks checksummed frames: echo it
 
     def send(self, obj: dict) -> None:
         raise NotImplementedError
@@ -192,7 +217,7 @@ class PipeTransport(Transport):
         self.frames = 0
 
     def send(self, obj: dict) -> None:
-        self.send_bytes(encode_frame(obj))
+        self.send_bytes(encode_frame(obj, crc=self.crc_out))
 
     def send_bytes(self, data: bytes) -> None:
         with self._lock:
@@ -204,10 +229,13 @@ class PipeTransport(Transport):
             self.frames += 1
 
     def recv(self) -> Optional[dict]:
+        meta: dict = {}
         try:
-            return read_frame(self._r)
+            frame = read_frame(self._r, meta=meta)
         except (FrameError, OSError, ValueError):
             return None
+        self._note_recv_meta(meta)
+        return frame
 
     def close(self, *, flush: bool = True) -> None:
         # Pipe writes are immediate (send flushes), so there is nothing
@@ -277,7 +305,7 @@ class SocketTransport(Transport):
 
     # -- writing -------------------------------------------------------
     def send(self, obj: dict) -> None:
-        self.send_bytes(encode_frame(obj))
+        self.send_bytes(encode_frame(obj, crc=self.crc_out))
 
     def send_bytes(self, data: bytes) -> None:
         if self._pipelined:
@@ -333,10 +361,13 @@ class SocketTransport(Transport):
 
     # -- reading -------------------------------------------------------
     def recv(self) -> Optional[dict]:
+        meta: dict = {}
         try:
-            return read_frame(self._rfile)
+            frame = read_frame(self._rfile, meta=meta)
         except (FrameError, OSError, ValueError):
             return None
+        self._note_recv_meta(meta)
+        return frame
 
     # -- teardown ------------------------------------------------------
     def _teardown_locked(self) -> None:
@@ -383,9 +414,39 @@ class SocketTransport(Transport):
 #:   the channel — the corrupt-prefix-must-not-size-an-allocation path).
 #: * ``fleet.chaos.delay``   — add ``value`` seconds to the next N sends
 #:   (kind ``slow``) — a latency spike.
+#: * ``fleet.chaos.payload`` — corrupt the next N inbound RESULT payloads
+#:   *past framing* (kind ``torn``): the frame decodes cleanly (length ok,
+#:   checksum ok — the corruption model is a bad worker/cache, not a bad
+#:   wire), but the decoded solve response carries a mutated edge set and
+#:   weight. Only the verification layer (``verify/``) can catch this one
+#:   — which is exactly what the corruption drill proves it does.
 CHAOS_DROP_SITE = "fleet.chaos.drop"
 CHAOS_CORRUPT_SITE = "fleet.chaos.corrupt"
 CHAOS_DELAY_SITE = "fleet.chaos.delay"
+CHAOS_PAYLOAD_SITE = "fleet.chaos.payload"
+
+
+def corrupt_result_payload(frame: dict) -> dict:
+    """Deterministically mutate a decoded solve-response payload the way
+    ``fleet.chaos.payload`` models it: the first claimed MST edge becomes
+    a self-loop (an edge the input graph cannot contain) and the claimed
+    total weight shifts by one — both plausible-looking to every layer
+    below verification. Mutates (a copy of) the inner response dict."""
+    resp = frame.get("resp")
+    target = resp if isinstance(resp, dict) else frame
+    target = dict(target)
+    if target.get("mst_edges"):
+        edges = [list(e) for e in target["mst_edges"]]
+        edges[0] = [edges[0][0], edges[0][0]]
+        target["mst_edges"] = edges
+    if "total_weight" in target:
+        target["total_weight"] = target["total_weight"] + 1
+    out = dict(frame)
+    if isinstance(resp, dict):
+        out["resp"] = target
+    else:
+        out = target
+    return out
 
 
 class ChaosState:
@@ -460,6 +521,13 @@ class ChaosTransport(Transport):
         return self._inner.kind
 
     @property
+    def crc_out(self) -> bool:
+        return self._inner.crc_out
+
+    def enable_crc(self) -> None:
+        self._inner.enable_crc()
+
+    @property
     def writes(self) -> int:
         return self._inner.writes
 
@@ -474,7 +542,7 @@ class ChaosTransport(Transport):
     def send(self, obj: dict) -> None:
         from distributed_ghs_implementation_tpu.utils.resilience import FAULTS
 
-        data = encode_frame(obj)
+        data = encode_frame(obj, crc=self._inner.crc_out)
         state = self.state
         armed_delay = FAULTS.pop(CHAOS_DELAY_SITE)
         delay = state.delay() + (
@@ -507,9 +575,31 @@ class ChaosTransport(Transport):
         self._inner.send_bytes(data)
 
     def recv(self) -> Optional[dict]:
+        from distributed_ghs_implementation_tpu.utils.resilience import FAULTS
+
         while True:
             frame = self._inner.recv()
-            if frame is None or not self.state.drop_recv:
+            if frame is None:
+                return None
+            if not self.state.drop_recv:
+                # Payload corruption fires PAST framing, on decoded solve
+                # responses that actually carry a result edge set — the
+                # shot is consumed only by a corruptible frame, so an
+                # armed count maps 1:1 onto corrupted results (exact
+                # drill counters). Length and checksum were both valid:
+                # nothing below the verification layer can object.
+                resp = frame.get("resp") if isinstance(
+                    frame.get("resp"), dict
+                ) else frame
+                if resp.get("mst_edges") and FAULTS.pop(
+                    CHAOS_PAYLOAD_SITE
+                ) is not None:
+                    from distributed_ghs_implementation_tpu.obs.events import (
+                        BUS,
+                    )
+
+                    BUS.count("fleet.chaos.payload_corrupted")
+                    frame = corrupt_result_payload(frame)
                 return frame
             from distributed_ghs_implementation_tpu.obs.events import BUS
 

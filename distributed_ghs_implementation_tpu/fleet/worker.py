@@ -191,6 +191,7 @@ def _build_service(args):
                       else max(0, args.sharded_lane)),
         stream_dir=args.stream_dir,
         stream_snapshot_every=args.stream_snapshot_every,
+        verify=args.verify,
     )
 
 
@@ -203,6 +204,7 @@ def _hello_for(args, warmup_summary=None) -> dict:
         "lane": bool(args.sharded_lane),
         "stream": bool(args.stream_dir),
         "kernel": os.environ.get("GHS_KERNEL", "auto"),
+        "verify": args.verify or "off",
     }
     if warmup_summary is not None:
         caps["warmup"] = warmup_summary
@@ -413,6 +415,10 @@ def build_parser() -> argparse.ArgumentParser:
                    help="initialize the JAX distributed runtime before "
                    "building the service (a pod-slice worker; "
                    "launcher/tpu_pod_worker.sh)")
+    p.add_argument("--verify", default=None, metavar="SPEC",
+                   help="result verification policy (off|sample|full, or "
+                   "per-class 'bulk=full,interactive=sample,default=off' — "
+                   "docs/VERIFICATION.md)")
     p.add_argument("--compile-cache-dir", default=None)
     p.add_argument("--no-compile-cache", action="store_true")
     p.add_argument("--obs-jsonl", default=None,

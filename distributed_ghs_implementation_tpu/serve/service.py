@@ -86,6 +86,7 @@ class MSTService:
         stream_snapshot_every: int = 8,
         stream_window_mode: str = "batched",
         max_streams: Optional[int] = None,
+        verify=None,
     ):
         self.store = store if store is not None else ResultStore(
             capacity=store_capacity, disk_dir=disk_dir
@@ -153,6 +154,27 @@ class MSTService:
             interactive_gate=self.scheduler.interactive,
             **stream_kwargs,
         )
+        # Result verification (round 19, docs/VERIFICATION.md): an
+        # off|sample|full policy per SLO class. ``full`` classes certify
+        # inline with transparent correction (the poisoned entry leaves
+        # store + sessions + residency, the graph re-solves fresh, the
+        # corrected answer is the one served); ``sample`` classes ride
+        # the async audit thread. ``verify`` accepts a spec string or a
+        # prebuilt verify.policy.VerifyPolicy.
+        self.verifier = None
+        if verify:
+            from distributed_ghs_implementation_tpu.verify.policy import (
+                ResultVerifier,
+                VerifyPolicy,
+            )
+
+            policy = VerifyPolicy.parse(verify)
+            if policy.enabled:
+                self.verifier = ResultVerifier(
+                    policy,
+                    invalidate=self._invalidate_entry,
+                    resolve=self._fresh_resolve,
+                )
         # digest -> DynamicMST (materialized by an update) or a lightweight
         # (result, backend) seed (parked by a solve).
         self._sessions: "collections.OrderedDict[str, object]" = (
@@ -265,6 +287,29 @@ class MSTService:
                 response.setdefault("slo_class", cls)
             return response
 
+    # -- verification hooks (round 19) ---------------------------------
+    def _invalidate_entry(self, key: Optional[str], digest: str) -> None:
+        """Purge a certificate-failing result EVERYWHERE it could be
+        served from again: store memory + disk (quarantined), the parked
+        update-session seed (it aliases the same arrays), and any mesh
+        residency for the digest."""
+        if key is not None:
+            self.store.invalidate(key, reason="certificate failed")
+        entry = self._sessions.get(digest)
+        if entry is not None and not isinstance(entry, DynamicMST):
+            del self._sessions[digest]
+        if self.sharded_lane is not None:
+            evict = getattr(self.sharded_lane, "evict", None)
+            if evict is not None:
+                evict(digest)
+
+    def _fresh_resolve(self, graph: Graph, backend: str) -> MSTResult:
+        """The correction re-solve: by the time this runs the poisoned
+        entry is invalidated, so the scheduler misses and solves fresh
+        (supervised, single-flighted — the normal miss machinery)."""
+        result, _source = self.scheduler.solve(graph, backend=backend)
+        return result
+
     # ------------------------------------------------------------------
     def _handle_solve(self, request: dict) -> dict:
         if request.get("cached_only"):
@@ -279,6 +324,19 @@ class MSTService:
             self.seen_buckets[bucket] = None
         result, source = self.scheduler.solve(graph, backend=backend)
         digest = graph.digest()
+        verified = None
+        if self.verifier is not None:
+            # Per-policy certification of EVERY solve answer — cache hits
+            # included (a bit-rotted or memory-corrupted cached result is
+            # precisely what nothing upstream can notice). A failed
+            # inline certificate is corrected transparently; the client
+            # sees only the corrected result (+ the verify.* counters).
+            result, verified = self.verifier.check(
+                result,
+                cls=sanitize_class(request.get("slo_class")),
+                key=solve_cache_key(graph, backend=backend),
+                backend=backend,
+            )
         self._remember(digest, result, backend)
         out = {
             "ok": True,
@@ -287,6 +345,8 @@ class MSTService:
             "source": source,
             "cached": source != "solved",
         }
+        if verified is not None:
+            out["verified"] = verified
         out.update(self._result_fields(result, request))
         return out
 
@@ -372,6 +432,18 @@ class MSTService:
         self.store.put(
             solve_cache_key(result.graph, backend=session.backend), result
         )
+        if self.verifier is not None:
+            # Update results ride the ASYNC audit regardless of class
+            # mode: the incremental cut/cycle maintenance is exactly the
+            # machinery a certificate should cross-check, but inline
+            # correction has no safe shape here (the session already
+            # re-keyed) — a failed audit evicts the cached entry so the
+            # next solve re-derives it fresh.
+            self.verifier.audit(
+                result,
+                cls=sanitize_class(request.get("slo_class")),
+                key=solve_cache_key(result.graph, backend=session.backend),
+            )
         out = {
             "ok": True,
             "op": "update",
@@ -459,6 +531,14 @@ class MSTService:
             on_commit=_cache_head,
         )
         result = out.pop("result")
+        if self.verifier is not None:
+            # Stream commits audit async like updates (same reasoning:
+            # the WAL append is already the commit point).
+            self.verifier.audit(
+                result,
+                cls=sanitize_class(request.get("slo_class")),
+                key=solve_cache_key(result.graph, backend=self.backend),
+            )
         response = {"ok": True, "op": "publish", **out}
         response.update(self._result_fields(result, request))
         return response
@@ -475,7 +555,8 @@ class MSTService:
             name: value
             for name, value in BUS.counters().items()
             if name.startswith(
-                ("serve.", "batch.", "compile.", "lane.", "stream.")
+                ("serve.", "batch.", "compile.", "lane.", "stream.",
+                 "verify.")
             )
         }
         out = {
@@ -489,6 +570,8 @@ class MSTService:
             # pipes must know when span-derived numbers under-count.
             "events_dropped": BUS.dropped,
         }
+        if self.verifier is not None:
+            out["verify"] = self.verifier.policy.describe()
         stream_stats = self.streams.stats()
         # Durable streams outnumber resident ones after an LRU eviction
         # or a restart; an operator needs the on-disk count to know a

@@ -162,6 +162,32 @@ class ResultStore:
                 # digests, so the worst case is a future miss.
                 BUS.count("serve.store.disk_write_failed")
 
+    def invalidate(self, key: str, *, reason: str = "") -> bool:
+        """Hard-evict a POISONED entry: memory LRU dropped, every disk
+        generation quarantined (never merely unlinked — a failed
+        certificate's input is postmortem evidence, and a digest chain
+        that re-plants it from disk would serve the same wrong answer
+        again). Returns whether anything was removed
+        (``serve.store.invalidated``)."""
+        removed = False
+        with self._lock:
+            removed = self._mem.pop(key, None) is not None
+        if self.disk_dir is not None:
+            from distributed_ghs_implementation_tpu.utils.integrity import (
+                quarantine,
+            )
+
+            path = _disk_path(self.disk_dir, key)
+            for candidate in (path, path + ".bak"):
+                if os.path.exists(candidate):
+                    removed = bool(quarantine(
+                        candidate, reason=reason or "invalidated",
+                        counter="serve.store.quarantined",
+                    )) or removed
+        if removed:
+            BUS.count("serve.store.invalidated")
+        return removed
+
     def evict_chain(self, key: str) -> bool:
         """Drop a superseded digest-chain ancestor from the memory LRU.
 
@@ -226,7 +252,10 @@ class ResultStore:
             return
         entries.sort(key=lambda e: e.stat().st_mtime)
         for entry in entries[: len(entries) - self.disk_max_entries]:
-            for path in (entry.path, entry.path + ".bak"):
+            for path in (
+                entry.path, entry.path + ".bak",
+                entry.path + ".sha256", entry.path + ".bak.sha256",
+            ):
                 # Concurrent workers sweep the shared directory too — a
                 # sibling winning the unlink race is success, not an error.
                 with contextlib.suppress(FileNotFoundError):
@@ -260,9 +289,40 @@ class ResultStore:
             os.close(fd)
 
     def _disk_get(self, key: str, graph: Graph) -> Optional[MSTResult]:
+        """One disk probe, with the failure modes told apart (round 19):
+
+        * **ENOENT** — a plain miss: never counted as corruption.
+        * **checksum mismatch** (``utils/integrity.py`` sidecar) — the
+          bytes rotted after the commit point: quarantine the file
+          (``.quarantine/``, ``serve.store.quarantined``) WITHOUT parsing
+          it, try the ``.bak`` generation, degrade to a miss.
+        * **torn/corrupt npz** (no sidecar to catch it — a legacy or
+          crash-window file) — ``np.load`` failures quarantine the same
+          way; they are corruption, not a miss, and must never raise out
+          of :meth:`get`.
+        * **digest mismatch** — a different graph collided on the
+          filename: not corruption, just not our entry.
+        """
+        from distributed_ghs_implementation_tpu.utils.integrity import (
+            IntegrityError,
+            check_file,
+            quarantine,
+        )
+
         path = _disk_path(self.disk_dir, key)
         for candidate in (path, path + ".bak"):
             if not os.path.exists(candidate):
+                continue
+            try:
+                if check_file(candidate) == "unverified":
+                    BUS.count("serve.store.unverified")
+            except FileNotFoundError:
+                continue  # lost a race with a sweep: a miss, not corruption
+            except IntegrityError as e:
+                quarantine(
+                    candidate, reason=str(e),
+                    counter="serve.store.quarantined",
+                )
                 continue
             try:
                 with np.load(candidate) as data:
@@ -277,6 +337,10 @@ class ResultStore:
                         backend=str(data["backend"]),
                         num_components=int(data["num_components"]),
                     )
-            except Exception:  # noqa: BLE001 — torn/corrupt: try the .bak
+            except Exception as e:  # noqa: BLE001 — torn/corrupt npz
+                quarantine(
+                    candidate, reason=f"{type(e).__name__}: {e}",
+                    counter="serve.store.quarantined",
+                )
                 continue
         return None

@@ -210,12 +210,37 @@ class UpdateLog:
         if tail is not None:
             return tail["seq"], tail["digest"]
         for candidate in (self.snap_path, self.snap_path + ".bak"):
+            if self._quarantine_if_corrupt(candidate):
+                continue
             try:
                 with np.load(candidate) as data:
                     return int(data["seq"]), str(data["digest"])
             except Exception:  # missing/torn: fall through
                 continue
         return None
+
+    @staticmethod
+    def _quarantine_if_corrupt(candidate: str) -> bool:
+        """Checksum-verify one snapshot generation before ``np.load``
+        touches it; a sidecar mismatch quarantines the file
+        (``stream.log.quarantined``) and reports True — the caller falls
+        to the next generation, exactly like a torn write."""
+        from distributed_ghs_implementation_tpu.utils.integrity import (
+            IntegrityError,
+            check_file,
+            quarantine,
+        )
+
+        try:
+            check_file(candidate)
+        except FileNotFoundError:
+            return False  # the load below reports it as missing
+        except IntegrityError as e:
+            quarantine(
+                candidate, reason=str(e), counter="stream.log.quarantined"
+            )
+            return True
+        return False
 
     # -- reading -------------------------------------------------------
     def _read_wal(self, count: bool = True) -> Tuple[List[dict], int]:
@@ -233,6 +258,9 @@ class UpdateLog:
         for candidate in (self.snap_path, self.snap_path + ".bak"):
             if not os.path.exists(candidate):
                 notes.append((candidate, "missing"))
+                continue
+            if self._quarantine_if_corrupt(candidate):
+                notes.append((candidate, "quarantined: checksum mismatch"))
                 continue
             try:
                 with np.load(candidate) as data:

@@ -476,6 +476,7 @@ class StreamManager:
             for note in state.get("notifications", []):
                 session.notifications.append(note)
             replayed = 0
+            diverged = False
             # WAL entries chain from the snapshot's STORED digest (that is
             # what log.load() validated) — chaining on the recomputed head
             # would silently drop every post-snapshot window whenever the
@@ -484,11 +485,13 @@ class StreamManager:
             for entry in entries:
                 if entry["prev"] != chain:
                     BUS.count("stream.replay.diverged")
+                    diverged = True
                     break
                 result, info = mst.apply_window(entry["updates"])
                 new_digest = result.graph.digest()
                 if new_digest != entry["digest"]:
                     BUS.count("stream.replay.diverged")
+                    diverged = True
                     break
                 session.notifications.append(
                     _notification(entry["seq"], entry["prev"], new_digest, info)
@@ -496,6 +499,34 @@ class StreamManager:
                 chain = session.head = new_digest
                 session.seq = entry["seq"]
                 replayed += 1
+            # Round 19: verify the REBUILT head against the journaled
+            # expectation. On a clean replay the two agree by construction
+            # (every applied window's recomputed digest was checked); a
+            # disagreement means the arrays were evolved through state we
+            # cannot vouch for — corrupt snapshot arrays, a mangled WAL
+            # update that still parsed, or a divergence that left the
+            # arrays one window past the last verified head. Replay alone
+            # would serve that forest with full confidence; instead fall
+            # back to ONE fresh solve of the rebuilt graph, so the served
+            # forest is re-derived from the edges actually recovered
+            # (``stream.replay.fresh_solve`` — the zero-fresh-solve
+            # failover contract is scoped to clean replays, and this is
+            # not one).
+            rebuilt = mst.result().graph.digest()
+            # A fully-verified replay CURES a seed-digest mismatch: when
+            # every WAL window re-derived its journaled digest from the
+            # arrays, the final state is journal-verified even though the
+            # seed was not (the legacy weight-dtype-change case).
+            seed_uncured = (
+                head != state["digest"] and not (replayed and not diverged)
+            )
+            if self._solver is not None and (
+                diverged or seed_uncured or rebuilt != session.head
+            ):
+                BUS.count("stream.replay.fresh_solve")
+                fresh = self._solver(mst.result().graph)
+                session.mst = self._make_mst(result=fresh)
+                session.head = fresh.graph.digest()
             span.set(replayed=replayed, head_seq=session.seq)
             BUS.count("stream.replay.streams")
             if replayed:

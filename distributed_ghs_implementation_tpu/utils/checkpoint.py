@@ -19,6 +19,7 @@ from a stranger's partition is the one failure recovery must not paper over.
 
 from __future__ import annotations
 
+import contextlib
 import os
 import tempfile
 from typing import List, Optional, Tuple
@@ -77,7 +78,22 @@ def atomic_write_npz(
     the atomic rename. rename-without-dirfsync is durable *eventually*,
     not at return — and every caller here (serve store publishes, stream
     snapshots, checkpoint saves) treats return as the commit point.
+
+    Integrity (round 19): every write records a ``<path>.sha256`` sidecar
+    (``utils/integrity.py``) so loads can refuse bit-rotted or torn bytes
+    before deserializing them. Ordering closes the false-quarantine hole:
+    the OLD sidecar rotates to ``.bak`` (or is unlinked) before the data
+    rename, and the NEW sidecar lands after it — a crash in the window
+    leaves the fresh data file *without* a sidecar, which loads treat as
+    "unverified" (accepted, counted), never as a mismatch against a stale
+    hash.
     """
+    from distributed_ghs_implementation_tpu.utils.integrity import (
+        sha256_file,
+        sidecar_path,
+        write_sidecar,
+    )
+
     d = os.path.dirname(os.path.abspath(path)) or "."
     os.makedirs(d, exist_ok=True)
     fd, tmp = tempfile.mkstemp(dir=d, suffix=".npz.tmp")
@@ -87,16 +103,37 @@ def atomic_write_npz(
             np.savez_compressed(f, **{k: np.asarray(v) for k, v in arrays.items()})
             f.flush()
             os.fsync(f.fileno())
+        digest = sha256_file(tmp)
         if retain_previous and os.path.exists(path):
             import zipfile
 
             if zipfile.is_zipfile(path):
                 os.replace(path, path + ".bak")
+                try:
+                    os.replace(
+                        sidecar_path(path), sidecar_path(path + ".bak")
+                    )
+                except OSError:
+                    # The rotated primary had NO sidecar (a crash landed
+                    # between its data rename and sidecar write): any
+                    # older .bak sidecar now describes bytes that are
+                    # gone — leaving it behind would false-quarantine
+                    # the good .bak generation on its next read.
+                    with contextlib.suppress(OSError):
+                        os.unlink(sidecar_path(path + ".bak"))
             else:
                 # The primary is torn (e.g. the save this one follows
                 # crashed mid-write): rotating it would clobber the last
                 # good generation. Drop it and keep the loadable .bak.
                 os.unlink(path)
+                with contextlib.suppress(OSError):
+                    os.unlink(sidecar_path(path))
+        else:
+            # The stale sidecar must never outlive the data file it
+            # described (a crash after the data rename would otherwise
+            # read as corruption of the NEW file).
+            with contextlib.suppress(OSError):
+                os.unlink(sidecar_path(path))
         armed = FAULTS.pop(fault_site)
         if armed is not None:
             if armed.kind == "torn":
@@ -108,6 +145,7 @@ def atomic_write_npz(
                     f.write(blob[: max(1, len(blob) // 2)])
             raise InjectedFault(f"injected fault at {fault_site} ({armed.kind})")
         os.replace(tmp, path)
+        write_sidecar(path, digest)
         fsync_dir(d)
     finally:
         if os.path.exists(tmp):
@@ -167,15 +205,27 @@ def load_checkpoint_resilient(
     errors) falls through; :class:`CheckpointMismatch` re-raises, because a
     wrong-graph resume is a caller bug, not a recoverable fault.
     """
+    from distributed_ghs_implementation_tpu.utils.integrity import (
+        IntegrityError,
+        check_file,
+    )
+
     notes: List[Tuple[str, str]] = []
     for candidate in (path, path + ".bak"):
         if not os.path.exists(candidate):
             notes.append((candidate, "missing"))
             continue
         try:
+            # Checksum first: bit-rotted bytes must be rejected before
+            # np.load parses them (a corrupt zip can fail DEEP inside
+            # decompression — or worse, parse into wrong arrays).
+            check_file(candidate)
             state = load_checkpoint(candidate, expect_fingerprint=expect_fingerprint)
         except CheckpointMismatch:
             raise
+        except IntegrityError as e:
+            notes.append((candidate, f"IntegrityError: {e}"))
+            continue
         except Exception as e:  # torn/corrupt/unreadable: try the next generation
             notes.append((candidate, f"{type(e).__name__}: {e}"))
             continue

@@ -21,6 +21,12 @@ can share the exact same durability discipline instead of re-deriving it:
 * **Compaction** — :meth:`JsonlWal.rewrite` replaces the log atomically
   (tmp + fsync + rename); a crash anywhere leaves either the old or the
   new generation, never a mix.
+* **Record checksums** (round 19) — every line carries a ``crc`` field
+  (crc32 over the record's canonical JSON), validated on read: a bit flip
+  that keeps the line parsable — the corruption schema checks cannot see
+  — is counted (``.crc_mismatch``) and skipped instead of replayed.
+  Pre-crc lines (no field) stay accepted, so existing logs upgrade in
+  place.
 
 The core knows nothing about what a record *means*: callers provide the
 ``schema`` stamped into (and checked out of) every line, an optional
@@ -32,10 +38,28 @@ from __future__ import annotations
 
 import json
 import os
+import zlib
 from typing import Callable, List, Optional, Tuple
 
 from distributed_ghs_implementation_tpu.obs.events import BUS
 from distributed_ghs_implementation_tpu.utils.locking import flocked, fsync_dir
+
+
+def _canonical(obj: dict) -> str:
+    """The one byte-deterministic JSON form records are checksummed over
+    (sorted keys, tight separators, ASCII escapes) — ``json.loads`` then
+    ``_canonical`` round-trips to the identical string, so readers can
+    re-derive the writer's checksum input from the parsed record."""
+    return json.dumps(obj, sort_keys=True, separators=(",", ":"))
+
+
+def _stamp_crc(record: dict) -> str:
+    """One record -> its log line: canonical JSON with a ``crc`` field
+    (crc32 of the canonical form WITHOUT the field). A bit flip inside a
+    value that stays valid JSON — the corruption the schema check cannot
+    see — then fails the checksum on read instead of replaying garbage."""
+    crc = zlib.crc32(_canonical(record).encode("utf-8"))
+    return _canonical({**record, "crc": crc})
 
 
 class JsonlWal:
@@ -86,7 +110,7 @@ class JsonlWal:
     def _append_locked(self, record: dict) -> None:
         parent = os.path.dirname(os.path.abspath(self.path)) or "."
         os.makedirs(parent, exist_ok=True)
-        line = json.dumps({"schema": self.schema, **record})
+        line = _stamp_crc({"schema": self.schema, **record})
         seal = b""
         created = True
         try:
@@ -129,7 +153,7 @@ class JsonlWal:
         tmp = self.path + ".tmp"
         with open(tmp, "w") as f:
             for e in entries:
-                f.write(json.dumps({"schema": self.schema, **e}) + "\n")
+                f.write(_stamp_crc({"schema": self.schema, **e}) + "\n")
             f.flush()
             os.fsync(f.fileno())
         os.replace(tmp, self.path)
@@ -142,6 +166,18 @@ class JsonlWal:
         unparsable, or schema-mismatched."""
         try:
             rec = json.loads(line)
+            if not isinstance(rec, dict):
+                raise ValueError("record is not an object")
+            crc = rec.pop("crc", None)
+            if crc is not None and zlib.crc32(
+                _canonical(rec).encode("utf-8")
+            ) != crc:
+                # Parsable-but-wrong bytes: a value-level bit flip the
+                # schema check cannot see. Counted separately (then
+                # skipped like any corrupt line); records from pre-crc
+                # builds simply have no crc field and stay accepted.
+                self._count("crc_mismatch")
+                raise ValueError("record checksum mismatch")
             if rec.get("schema") != self.schema:
                 raise ValueError(f"bad schema {rec.get('schema')!r}")
             rec.pop("schema", None)

@@ -1,0 +1,35 @@
+"""Runtime verification: MST certificates, serving policy, async audit.
+
+The survey's critical finding about the reference implementation is that it
+was only *probabilistically* correct — at 20 nodes its deadlock-escape
+heuristics silently produced a wrong MST (weight 57 vs 53) in 2 of 3 runs,
+and nothing in its serving path could have noticed. This package is the
+missing trust layer: every served result can be *certified* against the
+input graph in O(m α + m log n) — orders of magnitude cheaper than
+re-solving and, crucially, through an independent code path (union-find +
+binary-lifting path-max, never the Borůvka kernels), so a miscompiled
+kernel, a bit-rotted cache entry, or a corrupted forwarded payload cannot
+co-sign its own wrong answer.
+
+* :mod:`verify.certify` — the certificate checker itself (``docs/
+  VERIFICATION.md`` has the semantics).
+* :mod:`verify.policy` — the ``off|sample|full`` per-SLO-class serving
+  policy, the background audit thread, and the serve-side glue that
+  corrects a failed certificate transparently (evict + re-solve).
+
+Import discipline: this package must stay importable without jax — the
+fleet router (jax-free in echo drills) certifies forwarded payloads with
+the numpy engine; the XLA engine loads lazily on first use.
+"""
+
+from distributed_ghs_implementation_tpu.verify.certify import (  # noqa: F401
+    Certificate,
+    certify_claim,
+    certify_edge_ids,
+    certify_result,
+)
+from distributed_ghs_implementation_tpu.verify.policy import (  # noqa: F401
+    AsyncAuditor,
+    ResultVerifier,
+    VerifyPolicy,
+)

@@ -1,0 +1,529 @@
+"""MST certificates: prove a claimed forest IS the minimum spanning forest.
+
+A certificate check costs O(m α + m log n) — union-find over the claimed
+tree edges plus one batch of tree path-max queries — against the O(m log n)
+*per level* of re-solving, and runs through an entirely independent code
+path: no Borůvka kernel, no Pallas, no fragment arrays. That independence
+is the point. The solver stack routes through fused kernels, donated
+device buffers, disk caches, WAL replay, and cross-host forwarding; any of
+those can hand back a *plausible* wrong answer (the reference
+implementation served weight-57 "MSTs" whose true weight was 53 and never
+noticed). The certificate re-derives correctness from first principles:
+
+1. **Forest validity** — the claimed edge ids are in range and distinct,
+   and union-find over them finds no cycle (``bad_edge_ids`` / ``cycle``).
+2. **Spanning parity** — the claimed forest has exactly as many components
+   as the input graph: dropping a component (or splitting one) is caught
+   by comparing component counts (``not_spanning``).
+3. **Cycle property** — every non-tree edge is heavier than every tree
+   edge on the path between its endpoints (``not_minimal``). Weights are
+   compared as *ranks* in the total order ``(weight, edge id)`` — the same
+   tie-breaking contract the whole repo solves under — so the MSF is
+   unique and conditions 1–3 are necessary AND sufficient: a passing
+   certificate means the claimed forest is edge-for-edge THE minimum
+   spanning forest, not merely one of equal weight.
+
+The path-max queries use binary lifting over the rooted claimed forest
+(ancestor tables ``up[k][v]`` and max-edge-rank tables ``mx[k][v]``,
+``k ≤ log2(depth)``), answered for all non-tree edges at once. Two
+engines share the host-built tables:
+
+* ``engine="np"`` — pure NumPy, importable without jax (the fleet router
+  certifies forwarded payloads with this one).
+* ``engine="xla"`` — the query loop under ``jax.jit``, deliberately plain
+  XLA (never Pallas), so a Pallas-routed solve is cross-checked by a code
+  path that shares nothing with the kernel under suspicion.
+
+``engine="auto"`` picks XLA when jax is importable, NumPy otherwise. Both
+engines are bit-identical (tests pin it).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from distributed_ghs_implementation_tpu.graphs.edgelist import Graph
+from distributed_ghs_implementation_tpu.obs.events import BUS
+
+#: Failure reasons, in check order. ``None`` reason == certificate passed.
+REASONS = (
+    "bad_edge_ids",   # out of range / duplicate claimed edge ids
+    "cycle",          # claimed edges close a cycle (not a forest)
+    "not_spanning",   # component count differs from the input graph
+    "not_minimal",    # a non-tree edge beats a tree edge on its path
+    "unknown_edge",   # a claimed (u, v) pair is not an input edge
+    "weight_mismatch",  # claimed total weight != recomputed edge sum
+    "metadata_mismatch",  # claimed component count != certified count
+    "malformed_claim",  # the claim could not even be parsed as edges
+)
+
+
+@dataclasses.dataclass
+class Certificate:
+    """One verification verdict. ``bool(cert)`` is ``cert.ok``."""
+
+    ok: bool
+    reason: Optional[str]  # one of REASONS, None when ok
+    detail: str = ""
+    num_tree_edges: int = 0
+    expected_edges: int = 0
+    num_components: int = 0       # of the certified forest
+    graph_components: int = 0     # of the input graph
+    violations: int = 0           # offending non-tree edges (not_minimal)
+    engine: str = "np"
+    check_s: float = 0.0
+
+    def __bool__(self) -> bool:
+        return self.ok
+
+    def summary(self) -> dict:
+        out = {"ok": self.ok, "engine": self.engine,
+               "check_s": round(self.check_s, 6)}
+        if not self.ok:
+            out["reason"] = self.reason
+            out["detail"] = self.detail
+        return out
+
+
+def _fail(reason: str, detail: str, **fields) -> Certificate:
+    return Certificate(ok=False, reason=reason, detail=detail, **fields)
+
+
+def _edge_ranks(graph: Graph) -> np.ndarray:
+    """Rank of each edge in the total order ``(weight, edge id)`` —
+    re-derived here with a plain stable argsort (never the graph's cached
+    native-sorted order: the certificate must not trust inputs it can
+    cheaply recompute)."""
+    order = np.argsort(graph.w, kind="stable")
+    rank = np.empty(graph.num_edges, dtype=np.int64)
+    rank[order] = np.arange(graph.num_edges, dtype=np.int64)
+    return rank
+
+
+def _components(num_nodes: int, u: np.ndarray, v: np.ndarray) -> np.ndarray:
+    """Component label per vertex (C-speed scipy union-find equivalent)."""
+    from distributed_ghs_implementation_tpu.graphs.edgelist import (
+        component_labels,
+    )
+
+    return component_labels(num_nodes, u, v)
+
+
+def _root_forest(
+    num_nodes: int, tu: np.ndarray, tv: np.ndarray, tranks: np.ndarray
+) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """Root the claimed forest: ``(parent, depth, parent_edge_rank)``.
+
+    BFS with predecessors via scipy (C speed, depth-independent — a road
+    network MST is a few vertices wide and tens of thousands deep, where a
+    level-synchronous NumPy BFS would crawl). Roots carry ``parent ==
+    self`` and ``parent_edge_rank == -1`` (the neutral element under max).
+    """
+    from scipy.sparse import coo_matrix
+    from scipy.sparse.csgraph import breadth_first_order
+
+    parent = np.arange(num_nodes, dtype=np.int64)
+    depth = np.zeros(num_nodes, dtype=np.int64)
+    perank = np.full(num_nodes, -1, dtype=np.int64)
+    if tu.size == 0:
+        return parent, depth, perank
+    adj = coo_matrix(
+        (np.ones(2 * tu.size, dtype=np.int8),
+         (np.concatenate([tu, tv]), np.concatenate([tv, tu]))),
+        shape=(num_nodes, num_nodes),
+    ).tocsr()
+    labels = _components(num_nodes, tu, tv)
+    # One BFS per NON-TRIVIAL tree component, from its first vertex.
+    # Singleton components (isolated vertices — RMAT graphs have tens of
+    # thousands) are already correct as self-parented roots; a scipy BFS
+    # call per singleton turned an RMAT-17 certificate into minutes.
+    uniq, first = np.unique(labels, return_index=True)
+    sizes = np.bincount(labels, minlength=uniq.max() + 1 if uniq.size else 0)
+    first = first[sizes[uniq] >= 2]
+    seen = np.zeros(num_nodes, dtype=bool)
+    for root in first:
+        if seen[root]:
+            continue
+        order, pred = breadth_first_order(
+            adj, int(root), directed=False, return_predecessors=True
+        )
+        seen[order] = True
+        pred = pred[order]
+        has_parent = order != root
+        kids = order[has_parent]
+        parent[kids] = pred[has_parent]
+    # Parent-edge ranks by packed-key binary search over both orientations
+    # of the tree edges (child-side key -> the connecting edge's rank).
+    src = np.concatenate([tu, tv]).astype(np.int64)
+    dst = np.concatenate([tv, tu]).astype(np.int64)
+    ranks2 = np.concatenate([tranks, tranks]).astype(np.int64)
+    key = src * num_nodes + dst
+    korder = np.argsort(key)
+    key, ranks2 = key[korder], ranks2[korder]
+    child = np.nonzero(parent != np.arange(num_nodes, dtype=np.int64))[0]
+    want = parent[child] * num_nodes + child
+    perank[child] = ranks2[np.searchsorted(key, want)]
+    # Depth by pointer doubling: after k rounds, cnt(v) = min(depth(v),
+    # 2^k) — converges in log2(max depth) vectorized passes, so a
+    # 10^5-deep road-network MST costs ~17 array ops, not 10^5.
+    idx = np.arange(num_nodes, dtype=np.int64)
+    anc = parent.copy()
+    depth = (anc != idx).astype(np.int64)
+    while True:
+        nxt = anc[anc]
+        if np.array_equal(nxt, anc):
+            break
+        depth = depth + depth[anc]
+        anc = nxt
+    return parent, depth, perank
+
+
+def _lift_tables(
+    parent: np.ndarray, perank: np.ndarray, depth: np.ndarray
+) -> Tuple[np.ndarray, np.ndarray]:
+    """Binary-lifting tables ``(up[K, n], mx[K, n])``: ``up[k][v]`` is
+    ``v``'s ``2^k``-th ancestor, ``mx[k][v]`` the max tree-edge rank on
+    that ancestor path (-1 past the root)."""
+    n = parent.shape[0]
+    levels = max(1, int(depth.max()).bit_length()) if n else 1
+    # Rounded up so the XLA engine sees fewer distinct table shapes (the
+    # recurrence is closed past the root: up saturates at the root,
+    # mx at -1 — extra levels are semantically inert).
+    levels = -(-levels // 4) * 4
+    up = np.empty((levels, n), dtype=np.int64)
+    mx = np.empty((levels, n), dtype=np.int64)
+    up[0] = parent
+    mx[0] = perank
+    for k in range(1, levels):
+        up[k] = up[k - 1][up[k - 1]]
+        mx[k] = np.maximum(mx[k - 1], mx[k - 1][up[k - 1]])
+    return up, mx
+
+
+def _path_max_np(
+    up: np.ndarray, mx: np.ndarray, depth: np.ndarray,
+    a: np.ndarray, b: np.ndarray,
+) -> np.ndarray:
+    """Max tree-edge rank on the tree path ``a[i] .. b[i]``, vectorized
+    over all queries at once (the NumPy engine)."""
+    K = up.shape[0]
+    a = a.copy()
+    b = b.copy()
+    best = np.full(a.shape[0], -1, dtype=np.int64)
+    # Lift the deeper endpoint up to the shallower one's depth.
+    diff = depth[a] - depth[b]
+    swap = diff < 0
+    a[swap], b[swap] = b[swap], a[swap]
+    diff = np.abs(diff)
+    for k in range(K):
+        take = (diff >> k) & 1 == 1
+        best[take] = np.maximum(best[take], mx[k][a[take]])
+        a[take] = up[k][a[take]]
+    # Lift both while their 2^k ancestors differ; afterwards both sit one
+    # step below the LCA.
+    meet = a == b
+    for k in range(K - 1, -1, -1):
+        split = ~meet & (up[k][a] != up[k][b])
+        best[split] = np.maximum(
+            best[split], np.maximum(mx[k][a[split]], mx[k][b[split]])
+        )
+        a[split] = up[k][a[split]]
+        b[split] = up[k][b[split]]
+    final = ~meet
+    best[final] = np.maximum(
+        best[final], np.maximum(mx[0][a[final]], mx[0][b[final]])
+    )
+    return best
+
+
+#: The jitted XLA query, built once (lazily — this module must import
+#: without jax). A per-call ``@jax.jit`` would defeat jax's compile cache
+#: entirely: the cache keys on the wrapped FUNCTION OBJECT plus shapes.
+_XLA_QUERY = None
+
+
+def _get_xla_query():
+    global _XLA_QUERY
+    if _XLA_QUERY is not None:
+        return _XLA_QUERY
+    import jax
+    import jax.numpy as jnp
+
+    @jax.jit
+    def query(up_j, mx_j, depth_j, aq, bq):
+        K = up_j.shape[0]
+        da, db = depth_j[aq], depth_j[bq]
+        swap = da - db < 0
+        aq, bq = jnp.where(swap, bq, aq), jnp.where(swap, aq, bq)
+        diff = jnp.abs(da - db)
+        best = jnp.full(aq.shape, -1, dtype=jnp.int32)
+
+        def lift(k, carry):
+            aq, best = carry
+            take = (diff >> k) & 1 == 1
+            best = jnp.where(take, jnp.maximum(best, mx_j[k][aq]), best)
+            aq = jnp.where(take, up_j[k][aq], aq)
+            return aq, best
+
+        aq, best = jax.lax.fori_loop(0, K, lift, (aq, best))
+        meet = aq == bq
+
+        def descend(i, carry):
+            aq, bq, best = carry
+            k = K - 1 - i
+            split = ~meet & (up_j[k][aq] != up_j[k][bq])
+            cand = jnp.maximum(mx_j[k][aq], mx_j[k][bq])
+            best = jnp.where(split, jnp.maximum(best, cand), best)
+            aq = jnp.where(split, up_j[k][aq], aq)
+            bq = jnp.where(split, up_j[k][bq], bq)
+            return aq, bq, best
+
+        aq, bq, best = jax.lax.fori_loop(0, K, descend, (aq, bq, best))
+        last = jnp.maximum(mx_j[0][aq], mx_j[0][bq])
+        return jnp.where(meet, best, jnp.maximum(best, last))
+
+    _XLA_QUERY = query
+    return query
+
+
+def _path_max_xla(
+    up: np.ndarray, mx: np.ndarray, depth: np.ndarray,
+    a: np.ndarray, b: np.ndarray,
+) -> np.ndarray:
+    """The same query batch under ``jax.jit`` — plain XLA ops only (no
+    Pallas anywhere on this path), padded to a power of two so repeat
+    certifications of same-scale graphs reuse the compiled executable
+    (one compile per distinct ``(levels, n, padded queries)`` shape)."""
+    # int32 everywhere: vertex ids and edge ranks both fit (the certify
+    # entry points bound m below 2^31), and x64-disabled jax would
+    # silently truncate int64 anyway — better to cast deliberately.
+    up = up.astype(np.int32)
+    mx = mx.astype(np.int32)
+    depth = depth.astype(np.int32)
+    nq = a.shape[0]
+    pad = 1 << max(0, int(nq - 1).bit_length())
+    a_p = np.zeros(pad, dtype=np.int32)
+    b_p = np.zeros(pad, dtype=np.int32)
+    a_p[:nq] = a
+    b_p[:nq] = b  # pads query (0, 0): path max -1, inert
+    out = np.asarray(_get_xla_query()(up, mx, depth, a_p, b_p))
+    return out[:nq]
+
+
+def _resolve_engine(engine: str) -> str:
+    if engine == "auto":
+        try:
+            import jax  # noqa: F401
+
+            return "xla"
+        except Exception:  # noqa: BLE001 — no jax: numpy engine
+            return "np"
+    if engine not in ("np", "xla"):
+        raise ValueError(f"unknown certificate engine {engine!r}")
+    return engine
+
+
+def certify_edge_ids(
+    graph: Graph,
+    edge_ids: np.ndarray,
+    *,
+    engine: str = "auto",
+    expect_components: Optional[int] = None,
+) -> Certificate:
+    """Certify that ``edge_ids`` (indices into ``graph.u/v/w``) are THE
+    minimum spanning forest of ``graph``. See the module docstring for
+    what a passing certificate proves."""
+    t0 = time.perf_counter()
+    engine = _resolve_engine(engine)
+    n, m = graph.num_nodes, graph.num_edges
+    if engine == "xla" and max(n, m) >= 2**31:
+        engine = "np"  # the XLA engine is int32; host ints are unbounded
+
+    def done(cert: Certificate) -> Certificate:
+        cert.engine = engine
+        cert.check_s = time.perf_counter() - t0
+        BUS.count("verify.checks")
+        BUS.record("verify.check_s", cert.check_s)
+        return cert
+
+    ids = np.asarray(edge_ids, dtype=np.int64).ravel()
+    if ids.size and (ids.min() < 0 or ids.max() >= m):
+        return done(_fail(
+            "bad_edge_ids",
+            f"edge id out of range [0, {m}): "
+            f"[{ids.min()}, {ids.max()}]",
+        ))
+    if np.unique(ids).size != ids.size:
+        return done(_fail(
+            "bad_edge_ids",
+            f"{ids.size - np.unique(ids).size} duplicate edge ids claimed",
+        ))
+
+    tu, tv = graph.u[ids], graph.v[ids]
+    tree_labels = _components(n, tu, tv)
+    c_tree = int(np.unique(tree_labels).size) if n else 0
+    if ids.size != n - c_tree:
+        # More claimed edges than a forest on these components can hold ==
+        # at least one cycle (self-loops/duplicates were already rejected).
+        return done(_fail(
+            "cycle",
+            f"{ids.size} claimed edges over {c_tree} components "
+            f"(a forest has exactly {n - c_tree})",
+            num_tree_edges=int(ids.size), num_components=c_tree,
+        ))
+    c_graph = (
+        int(np.unique(_components(n, graph.u, graph.v)).size) if n else 0
+    )
+    if c_tree != c_graph:
+        return done(_fail(
+            "not_spanning",
+            f"claimed forest has {c_tree} components, the input graph "
+            f"has {c_graph} — a component was dropped or split",
+            num_tree_edges=int(ids.size),
+            num_components=c_tree, graph_components=c_graph,
+            expected_edges=n - c_graph,
+        ))
+    if expect_components is not None and int(expect_components) != c_graph:
+        return done(_fail(
+            "metadata_mismatch",
+            f"result metadata claims {expect_components} components, "
+            f"certificate finds {c_graph}",
+            num_tree_edges=int(ids.size),
+            num_components=c_tree, graph_components=c_graph,
+        ))
+
+    # Cycle property over ranks: every non-tree edge must out-rank every
+    # tree edge on the path between its endpoints.
+    if m and ids.size:
+        rank = _edge_ranks(graph)
+        in_tree = np.zeros(m, dtype=bool)
+        in_tree[ids] = True
+        parent, depth, perank = _root_forest(n, tu, tv, rank[ids])
+        up, mx = _lift_tables(parent, perank, depth)
+        nt = np.nonzero(~in_tree)[0]
+        if nt.size:
+            path_max = (_path_max_xla if engine == "xla" else _path_max_np)(
+                up, mx, depth, graph.u[nt], graph.v[nt]
+            )
+            bad = rank[nt] < path_max
+            if bad.any():
+                worst = nt[bad][:4]
+                return done(_fail(
+                    "not_minimal",
+                    f"{int(bad.sum())} non-tree edges are lighter than a "
+                    f"tree edge on their path (e.g. edge ids "
+                    f"{worst.tolist()})",
+                    num_tree_edges=int(ids.size),
+                    num_components=c_tree, graph_components=c_graph,
+                    expected_edges=n - c_graph,
+                    violations=int(bad.sum()),
+                ))
+    return done(Certificate(
+        ok=True, reason=None,
+        num_tree_edges=int(ids.size), expected_edges=n - c_graph,
+        num_components=c_tree, graph_components=c_graph,
+    ))
+
+
+def certify_result(result, *, engine: str = "auto") -> Certificate:
+    """Certify an :class:`api.MSTResult` — the serve-side entry point.
+
+    Checks the result's ``num_components`` metadata against the certified
+    count too: a deserialized cache entry can corrupt metadata and arrays
+    independently."""
+    return certify_edge_ids(
+        result.graph,
+        result.edge_ids,
+        engine=engine,
+        expect_components=result.num_components,
+    )
+
+
+def certify_claim(
+    num_nodes: int,
+    edges: Sequence,
+    mst_edges: Sequence,
+    *,
+    total_weight=None,
+    engine: str = "np",
+    atol: float = 1e-6,
+) -> Certificate:
+    """Certify a *payload-shaped* claim: the request's raw edge list plus
+    a response's ``mst_edges`` pairs (and optional claimed total weight).
+
+    This is the fleet router's form — it holds the original request (the
+    graph) and a forwarded response (the claim) as plain JSON, never as
+    repo objects, and must verify WITHOUT jax on its import path (the
+    default engine here is ``"np"``). A claimed pair that is not an input
+    edge fails ``unknown_edge``; a claimed weight that disagrees with the
+    recomputed edge sum fails ``weight_mismatch`` even when the edge set
+    itself is plausible (the corruption a bit-flipped weight field is).
+    """
+    t0 = time.perf_counter()
+
+    def done(cert: Certificate) -> Certificate:
+        cert.check_s = time.perf_counter() - t0
+        return cert
+
+    try:
+        graph = Graph.from_edges(int(num_nodes), edges)
+        pairs = np.asarray(list(mst_edges), dtype=np.int64).reshape(-1, 2)
+    except Exception as e:  # noqa: BLE001 — adversarial input IS the job
+        # A ragged/non-numeric claim (a buggy, older-build, or lying
+        # peer) must FAIL its certificate, not crash the verifier — the
+        # caller's rejection path is the same either way.
+        BUS.count("verify.checks")
+        return done(_fail(
+            "malformed_claim", f"{type(e).__name__}: {e}", engine=engine,
+        ))
+    lo = np.minimum(pairs[:, 0], pairs[:, 1])
+    hi = np.maximum(pairs[:, 0], pairs[:, 1])
+
+    if pairs.size and (
+        graph.num_edges == 0
+        or lo.min() < 0 or hi.max() >= graph.num_nodes
+    ):
+        BUS.count("verify.checks")
+        return done(_fail(
+            "unknown_edge",
+            "claimed edges against an edgeless graph" if
+            graph.num_edges == 0 else "claimed edge endpoint out of range",
+            engine=engine,
+        ))
+    # Graph arrays are lexsorted by (u, v) after canonicalization: claimed
+    # pairs map to edge ids by binary search on the packed key.
+    key = graph.u.astype(np.int64) * graph.num_nodes + graph.v
+    want = lo * graph.num_nodes + hi
+    pos = np.searchsorted(key, want)
+    ok_pos = (pos < key.size) & (key[np.minimum(pos, key.size - 1)] == want)
+    if pairs.size and not ok_pos.all():
+        missing = pairs[~ok_pos][:4]
+        BUS.count("verify.checks")
+        return done(_fail(
+            "unknown_edge",
+            f"claimed edges are not input edges: {missing.tolist()}",
+            engine=engine,
+        ))
+    ids = pos.astype(np.int64)
+    if total_weight is not None and pairs.size:
+        recomputed = graph.w[ids].sum()
+        if abs(float(recomputed) - float(total_weight)) > atol:
+            BUS.count("verify.checks")
+            return done(_fail(
+                "weight_mismatch",
+                f"claimed total weight {total_weight} != recomputed "
+                f"{recomputed}",
+                engine=engine,
+            ))
+    return done(certify_edge_ids(graph, ids, engine=engine))
+
+
+def describe_violations(cert: Certificate) -> List[str]:
+    """Human-readable failure rows for incident logs and drill reports."""
+    if cert.ok:
+        return []
+    return [f"{cert.reason}: {cert.detail}"]

@@ -103,6 +103,188 @@ def test_frame_oversize_declaration_refused_before_allocating():
     assert read_frame(frame, max_bytes=1 << 20)["pad"] == "y" * 2048
 
 
+def test_frame_crc_round_trip_and_meta():
+    from distributed_ghs_implementation_tpu.fleet.framing import encode_frame
+
+    obj = {"id": 3, "resp": {"ok": True, "total_weight": 42}}
+    buf = io.BytesIO(encode_frame(obj, crc=True))
+    meta = {}
+    assert read_frame(buf, meta=meta) == obj
+    assert meta["crc"] is True
+    # Legacy frames still read, and report crc=False.
+    buf = io.BytesIO(encode_frame(obj))
+    meta = {}
+    assert read_frame(buf, meta=meta) == obj
+    assert meta["crc"] is False
+
+
+def test_frame_crc_rejects_every_bit_flipped_payload():
+    """The gap CRC closes: without it, a flipped payload byte can survive
+    as DIFFERENT valid JSON (e.g. a mutated digit in a weight). With the
+    checksummed form, every single-bit payload mutation is a typed
+    FrameError at the frame boundary — fuzzed across all payload bytes
+    and several bit positions."""
+    import random
+
+    from distributed_ghs_implementation_tpu.fleet.framing import encode_frame
+
+    obj = {"id": 9, "resp": {"ok": True, "total_weight": 1234,
+                             "mst_edges": [[0, 1], [1, 2]]}}
+    frame = encode_frame(obj, crc=True)
+    header_len = frame.index(b"\n") + 1
+    payload_len = len(frame) - header_len - 1
+    rng = random.Random(7)
+    for _ in range(64):
+        i = header_len + rng.randrange(payload_len)
+        flipped = bytearray(frame)
+        flipped[i] ^= 1 << rng.randrange(8)
+        with pytest.raises(FrameError):
+            read_frame(io.BytesIO(bytes(flipped)))
+    # The same flips on a LEGACY frame demonstrate the hole: at least one
+    # mutation must survive parsing as a different object (that is why
+    # the checksum exists). Flip each digit of the weight.
+    legacy = encode_frame(obj)
+    lh = legacy.index(b"\n") + 1
+    survived = 0
+    for i in range(lh, len(legacy) - 1):
+        flipped = bytearray(legacy)
+        flipped[i] ^= 1  # low-bit flip: digit -> adjacent digit
+        try:
+            out = read_frame(io.BytesIO(bytes(flipped)))
+        except FrameError:
+            continue
+        if out is not None and out != obj:
+            survived += 1
+    assert survived > 0
+
+
+def test_frame_crc_garbage_headers_refused():
+    with pytest.raises(FrameError, match="non-hex"):
+        read_frame(io.BytesIO(b"5 zzzz\nhello\n"))
+    with pytest.raises(FrameError, match="malformed"):
+        read_frame(io.BytesIO(b"5 1a2b 77\nhello\n"))
+    # Declared crc that simply mismatches.
+    with pytest.raises(FrameError, match="checksum mismatch"):
+        read_frame(io.BytesIO(b'2 00000000\n{}\n'))
+
+
+def test_transport_crc_echo_on_receipt():
+    """A worker-side transport flips its outbound frames to the
+    checksummed form after the first checksummed inbound frame — the
+    negotiation that never sends CRC at a peer that might not parse it."""
+    import os as _os
+
+    from distributed_ghs_implementation_tpu.fleet.transport import (
+        PipeTransport,
+    )
+
+    r1, w1 = _os.pipe()  # router -> worker
+    r2, w2 = _os.pipe()  # worker -> router
+    router_side = PipeTransport(_os.fdopen(w1, "wb"), _os.fdopen(r2, "rb"))
+    worker_side = PipeTransport(_os.fdopen(w2, "wb"), _os.fdopen(r1, "rb"))
+    try:
+        assert not worker_side.crc_out
+        worker_side.send({"ready": True})  # hello: always legacy form
+        meta_frame = router_side.recv()
+        assert meta_frame == {"ready": True} and not router_side.crc_out
+        router_side.enable_crc()  # the hello advertised caps.crc
+        router_side.send({"ping": 1})
+        assert worker_side.recv() == {"ping": 1}
+        assert worker_side.crc_out  # echo-on-receipt
+        worker_side.send({"pong": 1})
+        assert router_side.recv() == {"pong": 1}
+    finally:
+        router_side.close()
+        worker_side.close()
+
+
+def test_chaos_payload_corrupts_only_result_frames_exactly():
+    """fleet.chaos.payload fires PAST framing, only on decoded solve
+    responses that carry an edge set, one armed shot per corrupted
+    frame — so drill counters map 1:1 onto corruptions."""
+    from distributed_ghs_implementation_tpu.fleet.transport import (
+        ChaosState,
+        ChaosTransport,
+        PipeTransport,
+    )
+    from distributed_ghs_implementation_tpu.utils.resilience import FAULTS
+
+    r1, w1 = os.pipe()
+    writer = PipeTransport(os.fdopen(w1, "wb"), io.BytesIO())
+    reader = ChaosTransport(
+        PipeTransport(io.BytesIO(), os.fdopen(r1, "rb")), ChaosState()
+    )
+    try:
+        FAULTS.arm("fleet.chaos.payload", times=1)
+        result = {"id": 1, "resp": {
+            "ok": True, "total_weight": 10, "mst_edges": [[0, 1], [1, 2]]}}
+        writer.send({"pong": 3})          # no edge set: never corrupted
+        writer.send(dict(result))         # armed: corrupted
+        writer.send({"id": 2, "resp": dict(result["resp"])})  # shot spent
+        assert reader.recv() == {"pong": 3}
+        corrupted = reader.recv()
+        assert corrupted["resp"]["total_weight"] == 11
+        assert corrupted["resp"]["mst_edges"][0] == [0, 0]
+        clean = reader.recv()
+        assert clean["resp"]["total_weight"] == 10
+        assert BUS.counters().get("fleet.chaos.payload_corrupted") == 1
+    finally:
+        FAULTS.reset()
+        writer.close()
+        reader.close()
+
+
+def test_router_certifies_solve_responses():
+    """The router-side payload certificate: a good claim passes, a
+    mutated edge set / weight fails, unverifiable pairs are skipped."""
+    import numpy as np
+
+    from distributed_ghs_implementation_tpu.graphs.generators import (
+        gnm_random_graph,
+    )
+    from distributed_ghs_implementation_tpu.models.rank_solver import (
+        solve_graph_kruskal_host,
+    )
+
+    g = gnm_random_graph(48, 120, seed=3)
+    edge_ids, _frag, _lv = solve_graph_kruskal_host(g)
+    mst_edges = [[int(a), int(b)]
+                 for a, b in zip(g.u[edge_ids], g.v[edge_ids])]
+    weight = int(np.sum(g.w[edge_ids]))
+    request = {
+        "op": "solve", "num_nodes": g.num_nodes,
+        "edges": [[int(a), int(b), int(c)]
+                  for a, b, c in zip(g.u, g.v, g.w)],
+    }
+    good = {"ok": True, "mst_edges": mst_edges, "total_weight": weight}
+    cert = FleetRouter._certify_solve_response(request, good)
+    assert cert is not None and cert.ok
+    bad = dict(good, total_weight=weight + 1)
+    cert = FleetRouter._certify_solve_response(request, bad)
+    assert cert is not None and not cert.ok
+    assert cert.reason == "weight_mismatch"
+    mangled = dict(good, mst_edges=[[0, 0]] + mst_edges[1:])
+    cert = FleetRouter._certify_solve_response(request, mangled)
+    assert cert is not None and cert.reason == "unknown_edge"
+    # Unverifiable pairs: digest-only requests, edge-less responses.
+    assert FleetRouter._certify_solve_response(
+        {"op": "solve", "digest": "d"}, good
+    ) is None
+    assert FleetRouter._certify_solve_response(
+        request, {"ok": True, "total_weight": weight}
+    ) is None
+    # Structurally malformed claims from a buggy/lying peer must FAIL
+    # certification, never crash the request that would have rejected
+    # them (ragged rows, non-numeric entries).
+    for junk in ([[0, 1], [2]], [["a", "b"]], [[0]], "nope and nope"):
+        cert = FleetRouter._certify_solve_response(
+            request, dict(good, mst_edges=junk if isinstance(junk, list)
+                          else [junk])
+        )
+        assert cert is not None and not cert.ok
+        assert cert.reason == "malformed_claim", (junk, cert.summary())
+
+
 # ----------------------------------------------------------------------
 # Hello / protocol version (fleet/transport.py)
 # ----------------------------------------------------------------------
@@ -114,8 +296,10 @@ def test_hello_round_trip_carries_proto_and_caps():
     checked = check_hello(dict(hello))
     assert checked["proto"] == PROTO_VERSION
     assert checked["worker"] == 3 and checked["token"] == "tok-1"
+    # Round 19: every hello from this build additionally advertises the
+    # frame-checksum capability (the router version-gates CRC on it).
     assert checked["caps"] == {"lane": True, "stream": False,
-                               "kernel": "xla"}
+                               "kernel": "xla", "crc": True}
 
 
 def test_hello_version_mismatch_rejected_with_clear_error():

@@ -1,0 +1,309 @@
+"""Integrity-checked persistence: sha256 sidecars from atomic_write_npz,
+quarantine-instead-of-deserialize on mismatch, the store's corrupt-vs-
+ENOENT distinction (a truncated npz NEVER raises out of ``get()``), the
+WAL's per-record crc, and checkpoint-recovery integration."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from distributed_ghs_implementation_tpu.obs.events import BUS
+from distributed_ghs_implementation_tpu.utils.checkpoint import (
+    atomic_write_npz,
+)
+from distributed_ghs_implementation_tpu.utils.integrity import (
+    IntegrityError,
+    check_file,
+    list_quarantined,
+    quarantine,
+    read_sidecar,
+    sidecar_path,
+)
+
+
+@pytest.fixture(autouse=True)
+def _clean_bus():
+    BUS.enable()
+    BUS.clear()
+    yield
+    BUS.clear()
+
+
+def _flip_one_byte(path: str, offset: int = -20) -> None:
+    with open(path, "r+b") as f:
+        data = bytearray(f.read())
+        data[offset] ^= 0xFF
+        f.seek(0)
+        f.write(data)
+
+
+# ----------------------------------------------------------------------
+# Sidecars from atomic_write_npz
+# ----------------------------------------------------------------------
+def test_atomic_write_records_matching_sidecar(tmp_path):
+    path = str(tmp_path / "x.npz")
+    atomic_write_npz(path, {"a": np.arange(5)})
+    assert os.path.exists(sidecar_path(path))
+    assert check_file(path) == "ok"
+
+
+def test_rotation_keeps_bak_sidecar_consistent(tmp_path):
+    path = str(tmp_path / "x.npz")
+    atomic_write_npz(path, {"a": np.arange(5)})
+    atomic_write_npz(path, {"a": np.arange(9)})
+    assert check_file(path) == "ok"
+    assert check_file(path + ".bak") == "ok"
+    # The generations really differ (the .bak sidecar is the OLD hash).
+    assert read_sidecar(path) != read_sidecar(path + ".bak")
+
+
+def test_rotation_after_sidecarless_primary_drops_stale_bak_sidecar(
+    tmp_path,
+):
+    """Crash-window regression: a primary that lost its sidecar (crash
+    between data rename and sidecar write) must not leave an OLDER
+    generation's .bak sidecar behind on the next rotation — that stale
+    hash would false-quarantine a perfectly good .bak fallback."""
+    path = str(tmp_path / "x.npz")
+    atomic_write_npz(path, {"a": np.arange(3)})   # gen 1
+    atomic_write_npz(path, {"a": np.arange(5)})   # gen 2 (+ gen-1 .bak)
+    os.unlink(sidecar_path(path))  # simulate the crash window
+    atomic_write_npz(path, {"a": np.arange(7)})   # gen 3: rotates gen 2
+    # The .bak holds gen-2 bytes; a surviving gen-1 sidecar would flag it.
+    assert check_file(path) == "ok"
+    assert check_file(path + ".bak") == "unverified"
+    with np.load(path + ".bak") as data:
+        assert data["a"].size == 5
+
+
+def test_bit_flip_raises_integrity_error_then_quarantines(tmp_path):
+    path = str(tmp_path / "x.npz")
+    atomic_write_npz(path, {"a": np.arange(64)})
+    _flip_one_byte(path)
+    with pytest.raises(IntegrityError):
+        check_file(path)
+    dest = quarantine(path, reason="test", counter="test.quarantined")
+    assert dest and os.path.exists(dest)
+    assert not os.path.exists(path)
+    assert os.path.exists(sidecar_path(dest))  # evidence travels together
+    assert list_quarantined(str(tmp_path)) == ["x.npz"]
+    assert BUS.counters().get("test.quarantined") == 1
+    # A second quarantine of the now-missing path is a no-op, not an error.
+    assert quarantine(path) is None
+
+
+def test_missing_sidecar_is_unverified_not_error(tmp_path):
+    path = str(tmp_path / "legacy.npz")
+    np.savez(path, a=np.arange(3))  # a pre-integrity file: no sidecar
+    assert check_file(path) == "unverified"
+    with pytest.raises(FileNotFoundError):
+        check_file(str(tmp_path / "nope.npz"))
+
+
+# ----------------------------------------------------------------------
+# Store: quarantine + corrupt-vs-ENOENT (satellite regression)
+# ----------------------------------------------------------------------
+def _store_with_one_entry(tmp_path):
+    from distributed_ghs_implementation_tpu.api import (
+        minimum_spanning_forest,
+    )
+    from distributed_ghs_implementation_tpu.graphs.generators import (
+        gnm_random_graph,
+    )
+    from distributed_ghs_implementation_tpu.serve.store import (
+        ResultStore,
+        solve_cache_key,
+    )
+
+    g = gnm_random_graph(48, 120, seed=5)
+    result = minimum_spanning_forest(g, backend="host")
+    store = ResultStore(capacity=4, disk_dir=str(tmp_path))
+    key = solve_cache_key(g, backend="host")
+    store.put(key, result)
+    return store, key, g, result
+
+
+def _disk_file(tmp_path):
+    return [str(p) for p in tmp_path.iterdir()
+            if p.name.endswith(".npz")][0]
+
+
+def test_store_quarantines_rotted_file_and_degrades_to_miss(tmp_path):
+    store, key, g, result = _store_with_one_entry(tmp_path)
+    store._mem.clear()  # force the disk path
+    _flip_one_byte(_disk_file(tmp_path))
+    assert store.get(key, g) is None  # a miss, never an exception
+    counters = BUS.counters()
+    assert counters.get("serve.store.quarantined") == 1
+    assert list_quarantined(str(tmp_path))
+    # The rotted file is GONE from the serving directory: the next put
+    # starts clean, the next get is a plain miss.
+    BUS.clear()
+    assert store.get(key, g) is None
+    assert "serve.store.quarantined" not in BUS.counters()
+
+
+def test_store_truncated_npz_never_raises_from_get(tmp_path):
+    """The satellite regression: a legacy torn npz (no sidecar to catch
+    it) must come back as a quarantined miss, not an exception."""
+    store, key, g, result = _store_with_one_entry(tmp_path)
+    store._mem.clear()
+    path = _disk_file(tmp_path)
+    os.unlink(sidecar_path(path))  # legacy file: integrity can't see it
+    with open(path, "r+b") as f:
+        blob = f.read()
+        f.seek(0)
+        f.truncate(len(blob) // 3)
+    assert store.get(key, g) is None  # torn zip: miss, not a raise
+    assert BUS.counters().get("serve.store.quarantined") == 1
+
+
+def test_store_enoent_is_a_plain_miss_not_corruption(tmp_path):
+    store, key, g, result = _store_with_one_entry(tmp_path)
+    store._mem.clear()
+    path = _disk_file(tmp_path)
+    os.unlink(path)
+    os.unlink(sidecar_path(path))
+    assert store.get(key, g) is None
+    counters = BUS.counters()
+    assert "serve.store.quarantined" not in counters
+    assert counters.get("serve.store.miss") == 1
+
+
+def test_store_invalidate_purges_memory_and_quarantines_disk(tmp_path):
+    store, key, g, result = _store_with_one_entry(tmp_path)
+    assert store.invalidate(key)
+    assert len(store) == 0
+    assert list_quarantined(str(tmp_path))
+    assert BUS.counters().get("serve.store.invalidated") == 1
+    # Nothing left to serve from either layer.
+    assert store.get(key, g) is None
+    # Idempotent: a second invalidate finds nothing.
+    assert not store.invalidate(key)
+
+
+def test_store_bak_generation_survives_primary_rot(tmp_path):
+    from distributed_ghs_implementation_tpu.serve.store import ResultStore
+
+    store, key, g, result = _store_with_one_entry(tmp_path)
+    store.put(key, result)  # second put: rotates a .bak generation
+    store._mem.clear()
+    _flip_one_byte(_disk_file(tmp_path))
+    got = store.get(key, g)  # primary quarantined, .bak answers
+    assert got is not None
+    assert got.total_weight == result.total_weight
+    assert BUS.counters().get("serve.store.quarantined") == 1
+
+
+# ----------------------------------------------------------------------
+# WAL per-record crc (utils/wal.py)
+# ----------------------------------------------------------------------
+def test_wal_records_carry_and_validate_crc(tmp_path):
+    from distributed_ghs_implementation_tpu.utils.wal import JsonlWal
+
+    wal = JsonlWal(str(tmp_path / "log.jsonl"), schema="test-v1",
+                   counter_prefix="test.wal")
+    wal.append({"seq": 1, "value": 10})
+    wal.append({"seq": 2, "value": 20})
+    entries, torn = wal.read()
+    assert [e["seq"] for e in entries] == [1, 2] and torn == 0
+    with open(wal.path) as f:
+        assert all("crc" in json.loads(ln) for ln in f.read().splitlines())
+
+
+def test_wal_value_mutation_caught_by_crc(tmp_path):
+    """A bit flip that keeps the line VALID JSON — the corruption the
+    schema check cannot see — must be skipped and counted."""
+    from distributed_ghs_implementation_tpu.utils.wal import JsonlWal
+
+    wal = JsonlWal(str(tmp_path / "log.jsonl"), schema="test-v1",
+                   counter_prefix="test.wal")
+    wal.append({"seq": 1, "value": 10})
+    wal.append({"seq": 2, "value": 20})
+    wal.append({"seq": 3, "value": 30})
+    with open(wal.path) as f:
+        lines = f.read().splitlines()
+    assert '"value":20' in lines[1]
+    lines[1] = lines[1].replace('"value":20', '"value":21')
+    assert json.loads(lines[1])  # still parses: only crc can object
+    with open(wal.path, "w") as f:
+        f.write("\n".join(lines) + "\n")
+    entries, _ = wal.read()
+    assert [e["seq"] for e in entries] == [1, 3]
+    counters = BUS.counters()
+    assert counters.get("test.wal.crc_mismatch") == 1
+    assert counters.get("test.wal.corrupt_line") == 1
+    # The tail scan skips the mutated record the same way.
+    lines[2] = lines[2].replace('"value":30', '"value":31')
+    with open(wal.path, "w") as f:
+        f.write("\n".join(lines) + "\n")
+    assert wal.tail()["seq"] == 1
+
+
+def test_wal_legacy_lines_without_crc_still_accepted(tmp_path):
+    from distributed_ghs_implementation_tpu.utils.wal import JsonlWal
+
+    wal = JsonlWal(str(tmp_path / "log.jsonl"), schema="test-v1",
+                   counter_prefix="test.wal")
+    with open(wal.path, "w") as f:
+        f.write(json.dumps({"schema": "test-v1", "seq": 1}) + "\n")
+    wal.append({"seq": 2})
+    entries, _ = wal.read()
+    assert [e["seq"] for e in entries] == [1, 2]
+    assert wal.tail()["seq"] == 2
+
+
+def test_wal_crc_canonical_roundtrip_floats_and_unicode(tmp_path):
+    from distributed_ghs_implementation_tpu.utils.wal import JsonlWal
+
+    wal = JsonlWal(str(tmp_path / "log.jsonl"), schema="test-v1",
+                   counter_prefix="test.wal")
+    record = {"seq": 1, "f": 0.1 + 0.2, "s": "naïve ☃",
+              "nested": {"z": [1.5, None, True]}}
+    wal.append(record)
+    entries, _ = wal.read()
+    assert entries[0]["f"] == record["f"]
+    assert entries[0]["s"] == record["s"]
+    assert BUS.counters().get("test.wal.crc_mismatch") is None
+
+
+# ----------------------------------------------------------------------
+# Checkpoint recovery integration
+# ----------------------------------------------------------------------
+def test_checkpoint_resilient_load_skips_rotted_primary(tmp_path):
+    from distributed_ghs_implementation_tpu.utils.checkpoint import (
+        load_checkpoint_resilient,
+    )
+
+    path = str(tmp_path / "ck.npz")
+    atomic_write_npz(path, {
+        "fragment": np.arange(4), "mst_ranks": np.arange(6),
+        "level": np.asarray(2),
+    })
+    atomic_write_npz(path, {
+        "fragment": np.arange(4), "mst_ranks": np.arange(6),
+        "level": np.asarray(3),
+    })
+    _flip_one_byte(path)
+    state, source, notes = load_checkpoint_resilient(path)
+    assert state is not None and source == path + ".bak"
+    assert state[2] == 2  # the .bak generation's level
+    assert any("IntegrityError" in why for _, why in notes)
+
+
+def test_stream_snapshot_rot_quarantined_falls_to_bak(tmp_path):
+    from distributed_ghs_implementation_tpu.stream.log import UpdateLog
+
+    log = UpdateLog(str(tmp_path), "s1")
+    state = {"num_nodes": 4, "u": np.asarray([0, 1]),
+             "v": np.asarray([1, 2]), "w": np.asarray([5, 6]),
+             "in_tree": np.asarray([True, True])}
+    log.snapshot(dict(state), seq=1, digest="d1")
+    log.snapshot(dict(state), seq=2, digest="d2")
+    _flip_one_byte(log.snap_path)
+    loaded, notes = log.load_snapshot()
+    assert loaded is not None and loaded["seq"] == 1  # the .bak generation
+    assert BUS.counters().get("stream.log.quarantined") == 1
+    assert any("quarantined" in why for _, why in notes)

@@ -845,9 +845,19 @@ def test_recover_chains_wal_on_stored_snapshot_digest(tmp_path):
         arrays = {k: np.asarray(data[k]) for k in data.files}
     arrays["digest"] = np.asarray("tampered-stored-digest")
     np.savez(log.snap_path, **arrays)
+    # Drop the checksum sidecar: this test simulates a LEGACY stored-digest
+    # mismatch (pre-integrity snapshot generations), not bit rot — with the
+    # stale sidecar left in place the round-19 integrity layer would
+    # (correctly) quarantine the rewritten file before replay ever saw it.
+    os.unlink(log.snap_path + ".sha256")
     with open(log.wal_path) as f:
         entries = [json.loads(line) for line in f.read().splitlines() if line]
     entries[0]["prev"] = "tampered-stored-digest"
+    for e in entries:
+        # Strip the per-record crc too (legacy lines carry none): an edited
+        # line under the ORIGINAL crc is exactly what the round-19 checksum
+        # exists to reject.
+        e.pop("crc", None)
     with open(log.wal_path, "w") as f:
         for e in entries:
             f.write(json.dumps(e) + "\n")

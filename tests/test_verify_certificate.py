@@ -1,0 +1,365 @@
+"""The verify/ layer: certificate checker vs the NetworkX oracle (seeded
+random + RMAT graphs), adversarial mutations rejected with the RIGHT
+reason, engine agreement (NumPy vs jitted XLA), the off|sample|full
+policy, the async auditor, and the service-level transparent correction
+path."""
+
+import numpy as np
+import networkx as nx
+import pytest
+
+from distributed_ghs_implementation_tpu.api import minimum_spanning_forest
+from distributed_ghs_implementation_tpu.graphs.generators import (
+    gnm_random_graph,
+    line_graph,
+    rmat_graph,
+)
+from distributed_ghs_implementation_tpu.obs.events import BUS
+from distributed_ghs_implementation_tpu.verify.certify import (
+    certify_claim,
+    certify_edge_ids,
+    certify_result,
+)
+from distributed_ghs_implementation_tpu.verify.policy import (
+    AsyncAuditor,
+    VerifyPolicy,
+)
+
+
+@pytest.fixture(autouse=True)
+def _clean_bus():
+    BUS.enable()
+    BUS.clear()
+    yield
+    BUS.clear()
+
+
+def _ranks(g):
+    order = np.argsort(g.w, kind="stable")
+    rank = np.empty(g.num_edges, dtype=np.int64)
+    rank[order] = np.arange(g.num_edges)
+    return rank
+
+
+def _edges_of(g):
+    return [[int(a), int(b), int(c)] for a, b, c in zip(g.u, g.v, g.w)]
+
+
+# ----------------------------------------------------------------------
+# Oracle parity: a passing certificate == the NetworkX-exact MSF
+# ----------------------------------------------------------------------
+@pytest.mark.parametrize("seed", [0, 1, 2, 3])
+def test_certificate_accepts_true_msf_and_matches_oracle(seed):
+    g = gnm_random_graph(180, 560, seed=seed)
+    r = minimum_spanning_forest(g, backend="host")
+    cert = certify_result(r, engine="np")
+    assert cert.ok and cert.reason is None
+    # The oracle cross-check: certificate acceptance must coincide with
+    # NetworkX weight parity (MSF weight is unique).
+    oracle = nx.minimum_spanning_tree(g.to_networkx())
+    assert r.total_weight == sum(
+        d["weight"] for _, _, d in oracle.edges(data=True)
+    )
+
+
+@pytest.mark.parametrize("scale", [8, 10])
+def test_certificate_on_rmat_graphs_both_engines(scale):
+    g = rmat_graph(scale, 8, seed=scale)
+    r = minimum_spanning_forest(g, backend="host")
+    for engine in ("np", "xla"):
+        cert = certify_result(r, engine=engine)
+        assert cert.ok, (engine, cert.summary())
+        assert cert.graph_components == r.num_components
+
+
+def test_certificate_deep_path_graph():
+    # A line graph's MST is the graph itself: maximum depth per vertex —
+    # the pointer-doubling depth build and log-depth lifting must hold.
+    g = line_graph(4096)
+    r = minimum_spanning_forest(g, backend="host")
+    for engine in ("np", "xla"):
+        assert certify_result(r, engine=engine).ok
+
+
+def test_empty_and_edgeless_graphs():
+    from distributed_ghs_implementation_tpu.graphs.edgelist import Graph
+
+    g = Graph.from_edges(5, [])
+    cert = certify_edge_ids(g, np.zeros(0, dtype=np.int64), engine="np")
+    assert cert.ok and cert.graph_components == 5
+
+
+# ----------------------------------------------------------------------
+# Adversarial mutations: rejected, each with the RIGHT reason
+# ----------------------------------------------------------------------
+def _swap_for_heavier(g, ids):
+    """Replace one tree edge with a heavier non-tree edge closing the
+    same cycle: still a spanning forest, no longer minimal."""
+    rank = _ranks(g)
+    in_tree = np.zeros(g.num_edges, dtype=bool)
+    in_tree[ids] = True
+    T = nx.Graph()
+    T.add_nodes_from(range(g.num_nodes))
+    for i in ids:
+        T.add_edge(int(g.u[i]), int(g.v[i]), eid=int(i))
+    for e in np.nonzero(~in_tree)[0]:
+        a, b = int(g.u[e]), int(g.v[e])
+        if not nx.has_path(T, a, b):
+            continue
+        path = nx.shortest_path(T, a, b)
+        on_path = [T[x][y]["eid"] for x, y in zip(path, path[1:])]
+        drop = max(on_path, key=lambda i: rank[i])
+        if rank[e] > rank[drop]:
+            out = ids.copy()
+            out[np.nonzero(ids == drop)[0][0]] = e
+            return out
+    return None
+
+
+@pytest.mark.parametrize("seed", [5, 6, 7])
+def test_swapped_heavier_edge_rejected_as_not_minimal(seed):
+    g = gnm_random_graph(150, 520, seed=seed)
+    r = minimum_spanning_forest(g, backend="host")
+    ids = np.asarray(r.edge_ids).copy()
+    mutated = _swap_for_heavier(g, ids)
+    assert mutated is not None
+    for engine in ("np", "xla"):
+        cert = certify_edge_ids(g, mutated, engine=engine)
+        assert not cert.ok and cert.reason == "not_minimal", cert.summary()
+        assert cert.violations >= 1
+
+
+def test_duplicate_edge_rejected_as_bad_edge_ids():
+    g = gnm_random_graph(80, 220, seed=9)
+    r = minimum_spanning_forest(g, backend="host")
+    ids = np.asarray(r.edge_ids).copy()
+    ids[0] = ids[1]
+    cert = certify_edge_ids(g, ids, engine="np")
+    assert cert.reason == "bad_edge_ids"
+    out_of_range = np.asarray(r.edge_ids).copy()
+    out_of_range[0] = g.num_edges + 3
+    assert certify_edge_ids(g, out_of_range).reason == "bad_edge_ids"
+
+
+def test_dropped_component_rejected_as_not_spanning():
+    # Two disjoint communities; drop every tree edge of the second.
+    a = gnm_random_graph(40, 90, seed=11)
+    edges = _edges_of(a)
+    edges += [[40 + int(u), 40 + int(v), int(w) + 1]
+              for u, v, w in _edges_of(gnm_random_graph(30, 70, seed=12))]
+    from distributed_ghs_implementation_tpu.graphs.edgelist import Graph
+
+    g = Graph.from_edges(70, edges)
+    r = minimum_spanning_forest(g, backend="host")
+    ids = np.asarray(r.edge_ids)
+    keep = ids[(g.u[ids] < 40) & (g.v[ids] < 40)]
+    assert keep.size < ids.size
+    cert = certify_edge_ids(g, keep, engine="np")
+    assert cert.reason == "not_spanning", cert.summary()
+
+
+def test_extra_edge_rejected_as_cycle():
+    g = gnm_random_graph(80, 220, seed=13)
+    r = minimum_spanning_forest(g, backend="host")
+    ids = np.asarray(r.edge_ids)
+    in_tree = np.zeros(g.num_edges, dtype=bool)
+    in_tree[ids] = True
+    extra = np.nonzero(~in_tree)[0][:1]
+    cert = certify_edge_ids(g, np.concatenate([ids, extra]), engine="np")
+    assert cert.reason == "cycle", cert.summary()
+
+
+def test_metadata_mismatch_rejected():
+    g = gnm_random_graph(60, 160, seed=14)
+    r = minimum_spanning_forest(g, backend="host")
+    cert = certify_edge_ids(
+        g, r.edge_ids, engine="np",
+        expect_components=r.num_components + 1,
+    )
+    assert cert.reason == "metadata_mismatch"
+
+
+def test_claim_form_unknown_edge_and_weight_mismatch():
+    g = gnm_random_graph(64, 180, seed=15)
+    r = minimum_spanning_forest(g, backend="host")
+    edges = _edges_of(g)
+    mst_edges = [[int(a), int(b)] for a, b in r.edges]
+    assert certify_claim(
+        64, edges, mst_edges, total_weight=r.total_weight
+    ).ok
+    assert certify_claim(
+        64, edges, mst_edges, total_weight=r.total_weight + 1
+    ).reason == "weight_mismatch"
+    assert certify_claim(
+        64, edges, [[0, 0]] + mst_edges[1:]
+    ).reason == "unknown_edge"
+    not_an_edge = mst_edges[:]
+    # A vertex pair that is (virtually certainly) not an input edge.
+    pairs = {(int(a), int(b)) for a, b in zip(g.u, g.v)}
+    for u in range(64):
+        for v in range(u + 1, 64):
+            if (u, v) not in pairs:
+                not_an_edge[0] = [u, v]
+                break
+        else:
+            continue
+        break
+    assert certify_claim(64, edges, not_an_edge).reason in (
+        "unknown_edge", "cycle", "not_minimal", "not_spanning",
+    )
+
+
+# ----------------------------------------------------------------------
+# Engine agreement
+# ----------------------------------------------------------------------
+def test_engines_agree_verdict_for_verdict():
+    for seed in range(6):
+        g = gnm_random_graph(100, 300, seed=40 + seed)
+        r = minimum_spanning_forest(g, backend="host")
+        ids = np.asarray(r.edge_ids).copy()
+        cases = [ids]
+        mutated = _swap_for_heavier(g, ids)
+        if mutated is not None:
+            cases.append(mutated)
+        for case in cases:
+            a = certify_edge_ids(g, case, engine="np")
+            b = certify_edge_ids(g, case, engine="xla")
+            assert (a.ok, a.reason, a.violations) == (
+                b.ok, b.reason, b.violations
+            )
+
+
+# ----------------------------------------------------------------------
+# Policy + auditor
+# ----------------------------------------------------------------------
+def test_policy_parse_specs():
+    p = VerifyPolicy.parse("full")
+    assert p.default == "full" and p.enabled
+    p = VerifyPolicy.parse("bulk=full,interactive=sample,default=off")
+    assert p.mode_for("bulk") == "full"
+    assert p.mode_for("interactive") == "sample"
+    assert p.mode_for("anything") == "off"
+    p = VerifyPolicy.parse("sample:4")
+    assert p.default == "sample" and p.sample_every == 4
+    assert not VerifyPolicy.parse(None).enabled
+    assert not VerifyPolicy.parse("off").enabled
+    with pytest.raises(ValueError):
+        VerifyPolicy.parse("bogus-mode")
+    assert VerifyPolicy.parse(p) is p  # pass-through
+
+
+def test_policy_sampling_is_deterministic_per_class():
+    p = VerifyPolicy.parse("sample:3")
+    hits = [p.should_sample("a") for _ in range(7)]
+    assert hits == [True, False, False, True, False, False, True]
+    # Independent counters per class.
+    assert p.should_sample("b") is True
+
+
+def test_auditor_failure_callback_and_counters():
+    g = gnm_random_graph(60, 150, seed=21)
+    r = minimum_spanning_forest(g, backend="host")
+    bad = minimum_spanning_forest(g, backend="host")
+    bad.edge_ids[0] = bad.edge_ids[1]
+    failures = []
+    auditor = AsyncAuditor(
+        engine="np",
+        on_failure=lambda result, cert, cls, key: failures.append(
+            (cert.reason, cls, key)
+        ),
+    )
+    assert auditor.submit(r, cls="x", key="k1")
+    assert auditor.submit(bad, cls="y", key="k2")
+    assert auditor.flush()
+    counters = BUS.counters()
+    assert counters.get("verify.audit.ok") == 1
+    assert counters.get("verify.audit.failed") == 1
+    assert failures == [("bad_edge_ids", "y", "k2")]
+
+
+# ----------------------------------------------------------------------
+# Service-level transparent correction (the serving contract)
+# ----------------------------------------------------------------------
+def test_service_corrects_corrupted_cached_result():
+    from distributed_ghs_implementation_tpu.serve.service import MSTService
+
+    svc = MSTService(verify="full", backend="host")
+    g = gnm_random_graph(64, 180, seed=7)
+    req = {"op": "solve", "num_nodes": g.num_nodes,
+           "edges": _edges_of(g), "slo_class": "bulk"}
+    first = svc.handle(req)
+    assert first["ok"] and first["verified"] == "full"
+    # Corrupt the cached result in place — the miscompiled-kernel /
+    # flipped-RAM stand-in nothing below a certificate can see.
+    key = next(iter(svc.store._mem))
+    svc.store._mem[key].edge_ids[0] = svc.store._mem[key].edge_ids[1]
+    second = svc.handle(req)
+    assert second["ok"] and second["total_weight"] == first["total_weight"]
+    counters = BUS.counters()
+    assert counters.get("verify.failed") == 1
+    assert counters.get("verify.corrected") == 1
+    assert counters.get("serve.store.invalidated") == 1
+
+
+def test_service_off_mode_never_checks():
+    from distributed_ghs_implementation_tpu.serve.service import MSTService
+
+    svc = MSTService(backend="host")  # no verify kwarg at all
+    assert svc.verifier is None
+    g = gnm_random_graph(48, 120, seed=8)
+    resp = svc.handle({"op": "solve", "num_nodes": g.num_nodes,
+                       "edges": _edges_of(g)})
+    assert resp["ok"] and "verified" not in resp
+    assert "verify.checks" not in BUS.counters()
+
+
+def test_stream_replay_divergence_falls_back_to_fresh_solve(tmp_path):
+    """A WAL window whose updates were tampered (legacy line, no crc)
+    diverges replay: the recovered session must be rebuilt by ONE fresh
+    solve instead of serving the unvouched-for maintained forest."""
+    import json
+
+    from distributed_ghs_implementation_tpu.stream.log import UpdateLog
+    from distributed_ghs_implementation_tpu.stream.session import (
+        StreamManager,
+    )
+
+    root = str(tmp_path)
+    solves = []
+
+    def solver(graph):
+        solves.append(graph.num_edges)
+        return minimum_spanning_forest(graph, backend="host")
+
+    mgr = StreamManager(root=root, snapshot_every=100, backend="host",
+                        solver=solver)
+    g = gnm_random_graph(64, 180, seed=31)
+    seed_result = minimum_spanning_forest(g, backend="host")
+    session = mgr.subscribe(digest=g.digest(), result=seed_result)
+    mgr.publish(session.id, session.head,
+                [{"kind": "insert", "u": 0, "v": 63, "w": 1}])
+    # Tamper the committed window's updates on disk (drop the crc so the
+    # line still parses — the legacy-corruption shape the chain digest
+    # check must catch).
+    log = UpdateLog(root, session.id)
+    with open(log.wal_path) as f:
+        lines = [json.loads(ln) for ln in f.read().splitlines() if ln]
+    lines[-1]["updates"] = [{"kind": "insert", "u": 0, "v": 62, "w": 2}]
+    for ln in lines:
+        ln.pop("crc", None)
+    with open(log.wal_path, "w") as f:
+        for ln in lines:
+            f.write(json.dumps(ln) + "\n")
+    BUS.clear()
+    solves.clear()
+    fresh_mgr = StreamManager(root=root, snapshot_every=100, backend="host",
+                              solver=solver)
+    recovered = fresh_mgr.recover(session.id)
+    assert recovered is not None
+    counters = BUS.counters()
+    assert counters.get("stream.replay.diverged") == 1
+    assert counters.get("stream.replay.fresh_solve") == 1
+    assert len(solves) == 1  # exactly ONE corrective solve
+    # The fallback session serves a certified-fresh forest for whatever
+    # graph the durable log actually rebuilt.
+    assert certify_result(recovered.mst.result(), engine="np").ok

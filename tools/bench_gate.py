@@ -142,6 +142,26 @@ KINDS = {
     # gate-stream-bench-v1 (bench.py --update-stream): the windowed-vs-
     # sequential ratio is a wall-clock pair — gate as a throughput floor.
     "window_speedup": "throughput",
+    # gate-verify-v1 (tools/load_drill.py --corrupt-store) and
+    # gate-verify-bench-v1 (bench.py --verify): the corruption drill is
+    # fully seeded — K store files rot, M cached results are mutated, N
+    # response payloads are corrupted in flight — so every defense
+    # counter is exact. wrong_results is THE number this round exists
+    # for: a single wrong served answer is the reference's silent-wrong-
+    # MST failure reborn, never a tolerance question. quarantined /
+    # verify_corrected / payload_rejected exact: a changed count means
+    # corruption was missed (or phantom-detected), not jitter.
+    # mutation_rejected exact: the certificate's statistical power is a
+    # contract. verify_overhead_p50_s needs no override (the _s suffix
+    # gates it as a wall-time ceiling).
+    "wrong_results": "exact",
+    "quarantined": "exact",
+    "verify_failed": "exact",
+    "verify_corrected": "exact",
+    "payload_rejected": "exact",
+    "audit_failed": "exact",
+    "mutation_rejected": "exact",
+    "verify_failed_clean": "exact",
     # gate-kernel-v1 (tools/profile_levels.py --compare-kernels and
     # bench.py --kernel): the fused-Pallas vs XLA level-kernel ratio is a
     # wall-clock pair — gate as a throughput floor. On hosts where Pallas
@@ -343,11 +363,22 @@ def main(argv=None) -> int:
         action="store_true",
         help="run the bench and (re)write the baseline instead of comparing",
     )
+    p.add_argument(
+        "--update-baseline",
+        metavar="PATH",
+        help="(re)write PATH from this run's metrics and exit — the "
+        "one-flag form of '--update --baseline PATH', for refreshing a "
+        "workload-specific baseline (e.g. docs/BENCH_BASELINE_VERIFY.json "
+        "from a --metrics report) without touching the default",
+    )
     p.add_argument("--time-tolerance", type=float, default=0.5,
                    help="allowed fractional wall-time regression (0.5 = +50%%)")
     p.add_argument("--count-tolerance", type=float, default=0.02,
                    help="allowed fractional count regression (0.02 = +2%%)")
     args = p.parse_args(argv)
+    if args.update_baseline:
+        args.baseline = args.update_baseline
+        args.update = True
 
     if args.metrics:
         with open(args.metrics) as f:
