@@ -913,6 +913,190 @@ def run_sharded_bench(args) -> int:
     return 0
 
 
+def run_stream_sharded_bench(args) -> int:
+    """Durable sharded streaming (gate-stream-sharded-v1): window
+    throughput on a MESH-RESIDENT oversize stream, plus the crash-rebuild
+    leg — a fresh process re-staging the snapshot and replaying the WAL
+    into the lane's donated slots with zero fresh solves.
+
+    One oversize-by-node-bucket seed (past the lane-engine admission
+    ceiling, so it routes like a billion-edge graph while solving in
+    bench time) is solved cold on the mesh, subscribed as a durable
+    stream fused to the lane, and driven through K published windows —
+    each commit coalesces via ``stream/window.py`` and migrates the
+    resident CSR slots through ``ShardedLane.refresh_resident``
+    (``window_commits_per_sec`` / ``window_updates_per_sec``). Then the
+    manager and lane are thrown away and a fresh pair rebuilds the head
+    from snapshot + WAL alone (``replay_rebuild_s``): the snapshot
+    re-stages exactly once (``residency_restored`` gates exact), every
+    window re-scatters, no solver is even attached, and the rebuilt head
+    must be edge-exact against a fresh oracle solve. Warm head solves on
+    both sides stay dispatch-only (``reshard_skipped`` gates exact).
+    """
+    import tempfile
+
+    import numpy as np
+
+    from distributed_ghs_implementation_tpu.api import minimum_spanning_forest
+    from distributed_ghs_implementation_tpu.obs.events import BUS
+    from distributed_ghs_implementation_tpu.graphs.generators import (
+        gnm_random_graph,
+    )
+    from distributed_ghs_implementation_tpu.parallel.lane import ShardedLane
+    from distributed_ghs_implementation_tpu.stream.session import StreamManager
+    from distributed_ghs_implementation_tpu.stream.window import (
+        random_update_stream,
+        warm_window_kernels,
+    )
+
+    BUS.enable()
+    BUS.clear()
+    n, m = args.stream_sharded_nodes, args.stream_sharded_edges
+    windows, per_window = args.stream_sharded_windows, args.stream_window
+    g = gnm_random_graph(n, m, seed=SEED)
+    rng = np.random.default_rng(SEED)
+
+    lane = ShardedLane(kernel=args.kernel)
+    t0 = time.perf_counter()
+    lane.precompile(n, m)
+    warm_window_kernels(n, m)
+    warm_window_kernels(n, m + windows * per_window)
+    warmup_s = time.perf_counter() - t0
+    print(
+        f"mesh + window warmup ({lane.n_dev} device(s)): {warmup_s:.3f}s",
+        file=sys.stderr,
+    )
+
+    t0 = time.perf_counter()
+    seed_result = lane.solve_result(g)
+    seed_solve_s = time.perf_counter() - t0
+
+    with tempfile.TemporaryDirectory(prefix="ghs-stream-sharded-") as root:
+        # snapshot_every deliberately does NOT divide the window count:
+        # the rebuild leg must find WAL entries past the last snapshot
+        # (replay_windows gates exact), not a fully-snapshotted stream.
+        mgr = StreamManager(root=root, snapshot_every=3, lane=lane)
+        session = mgr.subscribe(digest=g.digest(), result=seed_result)
+        if not session.sharded:
+            print("STREAM NOT SHARDED: seed did not route to the mesh lane",
+                  file=sys.stderr)
+            return 1
+
+        head = session.head
+        t0 = time.perf_counter()
+        for _ in range(windows):
+            window = random_update_stream(rng, g, per_window)
+            head = mgr.publish(session.id, head, window)["digest"]
+        window_commit_s = time.perf_counter() - t0
+
+        # Warm head solve: dispatch-only on the residency the commits
+        # maintained (reshard_skipped counts it).
+        head_graph = session.mst.result().graph
+        t0 = time.perf_counter()
+        ids_live, _, _ = lane.solve(head_graph)
+        head_warm_solve_s = time.perf_counter() - t0
+
+        # Crash-rebuild leg: fresh lane + manager, NO solver attached —
+        # the rebuild is snapshot re-stage + WAL re-scatter or nothing.
+        stream_id = session.id
+        del mgr, session
+        lane2 = ShardedLane(kernel=args.kernel)
+        mgr2 = StreamManager(root=root, snapshot_every=3, lane=lane2)
+        t0 = time.perf_counter()
+        recovered = mgr2.recover(stream_id)
+        replay_rebuild_s = time.perf_counter() - t0
+        if recovered is None or recovered.head != head:
+            print("REPLAY REBUILD FAILED: recovered head diverged",
+                  file=sys.stderr)
+            return 1
+        rebuilt = recovered.mst.result()
+        t0 = time.perf_counter()
+        ids_replay, _, _ = lane2.solve(rebuilt.graph)
+        replay_warm_solve_s = time.perf_counter() - t0
+
+    ref = minimum_spanning_forest(rebuilt.graph, backend="device")
+    if not (
+        np.array_equal(np.sort(ids_live), np.sort(ref.edge_ids))
+        and np.array_equal(np.sort(ids_replay), np.sort(ref.edge_ids))
+        and np.array_equal(np.sort(rebuilt.edge_ids), np.sort(ref.edge_ids))
+    ):
+        print("STREAM-SHARDED PARITY FAILED vs fresh oracle solve",
+              file=sys.stderr)
+        return 1
+
+    counters = BUS.counters()
+    migrated = int(
+        counters.get("stream.lane.migrated", 0)
+        + counters.get("stream.lane.restaged", 0)
+    )
+    commits_per_sec = windows / window_commit_s
+    out = {
+        "metric": f"durable sharded streaming, gnm({n},{m}) on "
+        f"{lane.n_dev} device(s), {windows} windows of {per_window}",
+        "value": round(commits_per_sec, 2),
+        "unit": "window commits/s (mesh-resident, durable)",
+        "warmup_s": round(warmup_s, 3),
+        "seed_solve_s": round(seed_solve_s, 3),
+        "window_commits_per_sec": round(commits_per_sec, 2),
+        "window_updates_per_sec": round(
+            windows * per_window / window_commit_s, 1
+        ),
+        "head_warm_solve_s": round(head_warm_solve_s, 3),
+        "replay_rebuild_s": round(replay_rebuild_s, 3),
+        "replay_warm_solve_s": round(replay_warm_solve_s, 3),
+        "residency_migrated": migrated,
+        "residency_restored": int(counters.get("lane.resident.restored", 0)),
+        "replay_windows": int(counters.get("stream.replay.windows", 0)),
+        "replay_fresh_solves": int(
+            counters.get("stream.replay.fresh_solve", 0)
+        ),
+        "reshard_skipped": int(counters.get("lane.reshard.skipped", 0)),
+        "kernel": lane.kernel,
+        "parity": "edge-exact vs fresh oracle solve (live AND rebuilt head)",
+    }
+    print(json.dumps(out))
+    if args.metrics_out:
+        metrics = {
+            "warmup_s": warmup_s,
+            "seed_solve_s": seed_solve_s,
+            "window_commit_s": window_commit_s,
+            "window_commits_per_sec": commits_per_sec,
+            "window_updates_per_sec": windows * per_window / window_commit_s,
+            "head_warm_solve_s": head_warm_solve_s,
+            "replay_rebuild_s": replay_rebuild_s,
+            "replay_warm_solve_s": replay_warm_solve_s,
+            "residency_migrated": migrated,
+            "residency_restored": int(
+                counters.get("lane.resident.restored", 0)
+            ),
+            "replay_windows": int(counters.get("stream.replay.windows", 0)),
+            "replay_fresh_solves": int(
+                counters.get("stream.replay.fresh_solve", 0)
+            ),
+            "reshard_skipped": int(counters.get("lane.reshard.skipped", 0)),
+            "mst_weight": int(
+                rebuilt.graph.w[np.asarray(rebuilt.edge_ids)].sum()
+            ),
+            "mst_edges": int(np.asarray(rebuilt.edge_ids).size),
+        }
+        with open(args.metrics_out, "w") as f:
+            json.dump(
+                {
+                    "schema": "ghs-bench-metrics-v1",
+                    "config": {
+                        "workload": "gate-stream-sharded-v1",
+                        "shape": f"gnm({n},{m})-seed{SEED}"
+                        f"-{lane.n_dev}dev-w{windows}x{per_window}",
+                    },
+                    "metrics": metrics,
+                },
+                f,
+                indent=2,
+            )
+            f.write("\n")
+    return 0
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(description=__doc__)
     p.add_argument("--scale", type=int, default=24, help="RMAT scale (2^scale vertices)")
@@ -976,6 +1160,22 @@ def main(argv=None) -> int:
     p.add_argument("--stream-window", type=int, default=64,
                    help="updates per committed window (the batching unit)")
     p.add_argument(
+        "--stream-sharded", action="store_true",
+        help="measure durable sharded streaming (gate-stream-sharded-v1): "
+        "window commits on a mesh-resident oversize stream fused to the "
+        "sharded lane, then the crash-rebuild leg — snapshot re-stage + "
+        "WAL re-scatter with zero fresh solves, edge-exact vs a fresh "
+        "oracle; set XLA_FLAGS=--xla_force_host_platform_device_count=8 "
+        "for the CI dryrun mesh",
+    )
+    p.add_argument("--stream-sharded-nodes", type=int, default=70_000,
+                   help="stream seed nodes for --stream-sharded (oversize "
+                   "by node bucket: routes to the mesh lane)")
+    p.add_argument("--stream-sharded-edges", type=int, default=3_000)
+    p.add_argument("--stream-sharded-windows", type=int, default=8,
+                   help="published windows in --stream-sharded (each of "
+                   "--stream-window updates)")
+    p.add_argument(
         "--verify", action="store_true",
         help="certificate-checker overhead bench (gate-verify-bench-v1): "
         "per-engine certify p50 at interactive + bulk scale, adversarial "
@@ -1005,6 +1205,8 @@ def main(argv=None) -> int:
         return run_fleet_tcp_bench(args)
     if args.update_stream:
         return run_update_stream_bench(args)
+    if args.stream_sharded:
+        return run_stream_sharded_bench(args)
     if args.sharded_lane:
         return run_sharded_bench(args)
     if args.batch_lanes:

@@ -366,6 +366,7 @@ def summarize_report(report: Optional[dict]) -> Optional[dict]:
         "single_warmed": report.get("single_warmed", 0),
         "mesh_warmed": report.get("mesh_warmed", 0),
         "stream_warmed": report.get("stream_warmed", 0),
+        "stream_sharded_warmed": report.get("stream_sharded_warmed", 0),
         "kernel": report.get("kernel"),
         "wall_s": round(float(report.get("wall_s", 0.0)), 3),
     }
@@ -419,6 +420,7 @@ def run_warmup(plan: WarmupPlan, *, lane=None) -> dict:
         "mesh_warmed": 0,
         "mesh_skipped": 0,
         "stream_warmed": 0,
+        "stream_sharded_warmed": 0,
         "kernel": kernel,
         "wall_s": 0.0,
     }
@@ -504,6 +506,24 @@ def run_warmup(plan: WarmupPlan, *, lane=None) -> dict:
 
             for nodes, edges in plan.stream_buckets:
                 report["stream_warmed"] += warm_window_kernels(nodes, edges)
+        if lane is not None and plan.mesh_buckets:
+            # The fused path: a lane worker's declared oversize workloads
+            # are also the sizes its SHARDED STREAMS publish windows at
+            # (stream/session.py) — warm the windowed-maintenance round
+            # for them too, so the first committed window on a mesh-
+            # resident stream pays no jit tracing even when the operator
+            # only declared --warmup-mesh-buckets. Cheap when
+            # --warmup-stream-buckets already covered the size (jit-cache
+            # hit), and warm_window_kernels caps the cycle-pass bucket at
+            # the tree size, so n >> m oversize shapes stay small.
+            from distributed_ghs_implementation_tpu.stream.window import (
+                warm_window_kernels,
+            )
+
+            for nodes, edges in plan.mesh_buckets:
+                report["stream_sharded_warmed"] += warm_window_kernels(
+                    nodes, edges
+                )
         span.set(compiled=report["compiled"], cached=report["cached"])
     report["wall_s"] = time.perf_counter() - t0
     return report

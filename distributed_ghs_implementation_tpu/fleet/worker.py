@@ -203,6 +203,12 @@ def _hello_for(args, warmup_summary=None) -> dict:
     caps = {
         "lane": bool(args.sharded_lane),
         "stream": bool(args.stream_dir),
+        # Both halves of the fused path: this worker can serve an
+        # oversize stream mesh-resident AND rebuild that residency from
+        # the shared durable log after a restart (stream/session.py) —
+        # what lets the router treat lane workers as interchangeable
+        # inheritors for sharded streams.
+        "stream_sharded": bool(args.sharded_lane and args.stream_dir),
         "kernel": os.environ.get("GHS_KERNEL", "auto"),
         "verify": args.verify or "off",
     }

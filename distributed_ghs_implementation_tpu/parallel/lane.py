@@ -231,6 +231,14 @@ class ShardedLane:
         # buffers (between LRU lookup and dispatch completion): an entry
         # with readers must never be DONATED out from under them.
         self._in_use: Dict[str, int] = {}
+        # digest -> pin refcount: entries pinned by an open stream session
+        # are not LRU-evictable (the eviction race — pressure from
+        # unrelated oversize traffic must not free a streamed graph's
+        # buffers mid-window). Keyed by digest, independent of residency:
+        # a pin on a not-yet-staged digest is legal and arms the moment
+        # the entry lands; refresh_resident moves pins along the digest
+        # chain so a session's claim follows its head.
+        self._pinned: Dict[str, int] = {}
 
     # ------------------------------------------------------------------
     # Routing predicate
@@ -280,7 +288,19 @@ class ShardedLane:
                     self._in_use.get(res.digest, 0) + 1
                 )
             while len(self._lru) > self.capacity:
-                self._lru.popitem(last=False)  # dropping refs frees HBM
+                victim = next(
+                    (d for d in self._lru if not self._pinned.get(d)), None
+                )
+                if victim is None:
+                    # Every entry is pinned by an open stream session.
+                    # Running over capacity beats freeing a pinned graph's
+                    # buffers out from under a mid-window commit; capacity
+                    # recovers on the next unpin (the counter makes the
+                    # overflow visible so operators size capacity to the
+                    # live stream count).
+                    BUS.count("lane.resident.pin_overflow")
+                    break
+                self._lru.pop(victim)  # dropping refs frees HBM
                 BUS.count("lane.resident.evict")
 
     def _release(self, digest: str) -> None:
@@ -304,9 +324,80 @@ class ShardedLane:
     def evict(self, digest: str) -> bool:
         """Drop a resident graph from the LRU (its device buffers free once
         no in-flight dispatch holds a checkout). Returns whether it was
-        resident. The next solve of that digest restages from the host."""
+        resident. The next solve of that digest restages from the host.
+        Explicit eviction overrides pins — it is the correctness purge
+        (failed certificate, invalidated entry), not capacity pressure."""
         res, _ = self._pop_resident(digest)
         return res is not None
+
+    # ------------------------------------------------------------------
+    # Stream pinning (stream/session.py holds these for its sessions)
+    # ------------------------------------------------------------------
+    def pin(self, digest: str) -> bool:
+        """Pin ``digest`` against LRU eviction (refcounted). An open
+        stream session's head must stay device-resident across eviction
+        pressure from unrelated traffic — donating its slots away
+        mid-window would scatter the next commit into freed buffers.
+        Returns whether the digest is currently resident."""
+        with self._lock:
+            self._pinned[digest] = self._pinned.get(digest, 0) + 1
+            return digest in self._lru
+
+    def unpin(self, digest: str) -> None:
+        with self._lock:
+            n = self._pinned.get(digest, 0) - 1
+            if n <= 0:
+                self._pinned.pop(digest, None)
+            else:
+                self._pinned[digest] = n
+
+    def pin_count(self, digest: str) -> int:
+        with self._lock:
+            return self._pinned.get(digest, 0)
+
+    def move_pins(self, old_digest: str, new_digest: str) -> None:
+        """Re-key pin refcounts along the digest chain (a stream commit):
+        the session that pinned the old head now answers for the new one.
+        ``refresh_resident`` calls this on every outcome path, so pins
+        follow the chain even when the residency itself was dropped."""
+        if old_digest == new_digest:
+            return
+        with self._lock:
+            n = self._pinned.pop(old_digest, 0)
+            if n:
+                self._pinned[new_digest] = (
+                    self._pinned.get(new_digest, 0) + n
+                )
+
+    def ensure_resident(
+        self,
+        graph: Graph,
+        *,
+        digest: Optional[str] = None,
+        pin: bool = False,
+    ) -> bool:
+        """Stage ``graph`` into the resident LRU WITHOUT solving — the
+        stream-replay rebuild path: a restarted lane worker re-stages the
+        snapshot state and lets the replayed windows re-scatter into the
+        slots (``refresh_resident``), so recovery never pays a mesh
+        solve. Idempotent when the digest is already resident (beyond the
+        optional pin). Returns whether the graph is resident on return;
+        graphs the lane cannot serve (empty, or past the rank envelope)
+        return ``False`` without pinning."""
+        if graph.num_nodes == 0 or graph.num_edges == 0:
+            return False
+        if not self.admits(graph):
+            return False
+        digest = digest if digest is not None else graph.digest()
+        if pin:
+            self.pin(digest)
+        if self._get_resident(digest) is not None:
+            return True
+        with self._admit:
+            if self._get_resident(digest) is None:
+                self._put_resident(self._stage_resident(graph, digest))
+                BUS.count("lane.resident.restored")
+        return True
 
     def _stage_resident(
         self,
@@ -518,12 +609,16 @@ class ShardedLane:
         in full (``lane.restage``) — past that the padded scatter loses
         to one contiguous host->device copy.
         """
-        res, busy = self._pop_resident(old_digest)
-        if res is None:
-            return False
         n = new_graph.num_nodes
         n_pad, m_pad = self.pad_shape(n, new_graph.num_edges)
         digest = new_graph.digest()
+        # Pins re-key along the chain on EVERY outcome — dropped included:
+        # the stream session's claim follows its head digest, and a
+        # dropped residency re-stages under the new head already pinned.
+        self.move_pins(old_digest, digest)
+        res, busy = self._pop_resident(old_digest)
+        if res is None:
+            return False
         if (res.n_pad, res.m_pad) != (n_pad, m_pad) or res.num_nodes != n:
             BUS.count("lane.update.dropped")
             return False

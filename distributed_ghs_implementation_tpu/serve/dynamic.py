@@ -116,12 +116,18 @@ class DynamicMST:
         backend: str = "device",
         supervisor=None,
         solver=None,
+        pre_resolve=None,
     ):
         # ``solver`` (graph -> MSTResult) overrides the direct supervised
         # solve in :meth:`_resolve` — the stream layer injects the serving
         # scheduler here so a windowed session's full-re-solve escape hatch
         # is cached, single-flighted, and capacity-bounded like any other
-        # miss (stream/session.py).
+        # miss (stream/session.py). ``pre_resolve`` (graph -> None) runs
+        # just before that solve: the stream layer migrates a mesh-resident
+        # session's device residency onto the resolve graph here, so an
+        # oversize resolve dispatches on already-scattered slots instead of
+        # cold-staging mid-publish. Best effort — a hook failure costs a
+        # cold stage, never the resolve.
         g = result.graph
         self._n = g.num_nodes
         # Canonical layout: sorted by (u, v), unique. Graph construction
@@ -138,6 +144,7 @@ class DynamicMST:
         self._backend = backend
         self._supervisor = supervisor
         self._solver = solver
+        self._pre_resolve = pre_resolve
         self._threshold = resolve_threshold
         self._last_mode = "seed"
         self._dirty = False
@@ -396,6 +403,11 @@ class DynamicMST:
                 self._splice(a, b, upd.w, in_tree=False)
         BUS.count("serve.dynamic.resolve")
         graph = Graph(self._n, self._u.copy(), self._v.copy(), self._w.copy())
+        if self._pre_resolve is not None:
+            try:
+                self._pre_resolve(graph)
+            except Exception:  # noqa: BLE001 — residency is best effort
+                BUS.count("serve.dynamic.pre_resolve_failed")
         if self._solver is not None:
             solved = self._solver(graph)
         else:

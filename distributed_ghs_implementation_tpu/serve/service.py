@@ -141,26 +141,14 @@ class MSTService:
             StreamManager,
         )
 
-        stream_kwargs = {}
-        if max_streams is not None:
-            stream_kwargs["max_streams"] = max_streams
-        self.streams = StreamManager(
-            root=stream_dir,
-            snapshot_every=stream_snapshot_every,
-            backend=backend,
-            resolve_threshold=resolve_threshold,
-            window_mode=stream_window_mode,
-            solver=lambda g: self.scheduler.solve(g, backend=backend)[0],
-            interactive_gate=self.scheduler.interactive,
-            **stream_kwargs,
-        )
         # Result verification (round 19, docs/VERIFICATION.md): an
         # off|sample|full policy per SLO class. ``full`` classes certify
         # inline with transparent correction (the poisoned entry leaves
         # store + sessions + residency, the graph re-solves fresh, the
         # corrected answer is the one served); ``sample`` classes ride
         # the async audit thread. ``verify`` accepts a spec string or a
-        # prebuilt verify.policy.VerifyPolicy.
+        # prebuilt verify.policy.VerifyPolicy. Built BEFORE the stream
+        # manager so sharded stream commits can ride the same auditor.
         self.verifier = None
         if verify:
             from distributed_ghs_implementation_tpu.verify.policy import (
@@ -175,6 +163,25 @@ class MSTService:
                     invalidate=self._invalidate_entry,
                     resolve=self._fresh_resolve,
                 )
+        stream_kwargs = {}
+        if max_streams is not None:
+            stream_kwargs["max_streams"] = max_streams
+        self.streams = StreamManager(
+            root=stream_dir,
+            snapshot_every=stream_snapshot_every,
+            backend=backend,
+            resolve_threshold=resolve_threshold,
+            window_mode=stream_window_mode,
+            solver=lambda g: self.scheduler.solve(g, backend=backend)[0],
+            interactive_gate=self.scheduler.interactive,
+            # The sharded-stream fusion: oversize streams keep their heads
+            # mesh-resident (pinned, donated window scatters, replay
+            # re-staging) and their post-window heads audited
+            # (stream/session.py module docstring).
+            lane=lane,
+            verifier=self.verifier,
+            **stream_kwargs,
+        )
         # digest -> DynamicMST (materialized by an update) or a lightweight
         # (result, backend) seed (parked by a solve).
         self._sessions: "collections.OrderedDict[str, object]" = (
@@ -521,10 +528,10 @@ class MSTService:
                 self.store.evict_chain(
                     cache_key_for_digest(prev_digest, backend=self.backend)
                 )
-                if self.sharded_lane is not None:
-                    self.sharded_lane.refresh_resident(
-                        prev_digest, result.graph
-                    )
+                # Mesh residency migration moved INTO the stream manager's
+                # commit path (stream/session.py _maintain_residency):
+                # it re-keys the session's eviction pin along with the
+                # buffers, which a hook out here cannot do.
 
         out = self.streams.publish(
             stream, request.get("digest"), request.get("updates", []),

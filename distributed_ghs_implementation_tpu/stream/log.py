@@ -174,7 +174,11 @@ class UpdateLog:
         ``notifications`` rides along (JSON-encoded) so a recovered
         stream's ring reaches BACK past the snapshot point — a subscriber
         whose cursor predates the snapshot still drains gap-free after a
-        failover, instead of hitting ``truncated``."""
+        failover, instead of hitting ``truncated``.
+
+        Extra ``state`` keys persist as-is — the sharded-residency marker
+        (``"sharded"``, stream/session.py) rides the same npz under the
+        same sha256 sidecar + ``.bak`` integrity net as the arrays."""
         os.makedirs(self.dir, exist_ok=True)
         arrays = dict(state)
         arrays["seq"] = np.asarray(int(seq))
@@ -275,6 +279,17 @@ class UpdateLog:
                         "notifications": (
                             json.loads(str(data["notifications"]))
                             if "notifications" in data.files else []
+                        ),
+                        # Residency marker (absent on pre-sharded-stream
+                        # snapshots): this stream's head lived device-
+                        # resident on the mesh lane, so a recovering
+                        # worker re-stages BEFORE replaying and the
+                        # replayed windows re-scatter into the slots —
+                        # zero fresh solves on the rebuild path
+                        # (stream/session.py recover()).
+                        "sharded": (
+                            bool(data["sharded"])
+                            if "sharded" in data.files else False
                         ),
                     }
             except Exception as e:  # torn/corrupt: fall to the next generation

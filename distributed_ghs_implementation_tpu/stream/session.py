@@ -28,6 +28,25 @@ The protocol is pull-based, which is what survives failover cleanly:
   continues exactly where it left off — without one fresh solve
   (``stream.replay.*`` counters + the scheduler's fresh-solve counter are
   the receipts the kill drill asserts on).
+
+**Sharded streams.** With a ``ShardedLane`` attached, a stream whose
+graph is oversize for the lane engine (the scheduler's ``sharded_lane``
+route) keeps its head **device-resident on the mesh**: the session pins
+the residency for its lifetime (the lane-LRU eviction race — pressure
+from unrelated oversize traffic must not donate a streamed graph's slots
+away mid-window), every committed window migrates the residency along
+the digest chain through the donated padded-slot scatter
+(``refresh_resident`` — the pin re-keys with it), and a window that
+degrades to a full re-solve migrates FIRST (``pre_resolve``) so the mesh
+solve dispatches on already-scattered slots. The durability contract
+extends to residency: snapshots carry a ``sharded`` marker, and
+``recover`` re-stages the snapshot state (``ensure_resident`` — a
+``device_put``, never a solve) then lets each replayed window re-scatter
+into the slots, so a killed-and-restarted lane worker rebuilds
+device-resident state with zero fresh solves
+(``stream.replay.residency_restored``). Post-window sharded heads
+additionally ride the async NumPy certify engine under the standard
+``verify=off|sample|full`` policy (class ``stream_sharded``).
 """
 
 from __future__ import annotations
@@ -37,6 +56,8 @@ import contextlib
 import threading
 import time
 from typing import Dict, List, Optional
+
+import numpy as np
 
 from distributed_ghs_implementation_tpu.obs.events import BUS
 from distributed_ghs_implementation_tpu.obs.slo import current_class
@@ -98,7 +119,10 @@ class StaleDigest(KeyError):
 class StreamSession:
     """One live stream: the windowed session + its notification ring."""
 
-    __slots__ = ("id", "mst", "head", "seq", "notifications", "lock", "log")
+    __slots__ = (
+        "id", "mst", "head", "seq", "notifications", "lock", "log",
+        "sharded",
+    )
 
     def __init__(
         self,
@@ -107,6 +131,7 @@ class StreamSession:
         head: str,
         seq: int = 0,
         log: Optional[UpdateLog] = None,
+        sharded: bool = False,
     ):
         self.id = stream_id
         self.mst = mst
@@ -117,6 +142,11 @@ class StreamSession:
         )
         self.lock = threading.Lock()
         self.log = log
+        # This stream's head lives device-resident on the mesh lane
+        # (pinned for the session's life; see the manager's residency
+        # maintenance). Reset to False exactly once when the pin is
+        # released — the flag doubles as the unpin idempotency guard.
+        self.sharded = sharded
 
 
 class StreamManager:
@@ -133,6 +163,8 @@ class StreamManager:
         solver=None,
         interactive_gate=None,
         max_streams: int = _MAX_STREAMS,
+        lane=None,
+        verifier=None,
     ):
         if snapshot_every < 1:
             raise ValueError(
@@ -148,6 +180,11 @@ class StreamManager:
         self.max_streams = max_streams
         self._solver = solver
         self._gate = interactive_gate
+        # ``lane`` (parallel.lane.ShardedLane) turns oversize streams into
+        # mesh-resident sessions (module docstring); ``verifier``
+        # (verify.policy.ResultVerifier) audits their post-window heads.
+        self._lane = lane
+        self._verifier = verifier
         self._streams: "collections.OrderedDict[str, StreamSession]" = (
             collections.OrderedDict()
         )
@@ -169,6 +206,84 @@ class StreamManager:
         if state is not None:
             return WindowedMST.from_state(state, **kwargs)
         return WindowedMST(result, **kwargs)
+
+    # -- sharded residency helpers --------------------------------------
+    def _lane_wants(self, graph) -> bool:
+        """Is this stream's graph one the mesh lane serves — oversize for
+        the lane engine, inside the lane's rank envelope? Mirrors the
+        scheduler's routing rule (``BatchPolicy.route``), so stream
+        residency and solve routing agree on where a graph lives."""
+        if self._lane is None:
+            return False
+        from distributed_ghs_implementation_tpu.batch.warmup import (
+            bucket_of,
+            warmable_single,
+        )
+
+        if warmable_single(*bucket_of(graph.num_nodes, graph.num_edges)):
+            return False
+        return self._lane.admits(graph)
+
+    def _session_state(self, session: StreamSession) -> dict:
+        state = session.mst.state_arrays()
+        if session.sharded:
+            # The durability contract extends to residency: the snapshot
+            # records that this head lives device-resident on the mesh,
+            # so a restarted lane worker re-stages BEFORE replaying
+            # (replayed windows then re-scatter into the slots) instead
+            # of deciding from scratch mid-recovery.
+            state["sharded"] = np.asarray(True)
+        return state
+
+    def _attach_lane(self, session: StreamSession) -> None:
+        """Arm the resolve escape hatch for a mesh-resident stream: when a
+        window degrades to a full re-solve, migrate the head's residency
+        onto the resolve graph FIRST, so the injected solver's oversize
+        route lands dispatch-only on already-scattered slots instead of
+        cold-staging the m-sized arrays mid-publish."""
+        lane = self._lane
+
+        def pre_resolve(graph) -> None:
+            if not lane.refresh_resident(session.head, graph):
+                lane.ensure_resident(graph)
+
+        session.mst._pre_resolve = pre_resolve
+
+    def _unpin(self, session: StreamSession) -> None:
+        """Release a sharded session's residency pin exactly once (drop,
+        manager-LRU eviction, or losing a registration race)."""
+        if session.sharded and self._lane is not None:
+            self._lane.unpin(session.head)
+        session.sharded = False
+
+    def _maintain_residency(
+        self, session: StreamSession, prev: str, graph
+    ) -> None:
+        """Post-commit mesh maintenance (inside the session lock): scatter
+        the committed window's changed rank slots into the resident
+        per-shard buffers (donated), re-keying the residency — and the
+        session's pin — along the digest chain. A drop (padded-shape
+        change) on a sharded session re-stages, so 'the stream head is
+        device-resident' survives every outcome; non-sharded sessions
+        keep the best-effort migration (a no-op unless the head happened
+        to be resident)."""
+        migrated = self._lane.refresh_resident(prev, graph)
+        if migrated:
+            if session.sharded:
+                BUS.count("stream.lane.migrated")
+        elif session.sharded:
+            self._lane.ensure_resident(graph, digest=session.head)
+            BUS.count("stream.lane.restaged")
+
+    def _audit_sharded(self, session: StreamSession, result) -> None:
+        """Route a post-window (or post-replay) sharded head through the
+        async NumPy certify engine under the standard off|sample|full
+        policy — counted in ``verify.*`` like every other audit. The
+        one-shot solve path audits at response time; these heads never
+        pass through it, so without this class they would be invisible
+        to verification."""
+        if session.sharded and self._verifier is not None:
+            self._verifier.audit(result, cls="stream_sharded", key=None)
 
     def _register(self, session: StreamSession) -> StreamSession:
         with self._lock:
@@ -192,6 +307,11 @@ class StreamManager:
                     h for h, s in self._by_head.items() if s == _sid
                 ]:
                     del self._by_head[head]
+                # An evicted sharded stream releases its residency pin:
+                # the head stays resident only as long as LRU pressure
+                # allows, and recovery re-stages (without solving) if it
+                # was lost in between.
+                self._unpin(_evicted)
                 BUS.count("stream.evicted")
             return session
 
@@ -201,6 +321,7 @@ class StreamManager:
                 del self._streams[session.id]
             if self._by_head.get(session.head) == session.id:
                 del self._by_head[session.head]
+        self._unpin(session)
 
     def _move_head(self, session: StreamSession, prev: str) -> None:
         with self._lock:
@@ -282,15 +403,31 @@ class StreamManager:
 
     def _create(self, digest: str, result) -> StreamSession:
         mst = self._make_mst(result=result)
-        log = None
+        sharded = self._lane_wants(result.graph)
+        session = StreamSession(
+            digest[:_ID_LEN], mst, digest, 0, None, sharded=sharded
+        )
         if self.root is not None:
-            log = UpdateLog(self.root, digest[:_ID_LEN])
+            session.log = UpdateLog(self.root, digest[:_ID_LEN])
             # The creation snapshot (seq 0) is what makes the stream
             # replayable from its very first window.
-            log.snapshot(mst.state_arrays(), seq=0, digest=digest)
-        session = StreamSession(digest[:_ID_LEN], mst, digest, 0, log)
+            session.log.snapshot(
+                self._session_state(session), seq=0, digest=digest
+            )
+        if sharded:
+            # The seed rode the mesh (the scheduler's oversize route), so
+            # its slots are usually still resident — pin them for the
+            # session's life: eviction pressure from unrelated traffic
+            # must not donate the stream's buffers away mid-window. A
+            # seed that lost residency between solve and subscribe
+            # re-stages here WITHOUT solving.
+            self._lane.ensure_resident(result.graph, digest=digest, pin=True)
+            self._attach_lane(session)
         BUS.count("stream.created")
-        return self._register(session)
+        registered = self._register(session)
+        if registered is not session:
+            self._unpin(session)  # a concurrent subscribe won the race
+        return registered
 
     def publish(
         self,
@@ -378,10 +515,17 @@ class StreamManager:
             session.head = new_digest
             session.seq = seq
             self._move_head(session, prev)
+            if self._lane is not None and prev != new_digest:
+                # Mesh maintenance rides the commit point: the coalesced
+                # window's changed rank slots scatter into the resident
+                # per-shard buffers (donated) and residency + pin re-key
+                # to the new head — seq-ordered under the session lock,
+                # like every other per-head side effect here.
+                self._maintain_residency(session, prev, result.graph)
             if session.log is not None and seq % self.snapshot_every == 0:
                 try:
                     session.log.snapshot(
-                        session.mst.state_arrays(), seq=seq,
+                        self._session_state(session), seq=seq,
                         digest=new_digest,
                         notifications=list(session.notifications),
                     )
@@ -392,6 +536,7 @@ class StreamManager:
                     BUS.count("stream.log.snapshot_failed")
             if on_commit is not None:
                 on_commit(result, prev, new_digest)
+            self._audit_sharded(session, result)
             BUS.count("stream.window.committed")
             BUS.count("stream.notify")
             return {
@@ -471,6 +616,23 @@ class StreamManager:
             session = StreamSession(
                 stream_id, mst, head, state["seq"], log
             )
+            session.sharded = self._lane_wants(mst.result().graph)
+            if state.get("sharded") and not session.sharded:
+                # The snapshot says this head lived mesh-resident but this
+                # process cannot re-stage it (no lane, or the graph left
+                # the lane's envelope) — replay still rebuilds the forest;
+                # only the residency contract degrades, visibly.
+                BUS.count("stream.replay.residency_unavailable")
+            if session.sharded:
+                # Re-stage the snapshot state (a device_put, never a
+                # solve), pinned; each replayed window below then
+                # re-scatters into the slots through the same donated
+                # path a live publish uses, so residency — and the pin —
+                # re-key along the replayed chain.
+                self._lane.ensure_resident(
+                    mst.result().graph, digest=head, pin=True
+                )
+                self._attach_lane(session)
             # Ring continuity across the snapshot point: the persisted
             # notifications preload, replayed windows append after them.
             for note in state.get("notifications", []):
@@ -496,9 +658,16 @@ class StreamManager:
                 session.notifications.append(
                     _notification(entry["seq"], entry["prev"], new_digest, info)
                 )
+                prev_head = session.head
                 chain = session.head = new_digest
                 session.seq = entry["seq"]
                 replayed += 1
+                if session.sharded:
+                    # Replayed windows re-scatter into the re-staged
+                    # slots — the donated update path, not a solve — and
+                    # the residency digest re-keys along the chain
+                    # exactly as the live publishes did.
+                    self._maintain_residency(session, prev_head, result.graph)
             # Round 19: verify the REBUILT head against the journaled
             # expectation. On a clean replay the two agree by construction
             # (every applied window's recomputed digest was checked); a
@@ -526,12 +695,29 @@ class StreamManager:
                 BUS.count("stream.replay.fresh_solve")
                 fresh = self._solver(mst.result().graph)
                 session.mst = self._make_mst(result=fresh)
+                prev_head = session.head
                 session.head = fresh.graph.digest()
+                if session.sharded:
+                    # The re-derived head supersedes the replayed one:
+                    # carry the pin over and make sure the served head is
+                    # the resident one (the solver's oversize route
+                    # usually staged it already).
+                    self._lane.move_pins(prev_head, session.head)
+                    self._lane.ensure_resident(
+                        fresh.graph, digest=session.head
+                    )
+                    self._attach_lane(session)
+            if session.sharded:
+                BUS.count("stream.replay.residency_restored")
+                self._audit_sharded(session, session.mst.result())
             span.set(replayed=replayed, head_seq=session.seq)
             BUS.count("stream.replay.streams")
             if replayed:
                 BUS.count("stream.replay.windows", replayed)
-            return self._register(session)
+            registered = self._register(session)
+            if registered is not session:
+                self._unpin(session)  # a concurrent recover won the race
+            return registered
 
     # -- introspection ---------------------------------------------------
     def heads(self) -> Dict[str, str]:
@@ -542,6 +728,9 @@ class StreamManager:
         with self._lock:
             out = {
                 "streams": len(self._streams),
+                "sharded": sum(
+                    1 for s in self._streams.values() if s.sharded
+                ),
                 "root": self.root,
                 "snapshot_every": self.snapshot_every,
                 "heads": {

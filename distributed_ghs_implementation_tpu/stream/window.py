@@ -227,12 +227,20 @@ def warm_window_kernels(num_nodes: int, num_edges: int) -> int:
     from distributed_ghs_implementation_tpu.ops.segment_ops import INT32_MAX
 
     n = max(1, int(num_nodes))
+    m = max(1, int(num_edges))
+    # The cycle pass runs over MSF ∪ changed edges — bounded by the TREE
+    # size, which is min(n-1, m)-ish, not n. Capping at min(n, m) matters
+    # for the sharded-stream shapes (n ≫ m, e.g. 70k nodes / 3k edges):
+    # warming pow2(n)-wide rounds there would pay two giant compiles no
+    # window ever dispatches. For the common n ≤ m streams the cap is a
+    # no-op and the warmed set is unchanged.
+    t = min(n, m)
     shapes = sorted({
-        _next_pow2(max(1, int(num_edges))),
-        # The cycle pass runs over MSF ∪ changed edges — slightly MORE
-        # than n-1 edges, so it lands one bucket above next_pow2(n).
-        _next_pow2(n),
-        2 * _next_pow2(n),
+        _next_pow2(m),
+        # Slightly MORE than tree-size edges can enter the cycle pass, so
+        # warm one bucket above next_pow2(t) too.
+        _next_pow2(t),
+        2 * _next_pow2(t),
     })
     for m_pad in shapes:
         fragment = jnp.arange(n, dtype=jnp.int32)

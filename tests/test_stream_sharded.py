@@ -1,0 +1,448 @@
+"""Durable sharded streaming (the stream/ <-> parallel/lane.py fusion).
+
+Four contracts in code:
+
+* **Pinning** — a resident graph pinned by an open stream session is not
+  LRU-evictable, even when eviction pressure lands DURING a window's
+  apply; pins re-key along the digest chain with ``refresh_resident``.
+* **Mesh maintenance** — a committed window on an oversize stream
+  migrates device residency through the donated padded-slot scatter, and
+  a window that degrades to a full re-solve migrates FIRST
+  (``pre_resolve``) so the mesh solve is dispatch-only.
+* **Crash-safe residency** — a restarted process rebuilds both the
+  forest AND the device-resident state from snapshot + WAL replay with
+  zero fresh solves (the round-14 replay-without-solving test, now on
+  the mesh), edge-exact against a fresh oracle solve.
+* **Verification** — post-window sharded heads ride the async NumPy
+  certify engine under the standard off|sample|full policy.
+"""
+
+import numpy as np
+import pytest
+
+from distributed_ghs_implementation_tpu.api import minimum_spanning_forest
+from distributed_ghs_implementation_tpu.graphs.edgelist import Graph
+from distributed_ghs_implementation_tpu.graphs.generators import (
+    gnm_random_graph,
+)
+from distributed_ghs_implementation_tpu.obs.events import BUS
+from distributed_ghs_implementation_tpu.parallel.lane import ShardedLane
+from distributed_ghs_implementation_tpu.stream.session import StreamManager
+from distributed_ghs_implementation_tpu.stream.window import (
+    random_update_stream,
+)
+
+# Oversize by NODE bucket (matches tests/test_lane.py): routes like a
+# billion-edge graph — past the lane-engine admission ceiling, onto the
+# mesh — while solving in test time.
+OVERSIZE_NODES = 70_000
+OVERSIZE_EDGES = 3_000
+
+
+def _oversize_graph(seed):
+    return gnm_random_graph(OVERSIZE_NODES, OVERSIZE_EDGES, seed=seed)
+
+
+def _edges(g):
+    return [[int(a), int(b), int(c)] for a, b, c in zip(g.u, g.v, g.w)]
+
+
+def _window(rng, seed_graph, size=4):
+    return [
+        u.__dict__
+        for u in random_update_stream(
+            rng, seed_graph, size,
+            kinds=("insert", "insert", "delete", "reweight"), max_w=200,
+        )
+    ]
+
+
+@pytest.fixture(autouse=True)
+def _bus():
+    BUS.enable()
+    BUS.clear()
+    yield
+    BUS.clear()
+
+
+def _stage_spans():
+    return sum(1 for e in BUS.events() if e[1] == "lane.stage")
+
+
+# ----------------------------------------------------------------------
+# Satellite: pin/unpin on the lane LRU
+# ----------------------------------------------------------------------
+def test_pin_blocks_eviction_until_unpin():
+    lane = ShardedLane(capacity=2)
+    graphs = [gnm_random_graph(200, 600, seed=s) for s in range(4)]
+    lane.solve(graphs[0])
+    pinned = graphs[0].digest()
+    assert lane.pin(pinned)  # resident at pin time
+    lane.solve(graphs[1])
+    lane.solve(graphs[2])  # pressure: would evict graphs[0] unpinned
+    assert pinned in lane.resident_digests()
+    assert graphs[1].digest() not in lane.resident_digests()
+    lane.unpin(pinned)
+    lane.solve(graphs[3])  # now the oldest unpinned entry IS graphs[0]
+    assert pinned not in lane.resident_digests()
+
+
+def test_all_pinned_runs_over_capacity():
+    lane = ShardedLane(capacity=1)
+    g1, g2 = (gnm_random_graph(200, 600, seed=s) for s in (10, 11))
+    lane.solve(g1)
+    lane.solve(g2)
+    lane.pin(g1.digest())  # g1 was evicted: pin survives non-residency
+    lane.pin(g2.digest())
+    lane.solve(g1)  # restages; now both entries resident and pinned
+    assert set(lane.resident_digests()) >= {g1.digest(), g2.digest()}
+    assert BUS.counters().get("lane.resident.pin_overflow", 0) >= 1
+    # Explicit eviction (the certificate-failure purge) overrides pins.
+    assert lane.evict(g1.digest())
+    assert g1.digest() not in lane.resident_digests()
+
+
+def test_pins_rekey_along_chain_with_refresh():
+    lane = ShardedLane()
+    g = _oversize_graph(3)
+    lane.solve(g)
+    lane.pin(g.digest())
+    edges = _edges(g)
+    edges[10][2] += 1  # small rank shift: the donated-scatter regime
+    g2 = Graph.from_edges(g.num_nodes, edges)
+    assert lane.refresh_resident(g.digest(), g2)
+    assert lane.pin_count(g.digest()) == 0
+    assert lane.pin_count(g2.digest()) == 1
+    assert g2.digest() in lane.resident_digests()
+
+
+def test_ensure_resident_stages_without_solving():
+    lane = ShardedLane()
+    g = _oversize_graph(4)
+    assert lane.ensure_resident(g, pin=True)
+    c = BUS.counters()
+    assert c.get("lane.resident.restored") == 1
+    assert lane.pin_count(g.digest()) == 1
+    assert not BUS.counters().get("lane.resident.miss")
+    # No solve ran; the staged entry makes the NEXT solve dispatch-only
+    # (resident.hit + reshard.skipped, no second lane.stage span).
+    spans = _stage_spans()
+    ids, _, _ = lane.solve(g)
+    assert _stage_spans() == spans
+    assert BUS.counters().get("lane.reshard.skipped") == 1
+    ref = minimum_spanning_forest(g, backend="device")
+    assert np.array_equal(ids, ref.edge_ids)
+    # Idempotent: a second ensure is pin-only.
+    assert lane.ensure_resident(g)
+    assert BUS.counters().get("lane.resident.restored") == 1
+
+
+# ----------------------------------------------------------------------
+# Satellite regression: eviction pressure DURING apply_window
+# ----------------------------------------------------------------------
+def test_stream_head_survives_eviction_pressure_mid_window(
+    tmp_path, monkeypatch
+):
+    lane = ShardedLane(capacity=1)
+    g = _oversize_graph(5)
+    result = lane.solve_result(g)
+    mgr = StreamManager(root=str(tmp_path), lane=lane)
+    session = mgr.subscribe(digest=g.digest(), result=result)
+    assert session.sharded
+    assert lane.pin_count(session.head) == 1
+
+    rng = np.random.default_rng(0)
+    real_apply = session.mst.apply_window
+
+    def pressured_apply(updates):
+        # Unrelated oversize traffic lands while the window is mid-apply:
+        # at capacity 1 this is maximal eviction pressure on the pinned
+        # head — the race the pin exists to close.
+        lane.solve(_oversize_graph(50))
+        lane.solve(_oversize_graph(51))
+        return real_apply(updates)
+
+    monkeypatch.setattr(session.mst, "apply_window", pressured_apply)
+    out = mgr.publish(session.id, session.head, _window(rng, g))
+    # The commit migrated the still-resident pinned entry to the new
+    # head: residency and pin survived the pressure.
+    assert out["digest"] in lane.resident_digests()
+    assert lane.pin_count(out["digest"]) == 1
+    assert lane.pin_count(out["prev_digest"]) == 0
+    assert BUS.counters().get("stream.lane.migrated") == 1
+
+
+# ----------------------------------------------------------------------
+# Mesh maintenance on the publish path
+# ----------------------------------------------------------------------
+def test_sharded_publish_scatters_into_resident_slots(tmp_path):
+    lane = ShardedLane()
+    g = _oversize_graph(6)
+    result = lane.solve_result(g)
+    mgr = StreamManager(root=str(tmp_path), snapshot_every=2, lane=lane)
+    session = mgr.subscribe(digest=g.digest(), result=result)
+    rng = np.random.default_rng(1)
+    head = session.head
+    for _ in range(3):
+        head = mgr.publish(session.id, head, _window(rng, g))["digest"]
+    c = BUS.counters()
+    # Every window migrated residency without a solve — by donated
+    # scatter when the rank delta is narrow, full restage past
+    # max_update_frac (a wide-shifting insert); never dropped.
+    assert c.get("stream.lane.migrated") == 3
+    assert (
+        c.get("lane.update.donated", 0) + c.get("lane.restage", 0) == 3
+    )
+    assert c.get("lane.update.donated", 0) >= 1
+    assert not c.get("lane.update.dropped")
+    assert head in lane.resident_digests()
+    assert lane.pin_count(head) == 1
+    # A solve of the head is dispatch-only on the maintained residency,
+    # and edge-exact against a fresh oracle solve.
+    spans = _stage_spans()
+    ids, _, _ = lane.solve(session.mst.result().graph)
+    assert _stage_spans() == spans
+    oracle = minimum_spanning_forest(
+        session.mst.result().graph, backend="device"
+    )
+    assert np.array_equal(ids, oracle.edge_ids)
+
+
+def test_resolve_escape_hatch_migrates_residency_first(tmp_path):
+    lane = ShardedLane()
+    g = _oversize_graph(7)
+    result = lane.solve_result(g)
+    mgr = StreamManager(
+        root=str(tmp_path), lane=lane,
+        solver=lambda graph: lane.solve_result(graph),
+    )
+    session = mgr.subscribe(digest=g.digest(), result=result)
+    rng = np.random.default_rng(2)
+    # Past the window threshold the window degrades to a full re-solve —
+    # the escape hatch under test (lowered so a small window trips it).
+    session.mst._window_threshold = 4
+    out = mgr.publish(session.id, session.head, _window(rng, g, size=12))
+    assert out["mode"] == "resolve"
+    # pre_resolve migrated the head's residency onto the resolve graph
+    # BEFORE the solver ran: the mesh solve found it resident (no cold
+    # miss) and the pin followed the chain.
+    assert BUS.counters().get("lane.reshard.skipped") == 1
+    # Only the seed solve missed; the mid-publish resolve did not.
+    assert BUS.counters().get("lane.resident.miss", 0) == 1
+    assert out["digest"] in lane.resident_digests()
+    assert lane.pin_count(out["digest"]) == 1
+
+
+def test_small_stream_stays_unsharded_with_lane_attached(tmp_path):
+    lane = ShardedLane()
+    g = gnm_random_graph(60, 180, seed=8)
+    result = minimum_spanning_forest(g)
+    mgr = StreamManager(root=str(tmp_path), lane=lane)
+    session = mgr.subscribe(digest=g.digest(), result=result)
+    assert not session.sharded
+    assert lane.pin_count(session.head) == 0
+    rng = np.random.default_rng(3)
+    out = mgr.publish(session.id, session.head, _window(rng, g))
+    # No residency was created for a lane-engine-sized stream.
+    assert out["digest"] not in lane.resident_digests()
+    assert not BUS.counters().get("stream.lane.migrated")
+
+
+def test_drop_and_manager_eviction_release_pins(tmp_path):
+    lane = ShardedLane()
+    graphs = [_oversize_graph(s) for s in (20, 21)]
+    mgr = StreamManager(root=str(tmp_path), lane=lane, max_streams=1)
+    s0 = mgr.subscribe(
+        digest=graphs[0].digest(), result=lane.solve_result(graphs[0])
+    )
+    assert lane.pin_count(s0.head) == 1
+    # Registering a second stream LRU-evicts the first -> its pin drops.
+    mgr.subscribe(
+        digest=graphs[1].digest(), result=lane.solve_result(graphs[1])
+    )
+    assert lane.pin_count(graphs[0].digest()) == 0
+    assert lane.pin_count(graphs[1].digest()) == 1
+
+
+# ----------------------------------------------------------------------
+# Crash-safe residency: replay re-stages + re-scatters, never solves
+# ----------------------------------------------------------------------
+def test_sharded_replay_rebuilds_residency_without_solving(
+    tmp_path, monkeypatch
+):
+    root = str(tmp_path)
+    lane = ShardedLane()
+    g = _oversize_graph(9)
+    result = lane.solve_result(g)
+
+    def solver_bomb(graph):
+        raise AssertionError("sharded replay must never fresh-solve")
+
+    mgr = StreamManager(
+        root=root, snapshot_every=2, lane=lane, solver=solver_bomb
+    )
+    session = mgr.subscribe(digest=g.digest(), result=result)
+    rng = np.random.default_rng(4)
+    head = session.head
+    seen = []
+    for _ in range(5):
+        out = mgr.publish(session.id, head, _window(rng, g))
+        head = out["digest"]
+        seen.append(out["seq"])
+    stream_id = session.id
+
+    # --- the worker dies; an inheritor process starts fresh -----------
+    import distributed_ghs_implementation_tpu.serve.dynamic as dyn_mod
+
+    def bomb(*a, **k):
+        raise AssertionError("replay must never solve")
+
+    monkeypatch.setattr(dyn_mod, "minimum_spanning_forest", bomb)
+    BUS.clear()
+    lane2 = ShardedLane()
+    fresh = StreamManager(
+        root=root, snapshot_every=2, lane=lane2, solver=solver_bomb
+    )
+    recovered = fresh.recover(stream_id)
+    assert recovered is not None
+    assert recovered.head == head
+    assert recovered.seq == 5
+    assert recovered.sharded
+    c = BUS.counters()
+    # Residency rebuilt: snapshot state re-staged once (a device_put),
+    # each replayed window re-scattered through the donated path, the
+    # digest re-keyed along the chain — and nothing solved.
+    assert c.get("stream.replay.residency_restored") == 1
+    assert c.get("lane.resident.restored") == 1
+    assert not c.get("stream.replay.fresh_solve")
+    assert not c.get("stream.replay.diverged")
+    assert head in lane2.resident_digests()
+    assert lane2.pin_count(head) == 1
+    # Notification ring regenerated gap/dup-free.
+    from distributed_ghs_implementation_tpu.stream.session import (
+        poll_gap_check,
+    )
+
+    poll = fresh.poll(stream_id, after_seq=0)
+    seqs = [n["seq"] for n in poll["notifications"]]
+    assert poll_gap_check(seqs, poll["seq"]) == {"gaps": 0, "dups": 0}
+    # The rebuilt head is edge-exact against a fresh oracle solve (the
+    # API entry point is not the bombed reference).
+    rebuilt = recovered.mst.result()
+    oracle = minimum_spanning_forest(rebuilt.graph, backend="device")
+    assert np.array_equal(np.sort(rebuilt.edge_ids), np.sort(oracle.edge_ids))
+    # And serving the head from the rebuilt residency is dispatch-only.
+    spans = _stage_spans()
+    ids, _, _ = lane2.solve(rebuilt.graph)
+    assert _stage_spans() == spans
+    assert np.array_equal(ids, oracle.edge_ids)
+
+
+def test_snapshot_carries_sharded_marker(tmp_path):
+    from distributed_ghs_implementation_tpu.stream.log import UpdateLog
+
+    root = str(tmp_path)
+    lane = ShardedLane()
+    g = _oversize_graph(12)
+    mgr = StreamManager(root=root, lane=lane)
+    session = mgr.subscribe(digest=g.digest(), result=lane.solve_result(g))
+    state, _notes = UpdateLog(root, session.id).load_snapshot()
+    assert state is not None and state["sharded"] is True
+
+    small = gnm_random_graph(60, 180, seed=13)
+    s2 = mgr.subscribe(
+        digest=small.digest(), result=minimum_spanning_forest(small)
+    )
+    state2, _ = UpdateLog(root, s2.id).load_snapshot()
+    assert state2 is not None and state2["sharded"] is False
+
+
+# ----------------------------------------------------------------------
+# Satellite: sharded commits ride the verify policy
+# ----------------------------------------------------------------------
+def test_sharded_commits_audited_under_policy(tmp_path):
+    from distributed_ghs_implementation_tpu.verify.policy import (
+        ResultVerifier,
+        VerifyPolicy,
+    )
+
+    lane = ShardedLane()
+    verifier = ResultVerifier(VerifyPolicy.parse("full"))
+    g = _oversize_graph(14)
+    mgr = StreamManager(
+        root=str(tmp_path), snapshot_every=2, lane=lane, verifier=verifier
+    )
+    session = mgr.subscribe(digest=g.digest(), result=lane.solve_result(g))
+    rng = np.random.default_rng(5)
+    head = session.head
+    for _ in range(2):
+        head = mgr.publish(session.id, head, _window(rng, g))["digest"]
+    assert verifier.auditor.flush(timeout_s=30.0)
+    c = BUS.counters()
+    assert c.get("verify.audit.queued", 0) >= 2
+    assert c.get("verify.audit.ok", 0) >= 2
+    assert not c.get("verify.audit.failed")
+
+    # The replay-rebuilt head audits too — heads that never pass through
+    # the one-shot publish/solve response path are still verified.
+    BUS.clear()
+    lane2 = ShardedLane()
+    fresh = StreamManager(
+        root=str(tmp_path), snapshot_every=2, lane=lane2, verifier=verifier
+    )
+    assert fresh.recover(session.id) is not None
+    assert verifier.auditor.flush(timeout_s=30.0)
+    c = BUS.counters()
+    assert c.get("verify.audit.queued", 0) >= 1
+    assert c.get("verify.audit.ok", 0) >= 1
+
+
+def test_off_policy_skips_sharded_audit(tmp_path):
+    from distributed_ghs_implementation_tpu.verify.policy import (
+        ResultVerifier,
+        VerifyPolicy,
+    )
+
+    lane = ShardedLane()
+    verifier = ResultVerifier(VerifyPolicy.parse("off"))
+    g = _oversize_graph(15)
+    mgr = StreamManager(root=str(tmp_path), lane=lane, verifier=verifier)
+    session = mgr.subscribe(digest=g.digest(), result=lane.solve_result(g))
+    rng = np.random.default_rng(6)
+    mgr.publish(session.id, session.head, _window(rng, g))
+    assert not BUS.counters().get("verify.audit.queued")
+
+
+# ----------------------------------------------------------------------
+# Service-level: the fused path through the serve ops
+# ----------------------------------------------------------------------
+def test_service_sharded_stream_flow(tmp_path):
+    from distributed_ghs_implementation_tpu.serve.service import MSTService
+
+    svc = MSTService(
+        sharded_lane=True,
+        stream_dir=str(tmp_path / "streams"),
+        stream_snapshot_every=2,
+        verify="sample",
+    )
+    g = _oversize_graph(16)
+    edges = [[int(a), int(b), int(c)] for a, b, c in zip(g.u, g.v, g.w)]
+    solved = svc.handle(
+        {"op": "solve", "num_nodes": g.num_nodes, "edges": edges}
+    )
+    assert solved["ok"]
+    sub = svc.handle({"op": "subscribe", "digest": solved["digest"]})
+    assert sub["ok"]
+    assert BUS.counters().get("serve.route.sharded_lane", 0) >= 1
+    session = svc.streams._streams[sub["stream"]]
+    assert session.sharded
+    assert svc.sharded_lane.pin_count(sub["digest"]) == 1
+    rng = np.random.default_rng(7)
+    pub = svc.handle({
+        "op": "publish", "stream": sub["stream"], "digest": sub["digest"],
+        "updates": _window(rng, g),
+    })
+    assert pub["ok"]
+    assert pub["digest"] in svc.sharded_lane.resident_digests()
+    assert svc.sharded_lane.pin_count(pub["digest"]) == 1
+    assert svc.streams.stats()["sharded"] == 1

@@ -149,7 +149,8 @@ def test_run_warmup_reports_compiled_vs_cached(monkeypatch):
     assert run_warmup(WarmupPlan()) == {
         "buckets": 0, "compiled": 0, "cached": 0, "skipped": 0,
         "single_warmed": 0, "mesh_warmed": 0, "mesh_skipped": 0,
-        "stream_warmed": 0, "kernel": "xla", "wall_s": 0.0,
+        "stream_warmed": 0, "stream_sharded_warmed": 0,
+        "kernel": "xla", "wall_s": 0.0,
     }
 
 

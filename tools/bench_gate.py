@@ -142,6 +142,17 @@ KINDS = {
     # gate-stream-bench-v1 (bench.py --update-stream): the windowed-vs-
     # sequential ratio is a wall-clock pair — gate as a throughput floor.
     "window_speedup": "throughput",
+    # gate-stream-sharded-v1 (bench.py --stream-sharded): the fused
+    # stream/lane residency bookkeeping is deterministic — every window
+    # must migrate device residency (donated scatter or bounded restage),
+    # the crash rebuild must re-stage exactly once from the snapshot and
+    # replay every WAL window with ZERO fresh solves, and the warm head
+    # solves must stay dispatch-only. A changed count means the
+    # fused-path logic changed, never jitter.
+    "residency_restored": "exact",
+    "residency_migrated": "exact",
+    "replay_windows": "exact",
+    "replay_fresh_solves": "exact",
     # gate-verify-v1 (tools/load_drill.py --corrupt-store) and
     # gate-verify-bench-v1 (bench.py --verify): the corruption drill is
     # fully seeded — K store files rot, M cached results are mutated, N

@@ -110,6 +110,8 @@ WORKLOAD_VERIFY = "gate-verify-v1"
 WORKLOAD_STREAM = "gate-stream-v1"
 WORKLOAD_STREAM_FLEET = "gate-stream-fleet-v1"
 WORKLOAD_STREAM_KILL = "gate-stream-kill-v1"
+WORKLOAD_STREAM_SHARDED = "gate-stream-sharded-drill-v1"
+WORKLOAD_STREAM_SHARDED_KILL = "gate-stream-sharded-kill-v1"
 DEFAULT_BASELINE = os.path.join(
     os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
     "docs",
@@ -124,7 +126,17 @@ HIT_SHAPE = (64, 180)
 UPDATE_SHAPE = (80, 240)
 OVERSIZE_SHAPE = (70_000, 140_000)
 STREAM_SHAPE = (128, 384)  # subscribed graphs (--update-heavy)
+# --update-heavy --sharded-lane: oversize-by-node-bucket stream seeds —
+# past the lane-engine admission ceiling (routes like a billion-edge
+# graph), few enough edges to solve in drill time (tests/test_lane.py's
+# oversize shape). Streams then run MESH-RESIDENT: windows scatter into
+# the lane's donated slots and a kill is recovered by re-stage + replay.
+STREAM_SHARDED_SHAPE = (70_000, 3_000)
 STREAM_WINDOW_UPDATES = 6  # edge mutations per published window
+
+
+def _stream_seed_shape(args):
+    return STREAM_SHARDED_SHAPE if args.sharded_lane else STREAM_SHAPE
 
 
 @dataclasses.dataclass
@@ -332,8 +344,13 @@ def build_stream_deck(args, rng: np.random.Generator):
 
     D = args.duration
     scale = args.rate / 10.0
+    shape = _stream_seed_shape(args)
     counts = {
-        "publish": max(9, int(45 * scale)),
+        # Sharded streams publish fewer, heavier windows: each seed solve
+        # is a mesh solve and each commit maintains device residency, so
+        # the deck trades arrival count for per-window weight.
+        "publish": (max(6, int(18 * scale)) if args.sharded_lane
+                    else max(9, int(45 * scale))),
         "notify": 0,  # one poll rides along with every publish
         "hit": max(4, int(10 * scale)),
     }
@@ -342,7 +359,7 @@ def build_stream_deck(args, rng: np.random.Generator):
 
     n_streams = args.streams
     stream_seeds = [
-        gnm_random_graph(*STREAM_SHAPE, seed=args.seed + 6000 + s)
+        gnm_random_graph(*shape, seed=args.seed + 6000 + s)
         for s in range(n_streams)
     ]
     for i, t in enumerate(
@@ -365,6 +382,52 @@ def build_stream_deck(args, rng: np.random.Generator):
 
     schedule.sort(key=lambda a: a.at_s)
     return schedule, hit_pool, stream_seeds, counts
+
+
+def _stream_oracle_check(stream_root: str, streams) -> dict:
+    """Client-side durability audit, run AFTER the counter snapshots: for
+    every stream, rebuild the head from the on-disk snapshot + WAL alone
+    (the inheritor's exact recovery path, replayed in this process), then
+    solve the rebuilt graph fresh and require the maintained forest
+    edge-exact against that oracle. Proves the durable artifacts — not
+    just the live sessions — carry every stream through a crash."""
+    from distributed_ghs_implementation_tpu.api import (
+        minimum_spanning_forest,
+    )
+    from distributed_ghs_implementation_tpu.stream.log import UpdateLog
+    from distributed_ghs_implementation_tpu.stream.window import WindowedMST
+
+    out = {"streams": len(streams), "rebuilt": 0, "head_match": 0,
+           "edge_exact": 0}
+    for state in streams:
+        snap, entries, _notes = UpdateLog(stream_root, state.stream).load()
+        if snap is None:
+            continue
+        mst = WindowedMST.from_state(snap, window_mode="batched")
+        chain = snap["digest"]
+        intact = True
+        for entry in entries:
+            if entry["prev"] != chain:
+                intact = False
+                break
+            result, _info = mst.apply_window(entry["updates"])
+            chain = result.graph.digest()
+            if chain != entry["digest"]:
+                intact = False
+                break
+        if not intact:
+            continue
+        out["rebuilt"] += 1
+        if chain == state.digest:
+            out["head_match"] += 1
+        rebuilt = mst.result()
+        oracle = minimum_spanning_forest(rebuilt.graph, backend="device")
+        if np.array_equal(
+            np.sort(np.asarray(rebuilt.edge_ids)),
+            np.sort(np.asarray(oracle.edge_ids)),
+        ):
+            out["edge_exact"] += 1
+    return out
 
 
 # ----------------------------------------------------------------------
@@ -826,8 +889,9 @@ def _run_drill(args, resources: dict) -> dict:
             # (and the next edge bucket up, where inserts land) so the
             # first committed window pays no jit tracing.
             warmup_stream_buckets=(
-                f"{STREAM_SHAPE[0]}x{STREAM_SHAPE[1]},"
-                f"{STREAM_SHAPE[0]}x{2 * STREAM_SHAPE[1]}"
+                f"{_stream_seed_shape(args)[0]}x{_stream_seed_shape(args)[1]},"
+                f"{_stream_seed_shape(args)[0]}x"
+                f"{2 * _stream_seed_shape(args)[1]}"
                 if args.update_heavy else None
             ),
         )
@@ -861,8 +925,10 @@ def _run_drill(args, resources: dict) -> dict:
             warm_window_kernels,
         )
 
-        warm_window_kernels(STREAM_SHAPE[0], STREAM_SHAPE[1])
-        warm_window_kernels(STREAM_SHAPE[0], 2 * STREAM_SHAPE[1])
+        warm_window_kernels(*_stream_seed_shape(args))
+        warm_window_kernels(
+            _stream_seed_shape(args)[0], 2 * _stream_seed_shape(args)[1]
+        )
     for g in warm_graphs:
         service.handle(_graph_request(g, "warm"))
     stream_digests = []
@@ -1337,6 +1403,12 @@ def _run_drill(args, resources: dict) -> dict:
     if args.jsonl:
         write_events_jsonl(BUS, args.jsonl)
 
+    # Durable-artifact oracle audit (after the counter snapshots above,
+    # so its own apply/solve traffic cannot pollute the gated windows).
+    stream_oracle = None
+    if args.update_heavy and stream_tmp is not None:
+        stream_oracle = _stream_oracle_check(stream_tmp, streams)
+
     # "extra" records are the chase polls riding publish arrivals — they
     # count toward latency/error accounting but not toward the
     # one-record-per-scheduled-arrival invariant.
@@ -1406,6 +1478,24 @@ def _run_drill(args, resources: dict) -> dict:
             ("zero fresh solves while streams were live",
              fresh_solves == 0),
         ]
+        if stream_oracle is not None:
+            checks += [
+                ("durable log rebuilds every stream head "
+                 "(snapshot+WAL alone)",
+                 stream_oracle["rebuilt"] == len(streams)
+                 and stream_oracle["head_match"] == len(streams)),
+                ("post-replay heads edge-exact against a fresh oracle "
+                 "solve",
+                 stream_oracle["edge_exact"] == len(streams)),
+            ]
+        if args.sharded_lane:
+            checks.append(
+                ("published windows migrated mesh residency (donated "
+                 "scatter or bounded restage, never dropped)",
+                 (window_counters.get("stream.lane.migrated", 0)
+                  + window_counters.get("stream.lane.restaged", 0)) >= 1
+                 and window_counters.get("lane.update.dropped", 0) == 0),
+            )
         if fleet_router is not None and args.kill_worker is not None:
             checks += [
                 ("worker killed mid-stream",
@@ -1418,6 +1508,15 @@ def _run_drill(args, resources: dict) -> dict:
                  recovery is not None
                  and all(r["ok"] for r in recovery)),
             ]
+            if args.sharded_lane:
+                checks.append(
+                    ("sharded residency rebuilt on replay (re-staged and "
+                     "re-scattered, never unavailable)",
+                     window_counters.get(
+                         "stream.replay.residency_restored", 0) >= 1
+                     and window_counters.get(
+                         "stream.replay.residency_unavailable", 0) == 0),
+                )
             if not args.elastic:  # elastic pins pool convergence instead
                 checks.append(
                     ("fleet healed: full ring after the drill",
@@ -1568,9 +1667,11 @@ def _run_drill(args, resources: dict) -> dict:
 
     if args.update_heavy:
         if fleet_router is None:
-            workload = WORKLOAD_STREAM
+            workload = (WORKLOAD_STREAM_SHARDED if args.sharded_lane
+                        else WORKLOAD_STREAM)
         elif args.kill_worker is not None:
-            workload = WORKLOAD_STREAM_KILL
+            workload = (WORKLOAD_STREAM_SHARDED_KILL if args.sharded_lane
+                        else WORKLOAD_STREAM_KILL)
         elif args.elastic:
             workload = WORKLOAD_FLEET_ELASTIC
         else:
@@ -1606,6 +1707,9 @@ def _run_drill(args, resources: dict) -> dict:
         config["update_heavy"] = True
         config["streams"] = args.streams
         config["window_updates"] = STREAM_WINDOW_UPDATES
+        if args.sharded_lane:
+            config["sharded_lane"] = True
+            config["stream_shape"] = list(STREAM_SHARDED_SHAPE)
     if args.fleet:
         config["fleet"] = args.fleet
         config["kill_worker"] = args.kill_worker
@@ -1629,6 +1733,16 @@ def _run_drill(args, resources: dict) -> dict:
         extra_metrics["drain_errors"] = drain_errors
         extra_metrics["stream_resets"] = sum(s.resets for s in streams)
         extra_metrics["fresh_solves"] = fresh_solves
+        if stream_oracle is not None:
+            extra_metrics["oracle_exact"] = stream_oracle["edge_exact"]
+        if args.sharded_lane:
+            extra_metrics["residency_restored"] = window_counters.get(
+                "stream.replay.residency_restored", 0
+            )
+            extra_metrics["residency_migrated"] = (
+                window_counters.get("stream.lane.migrated", 0)
+                + window_counters.get("stream.lane.restaged", 0)
+            )
         if recovery:
             extra_metrics["replay_recovery_s"] = max(
                 r["recover_s"] for r in recovery
@@ -1721,6 +1835,7 @@ def _run_drill(args, resources: dict) -> dict:
             "fresh_solves": fresh_solves,
             "head_seqs": {s.stream: s.head_seq for s in streams},
             "recovery": recovery,
+            "oracle": stream_oracle,
         }
     if fleet_router is not None:
         report["fleet"] = {
