@@ -338,6 +338,43 @@ def _cmd_stats(args) -> int:
     return 0
 
 
+def _cmd_merge_trace(args) -> int:
+    """Join per-process span JSONL logs (router + workers, or any set of
+    ``write_events_jsonl`` exports) into one Perfetto trace with flow
+    arrows across process hops, plus the per-trace critical-path report
+    (docs/OBSERVABILITY.md has the walkthrough)."""
+    from distributed_ghs_implementation_tpu.obs.export import (
+        write_merged_trace,
+    )
+
+    report = write_merged_trace(args.inputs, args.out, args.report)
+    cp = report["critical_path"]["summary"]
+    print(
+        f"merged {len(report['processes'])} processes, "
+        f"{report['spans_indexed']} spans, "
+        f"{report['flow_arrows']} flow arrows",
+        file=sys.stderr,
+    )
+    print(
+        f"traces: {report['traces_total']} total, "
+        f"{report['traces_joined']} joined across processes, "
+        f"{report['orphan_spans']} orphan spans",
+        file=sys.stderr,
+    )
+    if cp.get("traces"):
+        print(
+            f"critical path over {cp['traces']} rooted traces: "
+            f"queue {cp['queue_s']:.3f}s, transport {cp['transport_s']:.3f}s, "
+            f"solve {cp['solve_s']:.3f}s, verify {cp['verify_s']:.3f}s "
+            f"(accounted >= {cp['accounted_frac_min']:.3f})",
+            file=sys.stderr,
+        )
+    print("open in https://ui.perfetto.dev or chrome://tracing",
+          file=sys.stderr)
+    print(args.out)
+    return 0
+
+
 def _cmd_serve(args) -> int:
     """The MST query service: JSONL requests on stdin (or --input), JSON
     responses on stdout (serve/service.py has the protocol). ``--fleet N``
@@ -678,6 +715,21 @@ def build_parser() -> argparse.ArgumentParser:
     _obs_graph_args(s)
     s.add_argument("--input", help="summarize this event JSONL instead of running")
     s.set_defaults(fn=_cmd_stats)
+
+    mt = sub.add_parser(
+        "merge-trace",
+        help="join per-process span JSONL logs (fleet router + workers) "
+        "into one Perfetto trace with cross-process flow arrows and a "
+        "per-request critical-path report (docs/OBSERVABILITY.md)",
+    )
+    mt.add_argument("inputs", nargs="+",
+                    help="event JSONL files exported by each process "
+                    "(e.g. a --trace-dir's router.jsonl + worker*.jsonl)")
+    mt.add_argument("--out", default="merged_trace.json",
+                    help="merged Chrome-trace JSON output path")
+    mt.add_argument("--report",
+                    help="also write the merge + critical-path report here")
+    mt.set_defaults(fn=_cmd_merge_trace)
 
     srv = sub.add_parser(
         "serve",

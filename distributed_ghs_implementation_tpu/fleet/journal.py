@@ -261,20 +261,27 @@ class RouterJournal:
         key: Optional[str],
         cls: Optional[str],
         lane: bool = False,
+        trace: Optional[dict] = None,
     ) -> int:
         """Durably record one accepted request; returns its journal id.
         The caller dispatches only after this returns — the accept ack is
-        gated on the durable append."""
+        gated on the durable append. ``trace`` is the request's wire trace
+        context (obs/tracing.py): it rides the accept record — and any
+        checkpoint that carries it forward — so a successor router's
+        orphan replay re-dispatches under the ORIGINAL trace_id."""
         with self._lock:
             jid = self.state.next_jid
-            self._append({
+            rec = {
                 "t": "accept",
                 "jid": jid,
                 "req": request,
                 "key": key,
                 "cls": cls,
                 "lane": bool(lane),
-            })
+            }
+            if trace is not None:
+                rec["trace"] = trace
+            self._append(rec)
         return jid
 
     def answer(

@@ -86,6 +86,7 @@ from distributed_ghs_implementation_tpu.fleet.transport import (
     connect_to_worker,
     new_conn_token,
 )
+from distributed_ghs_implementation_tpu.obs import tracing
 from distributed_ghs_implementation_tpu.obs.events import BUS
 from distributed_ghs_implementation_tpu.obs.slo import sanitize_class
 
@@ -246,7 +247,7 @@ class _Pending:
     """One accepted request: survives its worker by being re-dispatched."""
 
     __slots__ = ("request", "key", "cls", "event", "response", "worker_id",
-                 "requeues", "lane", "sent_at")
+                 "requeues", "lane", "sent_at", "trace")
 
     def __init__(
         self,
@@ -264,6 +265,11 @@ class _Pending:
         self.requeues = 0
         self.lane = lane  # prefers a mesh-owning worker (oversize solve)
         self.sent_at: Optional[float] = None  # hop-latency clock start
+        # Wire trace context (obs/tracing.py) captured at dispatch time —
+        # failover re-dispatch happens on the monitor thread, where the
+        # contextvar from handle() is gone; this is how the re-queued
+        # attempt keeps the original trace_id.
+        self.trace: Optional[dict] = None
 
 
 class _Worker:
@@ -535,21 +541,28 @@ class FleetRouter:
                 entry.get("req") or {}, entry.get("key"), entry.get("cls"),
                 lane=bool(entry.get("lane")),
             )
-            err = self._dispatch(p, allow_shed=False)
-            if err is not None:
-                self._journal_answer(entry.get("jid"), ok=False)
-                continue
-            if not p.event.wait(self.config.request_timeout_s):
-                self._forget(p)
-                self._journal_answer(entry.get("jid"), ok=False)
-                continue
-            resp = p.response or {}
-            self._journal_answer(
-                entry.get("jid"), ok=bool(resp.get("ok")),
-                worker=p.worker_id, digest=resp.get("digest"),
-            )
-            if resp.get("ok"):
-                BUS.count("fleet.router.restart.replayed")
+            p.trace = entry.get("trace")
+            # Replay re-dispatch continues the ORIGINAL trace: the accept
+            # record journaled the wire context, so the replayed hop shows
+            # up as a fresh child span under the crashed router's request.
+            with tracing.activated(tracing.from_wire(p.trace)), \
+                    BUS.span("fleet.replay.request", cat="fleet",
+                             op=(entry.get("req") or {}).get("op")):
+                err = self._dispatch(p, allow_shed=False)
+                if err is not None:
+                    self._journal_answer(entry.get("jid"), ok=False)
+                    continue
+                if not p.event.wait(self.config.request_timeout_s):
+                    self._forget(p)
+                    self._journal_answer(entry.get("jid"), ok=False)
+                    continue
+                resp = p.response or {}
+                self._journal_answer(
+                    entry.get("jid"), ok=bool(resp.get("ok")),
+                    worker=p.worker_id, digest=resp.get("digest"),
+                )
+                if resp.get("ok"):
+                    BUS.count("fleet.router.restart.replayed")
 
     def __enter__(self) -> "FleetRouter":
         return self.start()
@@ -1096,7 +1109,14 @@ class FleetRouter:
         for p in orphans:
             p.requeues += 1
             BUS.count("fleet.requeue")
-            err = self._dispatch(p, allow_shed=False)
+            # Failover continues the original trace: re-activate the wire
+            # context captured at first dispatch, so the requeue span (and
+            # the second worker's spans under it) keep the trace_id while
+            # parenting to the attempt that lost its worker.
+            with tracing.activated(tracing.from_wire(p.trace)), \
+                    BUS.span("fleet.requeue.dispatch", cat="fleet",
+                             requeues=p.requeues):
+                err = self._dispatch(p, allow_shed=False)
             if err is not None:
                 p.response = err
                 p.event.set()
@@ -1503,6 +1523,13 @@ class FleetRouter:
         accepted (a response will land on ``p.event``) or a terminal
         error/shed response dict."""
         cfg = self.config
+        # The trace context to put on the wire: the caller's active one
+        # (handle()'s attempt span), else the context ``p`` was first
+        # dispatched under — the monitor thread's failover re-dispatch
+        # path, where the contextvar is long gone.
+        wire = tracing.wire_context()
+        if wire is None:
+            wire = p.trace
         deadline = time.monotonic() + cfg.request_timeout_s
         while True:
             if self._closed:
@@ -1541,7 +1568,13 @@ class FleetRouter:
                         rid = self._next_id
                     w.pending[rid] = p
                     p.sent_at = time.monotonic()
-                    w.transport.send({"id": rid, "req": p.request})
+                    frame = {"id": rid, "req": p.request}
+                    if wire is not None and w.caps.get("trace"):
+                        # Gated on the hello capability: a legacy worker
+                        # without caps.trace gets the untraced frame shape
+                        # it has always parsed.
+                        frame["trace"] = wire
+                    w.transport.send(frame)
             except OSError:
                 if rid is not None:
                     with w.lock:
@@ -1639,15 +1672,17 @@ class FleetRouter:
             probe["edges_out"] = True
         if "backend" in request:
             probe["backend"] = request["backend"]
-        resp = self._request_worker(
-            ow, probe,
-            timeout_s=min(_FORWARD_PROBE_TIMEOUT_S,
-                          self.config.request_timeout_s),
-            # A saturated owner (no free admission slot) is a miss, not
-            # something to wait out: the probe must not queue behind slow
-            # solves or starve real requests of the owner's slots.
-            slot_timeout_s=_FORWARD_PROBE_SLOT_TIMEOUT_S,
-        )
+        with BUS.span("fleet.forward.probe", cat="fleet", owner=owner):
+            resp = self._request_worker(
+                ow, probe,
+                timeout_s=min(_FORWARD_PROBE_TIMEOUT_S,
+                              self.config.request_timeout_s),
+                # A saturated owner (no free admission slot) is a miss,
+                # not something to wait out: the probe must not queue
+                # behind slow solves or starve real requests of the
+                # owner's slots.
+                slot_timeout_s=_FORWARD_PROBE_SLOT_TIMEOUT_S,
+            )
         if resp and resp.get("ok"):
             if verifiable:
                 cert = self._certify_solve_response(request, resp)
@@ -1692,7 +1727,11 @@ class FleetRouter:
         span_args = {"op": str(op)}
         if cls is not None:
             span_args["cls"] = cls
-        with BUS.span("fleet.request", cat="fleet", **span_args) as span:
+        # The fleet front door: mint (or join) the request's trace context
+        # before the root span opens, so every span below — here and on
+        # whichever workers the request visits — shares one trace_id.
+        with tracing.front_door(cls), \
+                BUS.span("fleet.request", cat="fleet", **span_args) as span:
             BUS.count("fleet.requests")
             try:
                 key = self._routing_key(request)
@@ -1714,7 +1753,8 @@ class FleetRouter:
                 # without durability would be the round-12 router again.
                 try:
                     jid = self._journal.accept(
-                        request, key=key, cls=cls, lane=lane
+                        request, key=key, cls=cls, lane=lane,
+                        trace=tracing.wire_context(),
                     )
                 except (OSError, TimeoutError) as e:
                     BUS.count("fleet.errors")
@@ -1743,51 +1783,65 @@ class FleetRouter:
                     return forwarded
             for attempt in (0, 1):
                 p = _Pending(request, key, cls, lane=lane)
-                err = self._dispatch(p)
-                if err is not None:
-                    span.set(ok=False, shed=bool(err.get("shed")))
-                    if not err.get("shed"):
-                        BUS.count("fleet.errors")
-                    if cls is not None:
-                        err.setdefault("slo_class", cls)
-                    if not err.get("router_crashed"):
-                        # A crashed router never acknowledged failure —
-                        # those accepts stay unanswered so the restart
-                        # replays them.
+                # One attempt = dispatch + wait + certify, under its own
+                # span: the worker-side spans parent to THIS attempt (the
+                # wire context is captured inside it), so the merge can
+                # price the transport hop as attempt-duration minus the
+                # worker's in-span service time.
+                with BUS.span(
+                    "fleet.attempt", cat="fleet", attempt=attempt
+                ) as aspan:
+                    p.trace = tracing.wire_context()
+                    err = self._dispatch(p)
+                    if err is not None:
+                        span.set(ok=False, shed=bool(err.get("shed")))
+                        if not err.get("shed"):
+                            BUS.count("fleet.errors")
+                        if cls is not None:
+                            err.setdefault("slo_class", cls)
+                        if not err.get("router_crashed"):
+                            # A crashed router never acknowledged failure —
+                            # those accepts stay unanswered so the restart
+                            # replays them.
+                            self._journal_answer(jid, ok=False)
+                        return err
+                    if not p.event.wait(self.config.request_timeout_s):
+                        BUS.count("fleet.timeout")
+                        span.set(ok=False)
+                        self._forget(p)
                         self._journal_answer(jid, ok=False)
-                    return err
-                if not p.event.wait(self.config.request_timeout_s):
-                    BUS.count("fleet.timeout")
-                    span.set(ok=False)
-                    self._forget(p)
-                    self._journal_answer(jid, ok=False)
-                    return {"ok": False, "op": op,
-                            "error": "request timed out in the fleet"}
-                response = dict(p.response)
-                if (
-                    attempt == 0
-                    and self.config.verify_responses
-                    and response.get("ok")
-                ):
-                    # Round 19: certify verifiable solve responses before
-                    # they leave the router — the fleet.chaos.payload net.
-                    # ONE re-dispatch on failure: the worker's own copy is
-                    # good (in-flight corruption) or the worker's own
-                    # verification corrects it (cache corruption). The
-                    # replacement is re-certified below before it earns
-                    # the corrected counter — a second consecutive bad
-                    # answer is systemic and is refused, never served.
-                    cert = self._certify_solve_response(request, response)
-                    if cert is not None and not cert.ok:
-                        BUS.count("verify.failed")
-                        BUS.count("fleet.response.rejected")
-                        BUS.instant(
-                            "fleet.response.reject", cat="fleet",
-                            worker=p.worker_id, reason=cert.reason,
+                        return {"ok": False, "op": op,
+                                "error": "request timed out in the fleet"}
+                    response = dict(p.response)
+                    aspan.set(worker=p.worker_id)
+                    if (
+                        attempt == 0
+                        and self.config.verify_responses
+                        and response.get("ok")
+                    ):
+                        # Round 19: certify verifiable solve responses
+                        # before they leave the router — the
+                        # fleet.chaos.payload net. ONE re-dispatch on
+                        # failure: the worker's own copy is good
+                        # (in-flight corruption) or the worker's own
+                        # verification corrects it (cache corruption). The
+                        # replacement is re-certified below before it
+                        # earns the corrected counter — a second
+                        # consecutive bad answer is systemic and is
+                        # refused, never served.
+                        cert = self._certify_solve_response(
+                            request, response
                         )
-                        corrected = True
-                        continue
-                break
+                        if cert is not None and not cert.ok:
+                            BUS.count("verify.failed")
+                            BUS.count("fleet.response.rejected")
+                            BUS.instant(
+                                "fleet.response.reject", cat="fleet",
+                                worker=p.worker_id, reason=cert.reason,
+                            )
+                            corrected = True
+                            continue
+                    break
             if corrected and response.get("ok"):
                 # The replacement must EARN the corrected counter: when
                 # it is verifiable, re-certify it — a second consecutive
@@ -1857,7 +1911,11 @@ class FleetRouter:
                     rid = self._next_id
                 w.pending[rid] = p
                 p.sent_at = time.monotonic()
-                w.transport.send({"id": rid, "req": request})
+                frame = {"id": rid, "req": request}
+                wire = tracing.wire_context()
+                if wire is not None and w.caps.get("trace"):
+                    frame["trace"] = wire
+                w.transport.send(frame)
         except OSError:
             self._release_slot(w)
             return None

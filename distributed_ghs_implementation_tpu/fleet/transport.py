@@ -107,6 +107,14 @@ def build_hello(
     hello["caps"].setdefault(
         "crc", os.environ.get("GHS_FLEET_CRC", "1") != "0"
     )
+    # Trace-propagation capability: this build understands an optional
+    # ``trace`` field on request frames (obs/tracing.py) and will
+    # re-establish the router's trace context before dispatch. Same
+    # opt-in shape as CRC — a legacy worker without the cap just gets
+    # untraced frames (GHS_FLEET_TRACE=0 simulates one in drills).
+    hello["caps"].setdefault(
+        "trace", os.environ.get("GHS_FLEET_TRACE", "1") != "0"
+    )
     if warmed is not None:
         hello["caps"]["warmed"] = bool(warmed)
     if token is not None:

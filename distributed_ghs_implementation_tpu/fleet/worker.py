@@ -67,6 +67,7 @@ import sys
 import threading
 import time
 from concurrent.futures import ThreadPoolExecutor
+from typing import Optional
 
 from distributed_ghs_implementation_tpu.fleet.transport import (
     PipeTransport,
@@ -75,6 +76,8 @@ from distributed_ghs_implementation_tpu.fleet.transport import (
     build_hello,
     parse_hostport,
 )
+from distributed_ghs_implementation_tpu.obs import tracing
+from distributed_ghs_implementation_tpu.obs.events import BUS
 
 CRASH_SITE = "fleet.worker.crash"
 #: Armed with kind="slow", stalls the worker's next request INSIDE its
@@ -139,8 +142,12 @@ class EchoService:
             return {"ok": True, "op": "update", "digest": new,
                     "prev_digest": digest, "worker": self.worker_id}
         if op == "stats":
+            from distributed_ghs_implementation_tpu.obs.events import BUS
+
             return {"ok": True, "op": "stats",
                     "counters": {"echo.handled": self.handled},
+                    "events_dropped": BUS.dropped,
+                    "histograms_raw": BUS.histograms_export(),
                     "worker": self.worker_id}
         if op == "shutdown":
             return {"ok": True, "op": "shutdown"}
@@ -228,7 +235,9 @@ def _serve_connection(transport: Transport, service, pool) -> str:
     goes back to accept)."""
     from distributed_ghs_implementation_tpu.utils.resilience import FAULTS
 
-    def _serve_one(rid: int, request: dict) -> None:
+    def _serve_one(
+        rid: int, request: dict, trace: Optional[dict] = None
+    ) -> None:
         shot = FAULTS.pop(CRASH_SITE)
         if shot is not None and shot.remaining == 0:
             os._exit(CRASH_EXIT_CODE)  # a real crash: no response, no flush
@@ -239,8 +248,17 @@ def _serve_connection(transport: Transport, service, pool) -> str:
             # inline pongs keep flowing — busy, not dead.
             time.sleep(slow.value)
         t0 = time.perf_counter()
+        # Re-establish the router's trace context (when the frame carried
+        # one) so every span this worker records — serve.*, batch.*,
+        # stream.* — shares the router's trace_id; ``fleet.serve`` is the
+        # worker-side service-time span the merge subtracts from the
+        # router's attempt span to price the transport hop.
+        ctx = tracing.from_wire(trace)
         try:
-            response = service.handle(request)
+            with tracing.activated(ctx), BUS.span(
+                "fleet.serve", cat="fleet", op=request.get("op")
+            ):
+                response = service.handle(request)
         except Exception as e:  # noqa: BLE001 — the channel must survive
             response = {"ok": False, "error": f"{type(e).__name__}: {e}"}
         try:
@@ -272,7 +290,10 @@ def _serve_connection(transport: Transport, service, pool) -> str:
                 )
                 continue
             if "req" in frame:
-                pool.submit(_serve_one, frame["id"], frame["req"])
+                pool.submit(
+                    _serve_one, frame["id"], frame["req"],
+                    frame.get("trace"),
+                )
     except _DrainSignal:
         return "drain"
 
@@ -372,7 +393,9 @@ def run_worker(args) -> int:
             write_events_jsonl,
         )
 
-        write_events_jsonl(BUS, args.obs_jsonl)
+        write_events_jsonl(
+            BUS, args.obs_jsonl, label=f"worker{args.worker_id}"
+        )
     return 0
 
 

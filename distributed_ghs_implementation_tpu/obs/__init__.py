@@ -10,19 +10,28 @@
 See ``docs/OBSERVABILITY.md`` for the event taxonomy and workflows.
 """
 
+from distributed_ghs_implementation_tpu.obs import tracing  # noqa: F401
 from distributed_ghs_implementation_tpu.obs.events import (  # noqa: F401
     BUS,
     NULL_SPAN,
     EventBus,
     get_bus,
+    merge_hists,
 )
 from distributed_ghs_implementation_tpu.obs.export import (  # noqa: F401
+    merge_trace_files,
     read_events_jsonl,
     render_stats,
     snapshot_from_jsonl,
     to_chrome_trace,
     write_chrome_trace,
     write_events_jsonl,
+    write_merged_trace,
+)
+from distributed_ghs_implementation_tpu.obs.pulse import (  # noqa: F401
+    FleetPulse,
+    pulse_report,
+    write_prometheus,
 )
 from distributed_ghs_implementation_tpu.obs.slo import (  # noqa: F401
     ClassStats,

@@ -36,6 +36,8 @@ import threading
 import time
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
+from distributed_ghs_implementation_tpu.obs import tracing
+
 # Chrome-trace phase codes carried on every record (export stays a rename).
 PH_COMPLETE = "X"  # span with a duration
 PH_INSTANT = "I"  # point event
@@ -86,9 +88,16 @@ NULL_SPAN = _NullSpan()
 
 
 class _Span:
-    """Live span handle; records one ``PH_COMPLETE`` event on exit."""
+    """Live span handle; records one ``PH_COMPLETE`` event on exit.
 
-    __slots__ = ("_bus", "name", "cat", "args", "_t0")
+    When a :mod:`obs.tracing` context is active (and sampled), entering
+    the span stamps ``trace``/``span``/``parent`` ids into its args and
+    pushes itself as the context's parent, so spans opened inside —
+    including on other processes that re-establish the context from the
+    wire — chain to it. Untraced code pays one contextvar read.
+    """
+
+    __slots__ = ("_bus", "name", "cat", "args", "_t0", "_trace_token")
 
     def __init__(self, bus: "EventBus", name: str, cat: str, args: dict):
         self._bus = bus
@@ -96,6 +105,7 @@ class _Span:
         self.cat = cat
         self.args = args or None
         self._t0 = bus.now_ns()
+        self._trace_token = None
 
     def set(self, **args) -> "_Span":
         """Attach arguments discovered mid-span (e.g. a resolved strategy)."""
@@ -105,9 +115,22 @@ class _Span:
         return self
 
     def __enter__(self) -> "_Span":
+        ctx = tracing.current()
+        if ctx is not None and ctx.sampled:
+            span_id = tracing.new_span_id()
+            if self.args is None:
+                self.args = {}
+            self.args["trace"] = ctx.trace_id
+            self.args["span"] = span_id
+            if ctx.span_id is not None:
+                self.args["parent"] = ctx.span_id
+            self._trace_token = tracing.push_child(ctx, span_id)
         return self
 
     def __exit__(self, *exc) -> bool:
+        if self._trace_token is not None:
+            tracing.pop(self._trace_token)
+            self._trace_token = None
         bus = self._bus
         bus._append(
             (
@@ -177,6 +200,48 @@ class _Hist:
         }
 
 
+def merge_hists(raws: Sequence[dict]) -> _Hist:
+    """Deterministic merge of raw reservoir exports into one :class:`_Hist`.
+
+    ``raws`` are :meth:`EventBus.histograms_export` values for ONE metric
+    across N processes, in a caller-stabilized order (the pulse sorts by
+    worker id). Count/sum/min/max merge exactly; the merged reservoir is a
+    count-weighted with-replacement draw from the per-process reservoirs
+    under the same fixed seed every histogram uses — so two pulses over
+    identical worker exports summarize byte-for-byte identically, and a
+    big worker's tail outweighs a small one's in the merged p99.
+    """
+    merged = _Hist()
+    pools = [
+        r for r in raws
+        if r and int(r.get("count", 0)) > 0 and r.get("samples")
+    ]
+    if not pools:
+        return merged
+    merged.count = sum(int(r["count"]) for r in pools)
+    merged.total = float(sum(float(r.get("sum", 0.0)) for r in pools))
+    merged.vmin = min(float(r["min"]) for r in pools)
+    merged.vmax = max(float(r["max"]) for r in pools)
+    concat = [float(s) for r in pools for s in r["samples"]]
+    if len(concat) <= _HIST_SAMPLE_CAP:
+        merged.samples = concat
+        return merged
+    rng = random.Random(_HIST_SEED)
+    weights = [int(r["count"]) for r in pools]
+    total_weight = sum(weights)
+    for _ in range(_HIST_SAMPLE_CAP):
+        x = rng.randrange(total_weight)
+        for r, w in zip(pools, weights):
+            if x < w:
+                samples = r["samples"]
+                merged.samples.append(
+                    float(samples[rng.randrange(len(samples))])
+                )
+                break
+            x -= w
+    return merged
+
+
 class EventBus:
     """Fixed-memory structured telemetry sink (see module docstring).
 
@@ -217,6 +282,14 @@ class EventBus:
         """Nanoseconds since this bus's epoch (clear() resets it)."""
         return time.perf_counter_ns() - self._epoch_ns
 
+    def epoch_unix_ns(self) -> int:
+        """The bus epoch as a wall-clock unix timestamp (ns) — the anchor
+        the multi-file trace merge uses to align per-process monotonic
+        timelines onto one axis. Derived at call time (wall clock minus
+        elapsed monotonic), so it is stable to ~scheduler noise, which is
+        plenty for cross-process flow arrows."""
+        return time.time_ns() - (time.perf_counter_ns() - self._epoch_ns)
+
     # -- emission ------------------------------------------------------
     def _append(self, rec: EventTuple) -> None:
         with self._lock:
@@ -243,6 +316,13 @@ class EventBus:
         dur_ns = int(dur_s * 1e9)
         if ts_ns is None:
             ts_ns = self.now_ns() - dur_ns
+        ctx = tracing.current()
+        if ctx is not None and ctx.sampled:
+            args = dict(args)
+            args["trace"] = ctx.trace_id
+            args["span"] = tracing.new_span_id()
+            if ctx.span_id is not None:
+                args["parent"] = ctx.span_id
         self._append(
             (PH_COMPLETE, name, cat, ts_ns, dur_ns,
              threading.get_ident(), args or None)
@@ -318,6 +398,24 @@ class EventBus:
 
     def histograms(self) -> Dict[str, dict]:
         return {name: h.summary() for name, h in self._hists.items()}
+
+    def histograms_export(self) -> Dict[str, dict]:
+        """Raw reservoir export (count/sum/min/max/samples) — the shape a
+        worker ships in its ``stats`` reply so the router-side pulse can
+        re-merge fleet-wide percentiles via :func:`merge_hists` instead of
+        averaging per-worker p99s (which is statistically meaningless)."""
+        with self._lock:
+            return {
+                name: {
+                    "count": h.count,
+                    "sum": h.total,
+                    "min": h.vmin,
+                    "max": h.vmax,
+                    "samples": list(h.samples),
+                }
+                for name, h in self._hists.items()
+                if h.count
+            }
 
     def snapshot(self) -> dict:
         """Aggregated view: span stats by name, counter totals, histograms.

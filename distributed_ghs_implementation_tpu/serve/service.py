@@ -48,6 +48,7 @@ from distributed_ghs_implementation_tpu.batch.warmup import (
     warmable_single,
 )
 from distributed_ghs_implementation_tpu.graphs.edgelist import Graph
+from distributed_ghs_implementation_tpu.obs import tracing
 from distributed_ghs_implementation_tpu.obs.events import BUS
 from distributed_ghs_implementation_tpu.obs.slo import (
     sanitize_class,
@@ -247,7 +248,7 @@ class MSTService:
         span_args = {"op": str(op)}
         if cls is not None:
             span_args["cls"] = cls
-        with tagged_class(cls), BUS.span(
+        with tagged_class(cls), tracing.front_door(cls), BUS.span(
             "serve.request", cat="serve", **span_args
         ) as span:
             BUS.count("serve.requests")
@@ -576,6 +577,10 @@ class MSTService:
             # Ring-overflow visibility: a drill reading stats over the
             # pipes must know when span-derived numbers under-count.
             "events_dropped": BUS.dropped,
+            # Raw reservoirs (not summaries): the router-side pulse merges
+            # these across workers with obs.events.merge_hists — fleet
+            # percentiles need the samples, not per-worker p99s.
+            "histograms_raw": BUS.histograms_export(),
         }
         if self.verifier is not None:
             out["verify"] = self.verifier.policy.describe()

@@ -77,12 +77,19 @@ class ChainBreak(ValueError):
 def _wal_entry(rec: dict) -> dict:
     """One schema-checked WAL record -> the replay entry shape (raising
     marks the line unparsable, exactly like non-JSON bytes)."""
-    return {
+    entry = {
         "seq": int(rec["seq"]),
         "prev": rec["prev"],
         "digest": rec["digest"],
         "updates": rec["updates"],
     }
+    if "trace" in rec:
+        # The publisher's trace context (obs/tracing.py): replay re-runs
+        # the window under the ORIGINAL trace_id, so a recovery shows up
+        # in the merged fleet trace as a child of the publish that
+        # committed the window.
+        entry["trace"] = rec["trace"]
+    return entry
 
 
 def stream_dir(root: str, stream_id: str) -> str:
@@ -121,7 +128,13 @@ class UpdateLog:
 
     # -- writing -------------------------------------------------------
     def append(
-        self, *, seq: int, prev_digest: str, digest: str, updates: list
+        self,
+        *,
+        seq: int,
+        prev_digest: str,
+        digest: str,
+        updates: list,
+        trace: Optional[dict] = None,
     ) -> None:
         """Append one committed window (flushed + fsynced, flock-serialized).
 
@@ -150,15 +163,15 @@ class UpdateLog:
             # The core seals any torn tail before the write, so a crashed
             # predecessor cannot make this (durably committed) record
             # unparsable on replay.
-            self._wal.append(
-                {
-                    "seq": int(seq),
-                    "prev": prev_digest,
-                    "digest": digest,
-                    "updates": updates,
-                },
-                locked=True,
-            )
+            rec = {
+                "seq": int(seq),
+                "prev": prev_digest,
+                "digest": digest,
+                "updates": updates,
+            }
+            if trace is not None:
+                rec["trace"] = trace
+            self._wal.append(rec, locked=True)
 
     def snapshot(
         self,

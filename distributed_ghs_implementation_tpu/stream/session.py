@@ -59,6 +59,7 @@ from typing import Dict, List, Optional
 
 import numpy as np
 
+from distributed_ghs_implementation_tpu.obs import tracing
 from distributed_ghs_implementation_tpu.obs.events import BUS
 from distributed_ghs_implementation_tpu.obs.slo import current_class
 from distributed_ghs_implementation_tpu.stream.log import (
@@ -450,7 +451,10 @@ class StreamManager:
         if session is None:
             raise KeyError(f"unknown stream {stream_id!r}")
         gate = self._gate() if self._gate is not None else contextlib.nullcontext()
-        with session.lock, gate:
+        # The stream front door: a publish arriving through a traced
+        # serve/fleet request joins that trace; a direct publish (tests,
+        # embedded use) mints its own root.
+        with session.lock, gate, tracing.front_door(current_class()):
             if digest != session.head:
                 BUS.count("stream.publish.stale")
                 raise StaleDigest(session.id, session.head, session.seq)
@@ -474,6 +478,10 @@ class StreamManager:
                         BUS.count("stream.poisoned")
                     raise
                 span.set(mode=info.mode, net=info.applied)
+                # Captured INSIDE the window span: the WAL rides this
+                # window's span id, so a replay of the entry parents to
+                # the publish that committed it (same trace, new spans).
+                publish_trace = tracing.wire_context()
             new_digest = result.graph.digest()
             seq = session.seq + 1
             notification = _notification(seq, session.head, new_digest, info)
@@ -490,6 +498,7 @@ class StreamManager:
                         seq=seq, prev_digest=session.head, digest=new_digest,
                         updates=[u if isinstance(u, dict) else u.__dict__
                                  for u in updates],
+                        trace=publish_trace,
                     )
                 except ChainBreak as e:
                     # Another process sharing this stream root (a fleet
@@ -649,7 +658,18 @@ class StreamManager:
                     BUS.count("stream.replay.diverged")
                     diverged = True
                     break
-                result, info = mst.apply_window(entry["updates"])
+                # Replay continues the ORIGINAL publish's trace (the WAL
+                # entry journaled its wire context): the re-applied
+                # window is a fresh child span under the publish that
+                # committed it — same trace_id, across processes and
+                # restarts.
+                with tracing.activated(
+                    tracing.from_wire(entry.get("trace"))
+                ), BUS.span(
+                    "stream.replay.window", cat="stream",
+                    stream=stream_id, seq=entry["seq"],
+                ):
+                    result, info = mst.apply_window(entry["updates"])
                 new_digest = result.graph.digest()
                 if new_digest != entry["digest"]:
                     BUS.count("stream.replay.diverged")

@@ -297,9 +297,55 @@ def test_hello_round_trip_carries_proto_and_caps():
     assert checked["proto"] == PROTO_VERSION
     assert checked["worker"] == 3 and checked["token"] == "tok-1"
     # Round 19: every hello from this build additionally advertises the
-    # frame-checksum capability (the router version-gates CRC on it).
-    assert checked["caps"] == {"lane": True, "stream": False,
-                               "kernel": "xla", "crc": True}
+    # frame-checksum capability (the router version-gates CRC on it), and
+    # this round the trace capability (the router version-gates the
+    # request frames' trace field on it the same way) — asserted as a
+    # SUBSET, not an exact dict, so the next capability doesn't break
+    # this test the way trace broke its exact-match ancestor.
+    expected = {"lane": True, "stream": False, "kernel": "xla"}
+    assert {k: checked["caps"][k] for k in expected} == expected
+    assert checked["caps"]["crc"] is True
+    assert checked["caps"]["trace"] is True
+
+
+def test_hello_legacy_no_trace_cap_degrades_to_untraced_frames(monkeypatch):
+    """A worker that doesn't advertise ``caps.trace`` (an older build, or
+    this one with GHS_FLEET_TRACE=0) must degrade to untraced request
+    frames — same version-gating contract as the round-19 CRC opt-in —
+    never to a frame the legacy peer could reject."""
+    from distributed_ghs_implementation_tpu.obs import tracing
+
+    # The worker subprocesses inherit the router process environment, so
+    # the env var IS the legacy-worker simulator.
+    monkeypatch.setenv("GHS_FLEET_TRACE", "0")
+    hello = build_hello(0)
+    assert hello["caps"]["trace"] is False  # what a legacy peer "says"
+    cfg = FleetConfig(
+        workers=1, test_echo=True,
+        heartbeat_interval_s=0.1, ready_timeout_s=120.0,
+        request_timeout_s=30.0,
+    )
+    router = FleetRouter(cfg).start()
+    try:
+        assert router._workers[0].caps.get("trace") is False
+        # A traced front door is ACTIVE on the router side; the gate must
+        # still keep the wire clean and the request must still answer.
+        ctx = tracing.mint("interactive")
+        token = tracing.activate(ctx)
+        try:
+            resp = router.handle({"op": "solve", "digest": "legacy-probe"})
+        finally:
+            tracing.deactivate(token)
+        assert resp["ok"]
+        # The router-side request span is still traced (local telemetry
+        # does not degrade — only the wire field does).
+        spans = [
+            args for ph, name, _c, _t, _d, _tid, args in BUS.events()
+            if name == "fleet.request" and args
+        ]
+        assert any(a.get("trace") == ctx.trace_id for a in spans)
+    finally:
+        router.shutdown()
 
 
 def test_hello_version_mismatch_rejected_with_clear_error():
@@ -510,6 +556,46 @@ def test_fleet_kill_mid_traffic_requeues_and_restarts(echo_fleet):
             break
         time.sleep(0.05)
     assert resp["worker"] == victim
+
+
+def test_fleet_kill_requeue_preserves_trace_with_new_child_span(echo_fleet):
+    """Trace continuity across failover: when the owning worker dies
+    mid-request and the router re-queues onto a survivor, the re-dispatch
+    must stay in the ORIGINAL request's trace (same trace id) as a fresh
+    child span — one trace tells the whole failover story."""
+    from distributed_ghs_implementation_tpu.obs import tracing
+
+    r = echo_fleet
+    victim = r.handle({"op": "solve", "digest": "trace-kill"})["worker"]
+    assert r.arm_worker_fault(victim, times=1)
+    BUS.clear()
+    ctx = tracing.mint("interactive")
+    token = tracing.activate(ctx)
+    try:
+        resp = r.handle({"op": "solve", "digest": "trace-kill"})
+    finally:
+        tracing.deactivate(token)
+    assert resp["ok"] and resp.get("requeued", 0) >= 1
+    spans: dict = {}
+    for _ph, name, _cat, _ts, _dur, _tid, args in BUS.events():
+        if args and args.get("trace") == ctx.trace_id:
+            spans.setdefault(name, []).append(args)
+    (root,) = spans["fleet.request"]
+    attempts = spans["fleet.attempt"]
+    assert attempts and all(a["parent"] == root["span"] for a in attempts)
+    redispatches = spans["fleet.requeue.dispatch"]
+    assert redispatches, "failover re-dispatch must be a traced span"
+    for red in redispatches:
+        assert red["span"] != root["span"]  # a NEW span...
+        # ...parented inside the attempt whose worker died, so the tree
+        # reads request -> attempt -> requeue.dispatch.
+        assert red["parent"] in {a["span"] for a in attempts}
+    # Wait for the victim's restart so the module-scoped fleet is healthy
+    # for whoever runs next.
+    deadline = time.monotonic() + 30
+    while time.monotonic() < deadline and not r._workers[victim].alive:
+        time.sleep(0.05)
+    assert r._workers[victim].alive
 
 
 def test_fleet_same_digest_twice_lands_once_per_worker(echo_fleet):

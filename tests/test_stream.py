@@ -502,6 +502,49 @@ def test_replay_recovers_head_and_notifications_without_solving(
     BUS.clear()
 
 
+def test_replay_windows_carry_publish_trace_with_fresh_spans(tmp_path):
+    """Trace continuity across the WAL: each committed window persists its
+    publish-time trace context, so a crash-recovery replay re-applies it
+    under the ORIGINAL trace id — with fresh span ids parented on the
+    publish-time window span, so one trace shows both the live commit and
+    its later replay."""
+    from distributed_ghs_implementation_tpu.obs import tracing
+
+    BUS.enable()
+    root = str(tmp_path)
+    ctx = tracing.mint("update")
+    token = tracing.activate(ctx)
+    try:
+        # snapshot_every=10: only the seed snapshot lands, so recovery
+        # must WAL-replay every one of the 3 published windows.
+        _mgr, session, head = _drive_stream(
+            root, windows=3, snapshot_every=10
+        )
+    finally:
+        tracing.deactivate(token)
+    publish_spans = {
+        args["span"]
+        for _ph, name, _c, _t, _d, _tid, args in BUS.events()
+        if name == "stream.window" and args
+        and args.get("trace") == ctx.trace_id
+    }
+    assert len(publish_spans) == 3
+    BUS.clear()
+    fresh = StreamManager(root=root, snapshot_every=10)
+    recovered = fresh.recover(session.id)
+    assert recovered is not None and recovered.head == head
+    replays = [
+        args for _ph, name, _c, _t, _d, _tid, args in BUS.events()
+        if name == "stream.replay.window" and args
+    ]
+    assert len(replays) == 3
+    for args in replays:
+        assert args.get("trace") == ctx.trace_id  # the ORIGINAL trace
+        assert args["span"] not in publish_spans  # ...as a fresh span
+        assert args.get("parent") in publish_spans  # under its commit
+    BUS.clear()
+
+
 def test_subscribe_by_seed_digest_recovers_after_restart(tmp_path):
     """A restarted process that never solved the seed can still subscribe
     by the SEED digest: the stream id derives from it, so recovery finds

@@ -1,0 +1,481 @@
+"""Distributed tracing + fleet telemetry plane (obs/tracing.py,
+obs/pulse.py, the obs/export.py multi-process merge).
+
+The cross-process trace-continuity legs live with their subsystems
+(tests/test_fleet.py: failover re-queue and the caps.trace version gate;
+tests/test_stream.py: WAL-replay continuity). This file owns the tracing
+primitives, the merge/critical-path assembly, and the pulse plane.
+"""
+
+import json
+import os
+
+import pytest
+
+from distributed_ghs_implementation_tpu.obs import tracing
+from distributed_ghs_implementation_tpu.obs.events import (
+    BUS,
+    EventBus,
+    merge_hists,
+)
+from distributed_ghs_implementation_tpu.obs.export import (
+    merge_trace_files,
+    render_stats,
+    write_events_jsonl,
+    write_merged_trace,
+)
+from distributed_ghs_implementation_tpu.obs.pulse import (
+    FleetPulse,
+    parse_budgets,
+    pulse_report,
+    write_prometheus,
+)
+
+
+@pytest.fixture(autouse=True)
+def _clean_bus():
+    BUS.enable()
+    BUS.clear()
+    yield
+    BUS.enable()
+    BUS.clear()
+
+
+# ----------------------------------------------------------------------
+# Context primitives
+# ----------------------------------------------------------------------
+def test_mint_activate_and_child_context():
+    assert tracing.current() is None
+    ctx = tracing.mint("interactive")
+    assert len(ctx.trace_id) == 32  # 128-bit hex
+    assert ctx.span_id is None  # a root: its first span has no parent
+    assert ctx.slo_class == "interactive"
+    token = tracing.activate(ctx)
+    try:
+        assert tracing.current() is ctx
+        child = ctx.child("abc123")
+        assert child.trace_id == ctx.trace_id
+        assert child.span_id == "abc123"
+        assert child.slo_class == "interactive"
+    finally:
+        tracing.deactivate(token)
+    assert tracing.current() is None
+
+
+def test_front_door_mints_once_and_reuses_active_context():
+    with tracing.front_door("bulk"):
+        outer = tracing.current()
+        assert outer is not None and outer.slo_class == "bulk"
+        # A nested front door (router handle below serve_loop, say) must
+        # JOIN the active trace, not start a second one.
+        with tracing.front_door("other"):
+            assert tracing.current().trace_id == outer.trace_id
+    assert tracing.current() is None
+
+
+def test_head_sampling_is_deterministic_and_seeded(monkeypatch):
+    ids = [tracing.new_trace_id() for _ in range(200)]
+    monkeypatch.setenv("GHS_TRACE_SAMPLE", "0.5")
+    monkeypatch.setenv("GHS_TRACE_SEED", "7")
+    first = [tracing.head_sampled(t) for t in ids]
+    assert first == [tracing.head_sampled(t) for t in ids]  # deterministic
+    assert 40 < sum(first) < 160  # actually samples, not all/none
+    monkeypatch.setenv("GHS_TRACE_SEED", "8")
+    assert first != [tracing.head_sampled(t) for t in ids]  # seed matters
+    monkeypatch.setenv("GHS_TRACE_SAMPLE", "1.0")
+    assert all(tracing.head_sampled(t) for t in ids)
+    monkeypatch.setenv("GHS_TRACE_SAMPLE", "0")
+    assert not any(tracing.head_sampled(t) for t in ids)
+
+
+def test_wire_context_round_trip_and_garbage_tolerance():
+    assert tracing.wire_context() is None  # no active context
+    ctx = tracing.mint("interactive")
+    token = tracing.activate(ctx)
+    try:
+        wire = tracing.wire_context()
+    finally:
+        tracing.deactivate(token)
+    assert wire["trace"] == ctx.trace_id and wire["cls"] == "interactive"
+    back = tracing.from_wire(wire)
+    assert back.trace_id == ctx.trace_id and back.slo_class == "interactive"
+    # from_wire is a trust boundary: garbage degrades to None, never
+    # raises into the read loop that called it.
+    for junk in (None, {}, [], "x", 7, {"trace": 9}, {"trace": ""},
+                 {"sampled": True}):
+        assert tracing.from_wire(junk) is None
+
+
+# ----------------------------------------------------------------------
+# Span stamping (EventBus integration)
+# ----------------------------------------------------------------------
+def test_spans_stamp_trace_and_nest_parents():
+    bus = EventBus(enabled=True)
+    ctx = tracing.mint("interactive")
+    token = tracing.activate(ctx)
+    try:
+        with bus.span("a", cat="t"):
+            with bus.span("b", cat="t"):
+                pass
+    finally:
+        tracing.deactivate(token)
+    by_name = {
+        name: args for _ph, name, _c, _t, _d, _tid, args in bus.events()
+    }
+    assert by_name["a"]["trace"] == ctx.trace_id
+    assert "parent" not in by_name["a"]  # the root span
+    assert by_name["b"]["trace"] == ctx.trace_id
+    assert by_name["b"]["parent"] == by_name["a"]["span"]
+    assert by_name["a"]["span"] != by_name["b"]["span"]
+
+
+def test_spans_untraced_without_context_and_when_unsampled(monkeypatch):
+    bus = EventBus(enabled=True)
+    with bus.span("plain", cat="t"):
+        pass
+    (args,) = [a or {} for _p, n, _c, _t, _d, _ti, a in bus.events()
+               if n == "plain"]
+    assert "trace" not in args and "span" not in args
+    # An unsampled trace stays context-active (the class tag, the wire
+    # decision) but stamps nothing.
+    monkeypatch.setenv("GHS_TRACE_SAMPLE", "0")
+    ctx = tracing.mint("bulk")
+    assert ctx.sampled is False
+    token = tracing.activate(ctx)
+    try:
+        with bus.span("dark", cat="t"):
+            pass
+        assert tracing.wire_context() is None
+    finally:
+        tracing.deactivate(token)
+    (args,) = [a or {} for _p, n, _c, _t, _d, _ti, a in bus.events()
+               if n == "dark"]
+    assert "trace" not in args
+
+
+# ----------------------------------------------------------------------
+# Multi-process merge + critical path (obs/export.py)
+# ----------------------------------------------------------------------
+def _two_process_trace(tmp_path):
+    """One request traced across a synthetic router + worker 'process'
+    pair (two buses, two JSONL exports)."""
+    import time
+
+    router_bus = EventBus(enabled=True)
+    worker_bus = EventBus(enabled=True)
+    ctx = tracing.mint("interactive")
+    token = tracing.activate(ctx)
+    try:
+        with router_bus.span("fleet.request", cat="fleet", op="solve"):
+            with router_bus.span("fleet.attempt", cat="fleet", attempt=1):
+                wire = tracing.wire_context()
+                # "the worker": re-establish context from the wire
+                wtoken = tracing.activate(tracing.from_wire(wire))
+                try:
+                    with worker_bus.span("fleet.serve", cat="fleet"):
+                        with worker_bus.span("serve.solve", cat="serve"):
+                            time.sleep(0.002)
+                finally:
+                    tracing.deactivate(wtoken)
+                time.sleep(0.001)
+    finally:
+        tracing.deactivate(token)
+    rp = str(tmp_path / "router.jsonl")
+    wp = str(tmp_path / "worker0.jsonl")
+    write_events_jsonl(router_bus, rp, label="router")
+    write_events_jsonl(worker_bus, wp, label="worker0")
+    return ctx, [rp, wp]
+
+
+def test_merge_joins_processes_with_flow_arrows_and_no_orphans(tmp_path):
+    _ctx, paths = _two_process_trace(tmp_path)
+    trace, report = merge_trace_files(paths)
+    assert report["schema"] == "ghs-trace-merge-v1"
+    assert len(report["processes"]) == 2
+    assert report["traces_total"] == 1
+    assert report["traces_joined"] == 1  # spans from BOTH processes
+    assert report["orphan_spans"] == 0
+    assert report["flow_arrows"] >= 1
+    # Distinct pids even though both buses ran in THIS process (the
+    # dedup fallback), each with a process_name metadata event.
+    pids = {e["pid"] for e in trace["traceEvents"] if "pid" in e}
+    assert len(pids) == 2
+    names = {
+        e["args"]["name"] for e in trace["traceEvents"]
+        if e.get("ph") == "M" and e.get("name") == "process_name"
+    }
+    assert names == {"router", "worker0"}
+    # Flow arrows pair: a start at the parent, a finish at the child.
+    phases = [e["ph"] for e in trace["traceEvents"] if e.get("cat") == "trace"]
+    assert phases.count("s") == phases.count("f") >= 1
+
+
+def test_merge_critical_path_accounts_request_wall_time(tmp_path):
+    _ctx, paths = _two_process_trace(tmp_path)
+    _trace, report = merge_trace_files(paths)
+    summary = report["critical_path"]["summary"]
+    assert summary["traces"] == 1
+    assert summary["accounted_frac_min"] >= 0.9  # the acceptance gate
+    (per,) = report["critical_path"]["per_trace"]
+    total = per["total_s"]
+    parts = (per["queue_s"] + per["probe_s"] + per["transport_s"]
+             + per["solve_s"] + per["verify_s"] + per["service_other_s"]
+             + per["residual_s"])
+    assert parts == pytest.approx(total)  # the decomposition telescopes
+    assert per["solve_s"] > 0  # serve.solve classified as solve time
+
+
+def test_merge_counts_worker_only_fragments_as_unrooted_not_orphans(
+    tmp_path,
+):
+    """A worker fragment whose router spans were cleared (the drill's
+    warm phase) must NOT read as a broken trace: it has no root, so it is
+    unrooted — orphan_spans counts dangling parents inside ROOTED traces
+    only."""
+    ctx, paths = _two_process_trace(tmp_path)
+    warm_bus = EventBus(enabled=True)
+    # A wire context whose parent span lived in the since-cleared router
+    # bus: the worker's span has a DANGLING parent and its trace no root.
+    warm = tracing.from_wire({
+        "trace": tracing.new_trace_id(), "sampled": True,
+        "span": "deadbeef00000001", "cls": "warm",
+    })
+    token = tracing.activate(warm)
+    try:
+        with warm_bus.span("fleet.serve", cat="fleet"):
+            pass
+    finally:
+        tracing.deactivate(token)
+    frag = str(tmp_path / "worker1.jsonl")
+    write_events_jsonl(warm_bus, frag, label="worker1")
+    _trace, report = merge_trace_files(paths + [frag])
+    assert report["traces_total"] == 2
+    assert report["traces_rooted"] == 1
+    assert report["traces_unrooted"] == 1
+    assert report["orphan_spans"] == 0
+    assert report["traces_joined"] == 1
+
+
+def test_write_merged_trace_emits_both_artifacts(tmp_path):
+    _ctx, paths = _two_process_trace(tmp_path)
+    out = str(tmp_path / "merged.json")
+    rep_path = str(tmp_path / "cp.json")
+    report = write_merged_trace(paths, out, rep_path)
+    assert json.load(open(out))["traceEvents"]
+    assert json.load(open(rep_path))["orphan_spans"] == 0
+    assert report["traces_joined"] == 1
+
+
+# ----------------------------------------------------------------------
+# Reservoir merge (obs/events.py)
+# ----------------------------------------------------------------------
+def test_merge_hists_exact_moments_and_determinism():
+    a, b = EventBus(enabled=True), EventBus(enabled=True)
+    for i in range(100):
+        a.record("lat_s", i * 0.001)
+    for i in range(50):
+        b.record("lat_s", 1.0 + i * 0.001)
+    raws = [a.histograms_export()["lat_s"], b.histograms_export()["lat_s"]]
+    merged = merge_hists(raws)
+    assert merged.count == 150
+    assert merged.total == pytest.approx(
+        sum(i * 0.001 for i in range(100))
+        + sum(1.0 + i * 0.001 for i in range(50))
+    )
+    assert merged.vmin == 0.0 and merged.vmax == pytest.approx(1.049)
+    # Deterministic: same inputs, byte-identical summary.
+    assert merged.summary() == merge_hists(raws).summary()
+    # Under the cap, the merge is exact concatenation.
+    assert sorted(merged.samples) == sorted(
+        raws[0]["samples"] + raws[1]["samples"]
+    )
+
+
+def test_merge_hists_over_cap_weights_by_count():
+    big, small = EventBus(enabled=True), EventBus(enabled=True)
+    for i in range(2000):
+        big.record("x", 10.0)
+    for i in range(100):
+        small.record("x", 1.0)
+    merged = merge_hists(
+        [big.histograms_export()["x"], small.histograms_export()["x"]]
+    )
+    assert merged.count == 2100
+    share = sum(1 for s in merged.samples if s == 10.0) / len(merged.samples)
+    assert share > 0.8  # the big worker dominates the merged reservoir
+
+
+# ----------------------------------------------------------------------
+# Pulse (obs/pulse.py)
+# ----------------------------------------------------------------------
+def _canned_stats():
+    wbus = EventBus(enabled=True)
+    wbus.record("echo.latency_s", 0.001)
+    wbus.record("echo.latency_s", 0.003)
+    return {
+        "ok": True,
+        "fleet": {"fleet.requests": 9},
+        "pool": {"workers": 3},
+        "workers": {
+            0: {"alive": True, "pending": 0, "stats": {
+                "counters": {"echo.handled": 3},
+                "events_dropped": 0,
+                "histograms_raw": wbus.histograms_export()}},
+            1: {"alive": True, "pending": 1, "stats": {
+                "counters": {"echo.handled": 4, "other": 2},
+                "events_dropped": 5,
+                "histograms_raw": {}}},
+            2: {"alive": True, "pending": 0, "stats": {
+                "counters": {"echo.handled": 5},
+                "events_dropped": 0,
+                "histograms_raw": {}}},
+        },
+    }
+
+
+def test_pulse_report_totals_are_exact_per_worker_sums():
+    report = pulse_report(_canned_stats())
+    assert report["schema"] == "ghs-fleet-pulse-v1"
+    assert report["workers_scraped"] == 3
+    # THE invariant: totals == the exact sum of the per-worker counters
+    # the report itself carries (CI re-asserts this on a live fleet).
+    for name, total in report["counters"].items():
+        assert total == sum(
+            (w.get("counters") or {}).get(name, 0)
+            for w in report["workers"].values()
+        )
+    assert report["counters"]["echo.handled"] == 12
+    assert report["workers"]["1"]["events_dropped"] == 5
+    assert report["histograms"]["echo.latency_s"]["count"] == 2
+    assert report["router"]["counters"]["fleet.requests"] == 9
+
+
+def test_pulse_scrape_writes_artifacts_and_prometheus(tmp_path):
+    stats = _canned_stats()
+
+    class StubRouter:
+        def handle(self, request):
+            assert request == {"op": "stats"}
+            return stats
+
+    pulse = FleetPulse(
+        StubRouter(), interval_s=999.0, out_dir=str(tmp_path),
+        budgets={"default": 1.0},
+    )
+    report = pulse.scrape_once()
+    assert pulse.scrapes == 1 and pulse.last_report is report
+    on_disk = json.load(open(tmp_path / "pulse.json"))
+    assert on_disk["counters"]["echo.handled"] == 12
+    prom = open(tmp_path / "pulse.prom").read()
+    assert "ghs_echo_handled 12.0" in prom  # the exact total line
+    assert "ghs_other 2.0" in prom  # no cross-metric bleed into totals
+    assert 'ghs_echo_handled{worker="1"} 4.0' in prom
+    assert 'ghs_worker_events_dropped{worker="1"} 5' in prom
+    assert 'ghs_echo_latency_s{quantile="0.99"}' in prom
+
+
+def test_pulse_slow_request_exemplar_captures_full_span_tree(tmp_path):
+    class StubRouter:
+        def handle(self, request):
+            return {"workers": {}}
+
+    ctx = tracing.mint("interactive")
+    token = tracing.activate(ctx)
+    try:
+        with BUS.span("fleet.request", cat="fleet", cls="interactive"):
+            with BUS.span("fleet.attempt", cat="fleet", attempt=1):
+                import time
+
+                time.sleep(0.005)
+    finally:
+        tracing.deactivate(token)
+    pulse = FleetPulse(
+        StubRouter(), interval_s=999.0, out_dir=str(tmp_path),
+        budgets={"interactive": 0.001},  # the 5ms sleep breaches it
+    )
+    pulse.scrape_once()
+    lines = open(tmp_path / "exemplars.jsonl").read().splitlines()
+    (exemplar,) = [json.loads(line) for line in lines]
+    assert exemplar["schema"] == "ghs-slow-exemplar-v1"
+    assert exemplar["trace"] == ctx.trace_id
+    assert exemplar["cls"] == "interactive"
+    assert exemplar["dur_s"] > exemplar["budget_s"]
+    names = {s["name"] for s in exemplar["spans"]}
+    assert names == {"fleet.request", "fleet.attempt"}  # the WHOLE tree
+
+
+def test_parse_budgets_spec_and_errors():
+    assert parse_budgets("interactive=0.05, bulk=2,default=1") == {
+        "interactive": 0.05, "bulk": 2.0, "default": 1.0,
+    }
+    assert parse_budgets("") == {}
+    with pytest.raises(ValueError, match="CLASS=SECONDS"):
+        parse_budgets("interactive=fast")
+
+
+def test_write_prometheus_zero_count_histograms_skipped(tmp_path):
+    report = pulse_report({"workers": {}})
+    report["histograms"] = {"empty": {"count": 0}}
+    path = str(tmp_path / "p.prom")
+    write_prometheus(report, path)
+    assert "empty" not in open(path).read()
+
+
+# ----------------------------------------------------------------------
+# render_stats drop flag (satellite)
+# ----------------------------------------------------------------------
+def test_render_stats_flags_workers_with_dropped_events():
+    with BUS.span("x", cat="t"):
+        pass
+    snapshot = BUS.snapshot()
+    snapshot["workers"] = {
+        "0": {"stats": {"events_dropped": 0}},
+        "1": {"stats": {"events_dropped": 41}},
+    }
+    text = render_stats(snapshot)
+    assert "worker 1 dropped 41 events" in text
+    assert "worker 0 dropped" not in text
+
+
+# ----------------------------------------------------------------------
+# Live end-to-end: in-process echo fleet, traced request, pulse audit
+# ----------------------------------------------------------------------
+def test_echo_fleet_traced_request_joins_worker_process(tmp_path):
+    from distributed_ghs_implementation_tpu.fleet.router import (
+        FleetConfig,
+        FleetRouter,
+    )
+
+    obs_dir = str(tmp_path / "obs")
+    router = FleetRouter(FleetConfig(
+        workers=2, test_echo=True, heartbeat_interval_s=0.1,
+        ready_timeout_s=120.0, request_timeout_s=30.0, obs_dir=obs_dir,
+    )).start()
+    try:
+        for i in range(6):
+            assert router.handle({"op": "solve", "digest": f"t{i}"})["ok"]
+        # Live pulse against the real fleet: totals must equal the
+        # per-worker sums it reports.
+        report = FleetPulse(router, interval_s=999.0).scrape_once()
+        assert report["workers_scraped"] == 2
+        handled = report["counters"]["echo.handled"]
+        assert handled == sum(
+            (w.get("counters") or {}).get("echo.handled", 0)
+            for w in report["workers"].values()
+        )
+        assert handled >= 6
+    finally:
+        router.shutdown()
+    # The drained workers exported JSONL; merged with the router's bus,
+    # every request must join across processes with zero orphans.
+    router_jsonl = str(tmp_path / "router.jsonl")
+    write_events_jsonl(BUS, router_jsonl, label="router")
+    paths = [router_jsonl] + sorted(
+        os.path.join(obs_dir, f) for f in os.listdir(obs_dir)
+        if f.endswith(".jsonl")
+    )
+    _trace, report = merge_trace_files(paths)
+    assert len(report["processes"]) == 3
+    assert report["orphan_spans"] == 0
+    assert report["traces_joined"] >= 6
+    assert report["critical_path"]["summary"]["accounted_frac_min"] >= 0.9

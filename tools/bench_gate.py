@@ -173,6 +173,16 @@ KINDS = {
     "audit_failed": "exact",
     "mutation_rejected": "exact",
     "verify_failed_clean": "exact",
+    # gate-trace-v1 (tools/load_drill.py --trace-dir): the trace-join
+    # contract is exact — every rooted trace in the merged multi-process
+    # trace must resolve each of its spans to a parent (orphan_spans is a
+    # zero-baseline exact), and the number of requests whose trace joins
+    # spans from >= 2 processes is deterministic for the seeded echo deck
+    # (every accepted request dispatches or probes to a worker). A changed
+    # count means context propagation broke on some path — a dropped wire
+    # field, a worker not re-establishing context — never jitter.
+    "orphan_spans": "exact",
+    "traces_joined": "exact",
     # gate-kernel-v1 (tools/profile_levels.py --compare-kernels and
     # bench.py --kernel): the fused-Pallas vs XLA level-kernel ratio is a
     # wall-clock pair — gate as a throughput floor. On hosts where Pallas

@@ -783,8 +783,10 @@ def run_drill(args) -> dict:
     import shutil
 
     resources: dict = {}
+    report = None
     try:
-        return _run_drill(args, resources)
+        report = _run_drill(args, resources)
+        return report
     finally:
         autoscaler = resources.get("autoscaler")
         if autoscaler is not None:
@@ -797,10 +799,56 @@ def run_drill(args) -> dict:
                 proc.wait(timeout=10)  # shutdown drained it: exit 0
             except Exception:  # noqa: BLE001 — teardown must not raise
                 proc.kill()
+        # Trace assembly has to wait until here: the drain above is what
+        # flushes every worker's JSONL into --trace-dir, and the router's
+        # own export should include the retire/drain spans too.
+        if report is not None and getattr(args, "trace_dir", None):
+            _merge_trace_artifacts(args, report)
         for key in ("disk_tmp", "stream_tmp", "journal_tmp"):
             tmp = resources.get(key)
             if tmp:
                 shutil.rmtree(tmp, ignore_errors=True)
+
+
+def _merge_trace_artifacts(args, report: dict) -> None:
+    """Post-drain assembly for ``--trace-dir``: export the router's bus
+    beside the workers' JSONL dumps, merge them into one Perfetto trace +
+    critical-path report, and promote the join-quality numbers
+    (``orphan_spans``, ``traces_joined``) into the gated metrics."""
+    import glob
+
+    from distributed_ghs_implementation_tpu.obs.events import BUS
+    from distributed_ghs_implementation_tpu.obs.export import (
+        write_events_jsonl,
+        write_merged_trace,
+    )
+
+    write_events_jsonl(
+        BUS, os.path.join(args.trace_dir, "router.jsonl"), label="router"
+    )
+    paths = sorted(
+        p for p in glob.glob(os.path.join(args.trace_dir, "*.jsonl"))
+        if os.path.basename(p) != "exemplars.jsonl"
+    )
+    merged = write_merged_trace(
+        paths,
+        os.path.join(args.trace_dir, "merged_trace.json"),
+        os.path.join(args.trace_dir, "critical_path.json"),
+    )
+    report["trace"] = {
+        "dir": args.trace_dir,
+        "inputs": [os.path.basename(p) for p in paths],
+        "processes": len(merged["processes"]),
+        "traces_total": merged["traces_total"],
+        "traces_rooted": merged["traces_rooted"],
+        "traces_joined": merged["traces_joined"],
+        "orphan_spans": merged["orphan_spans"],
+        "critical_path": merged["critical_path"]["summary"],
+    }
+    gate = report.get("gate_metrics")
+    if isinstance(gate, dict) and isinstance(gate.get("metrics"), dict):
+        gate["metrics"]["orphan_spans"] = merged["orphan_spans"]
+        gate["metrics"]["traces_joined"] = merged["traces_joined"]
 
 
 def _run_drill(args, resources: dict) -> dict:
@@ -812,6 +860,12 @@ def _run_drill(args, resources: dict) -> dict:
     from distributed_ghs_implementation_tpu.utils.resilience import FAULTS
 
     BUS.enable()
+    if args.trace_dir:
+        os.makedirs(args.trace_dir, exist_ok=True)
+        if not args.obs_dir:
+            # The per-worker obs JSONL exports double as the trace-merge
+            # inputs, so an unset --obs-dir lands them in the trace dir.
+            args.obs_dir = args.trace_dir
     rng = np.random.default_rng(args.seed)
     deck = build_stream_deck if args.update_heavy else build_deck
     schedule, warm_graphs, stream_seeds, counts = deck(args, rng)
@@ -1860,6 +1914,22 @@ def _run_drill(args, resources: dict) -> dict:
                 "scale_down_events": scale_downs,
                 "join_warm_s": join_hist,
             }
+        if args.trace_dir:
+            # One pulse scrape while every worker is still alive: the
+            # merged counters/histograms + Prometheus exposition land as
+            # drill artifacts (pulse.json / pulse.prom), and its totals
+            # are auditable against the per-worker payloads it carries.
+            from distributed_ghs_implementation_tpu.obs.pulse import (
+                FleetPulse,
+            )
+
+            pulse = FleetPulse(fleet_router, out_dir=args.trace_dir)
+            scraped = pulse.scrape_once()
+            report["pulse"] = {
+                "workers_scraped": scraped["workers_scraped"],
+                "counters": scraped["counters"],
+                "artifacts": ["pulse.json", "pulse.prom"],
+            }
         # run_drill's finally drains the fleet: workers flush in-flight
         # responses + export their per-worker obs JSONL (--obs-dir).
     return report
@@ -2334,6 +2404,14 @@ def main(argv=None) -> int:
                    "(off|sample[:N]|full or per-class — "
                    "docs/VERIFICATION.md); the corrupt drill defaults "
                    "to 'full'")
+    p.add_argument("--trace-dir",
+                   help="with --fleet: distributed-tracing artifact dir — "
+                   "per-process JSONL span logs (workers on drain, router "
+                   "post-shutdown), one merged Perfetto trace "
+                   "(merged_trace.json) + critical-path report "
+                   "(critical_path.json), and a fleet pulse scrape "
+                   "(pulse.json / pulse.prom); orphan_spans and "
+                   "traces_joined join the gated metrics")
     p.add_argument("--obs-dir",
                    help="with --fleet: per-worker obs JSONL exports land "
                    "here on drain (worker<K>.<incarnation>.jsonl)")
@@ -2355,6 +2433,9 @@ def main(argv=None) -> int:
         not args.fleet or not 0 <= args.kill_worker < args.fleet
     ):
         p.error("--kill-worker needs --fleet N with 0 <= K < N")
+    if args.trace_dir and not args.fleet:
+        p.error("--trace-dir needs --fleet N (it assembles a multi-process "
+                "trace; single-process runs have --jsonl)")
     if args.elastic and not args.fleet:
         p.error("--elastic needs --fleet N (it drives the fleet's pool)")
     if args.elastic and not args.obs_dir:
