@@ -763,6 +763,115 @@ def run_verify_bench(args) -> int:
     return 0 if failed_clean == 0 else 1
 
 
+def run_kinds_bench(args) -> int:
+    """Per-kind analytics latency (``gate-analytics-bench-v1``): what each
+    query kind of the analytics front door (``docs/ANALYTICS.md``) costs
+    through the full service path, cold and warm.
+
+    Per kind, against a FRESH service (so no cross-kind cache sharing
+    flatters the cold number):
+
+    * **<kind>_solve_p50_s** — the miss path: the kind's own solve
+      (``components`` solves the index-weighted twin; ``k_msf`` /
+      ``bottleneck`` / ``path_max`` solve the MSF then reduce).
+    * **<kind>_hit_p50_s** — the warm repeat: the per-kind cache entry, or
+      the O(tree) host derivation off the shared MSF entry.
+
+    ``mst_weight`` gates EXACT as everywhere; any non-ok or wrong-weight
+    response fails the run (``wrong_results``).
+    """
+    from distributed_ghs_implementation_tpu.graphs.generators import (
+        gnm_random_graph,
+    )
+    from distributed_ghs_implementation_tpu.obs.events import BUS, quantile
+    from distributed_ghs_implementation_tpu.serve.service import MSTService
+    from distributed_ghs_implementation_tpu.utils.verify import (
+        networkx_mst_weight,
+    )
+
+    kinds = ("mst", "components", "k_msf", "bottleneck", "path_max")
+    k_forest = 3
+    BUS.enable()
+    pool = [
+        gnm_random_graph(args.batch_nodes, args.batch_edges, seed=70 + i)
+        for i in range(8)
+    ]
+    oracle_weight = int(sum(networkx_mst_weight(g) for g in pool))
+
+    def kind_request(g, kind: str) -> dict:
+        req = {
+            "op": "solve",
+            "num_nodes": g.num_nodes,
+            "edges": [
+                [int(a), int(b), int(c)] for a, b, c in zip(g.u, g.v, g.w)
+            ],
+        }
+        if kind != "mst":
+            req["kind"] = kind
+        if kind == "components":
+            req["labels_out"] = True
+        elif kind == "k_msf":
+            req["k"] = k_forest
+        elif kind == "path_max":
+            req["u"], req["v"] = 0, g.num_nodes - 1
+        return req
+
+    # Warm the bucket's jit compile outside the clock — boot cost, not a
+    # per-kind price (every kind rides the same level loop).
+    MSTService(backend="device").handle(kind_request(pool[0], "mst"))
+
+    solve_lat = {k: [] for k in kinds}
+    hit_lat = {k: [] for k in kinds}
+    wrong = 0
+    for _ in range(args.repeats):
+        for kind in kinds:
+            svc = MSTService(backend="device")
+            served = 0
+            for sink in (solve_lat, hit_lat):
+                for g in pool:
+                    t0 = time.perf_counter()
+                    resp = svc.handle(kind_request(g, kind))
+                    sink[kind].append(time.perf_counter() - t0)
+                    if not resp.get("ok"):
+                        wrong += 1
+                    elif kind == "mst":
+                        served += int(resp["total_weight"])
+            if kind == "mst" and served != 2 * oracle_weight:
+                wrong += 1
+    if wrong:
+        print(f"KINDS BENCH FAILED: {wrong} wrong/non-ok responses",
+              file=sys.stderr)
+
+    metrics = {"mst_weight": oracle_weight, "wrong_results": wrong}
+    for kind in kinds:
+        metrics[f"{kind}_solve_p50_s"] = quantile(solve_lat[kind], 0.5)
+        metrics[f"{kind}_hit_p50_s"] = quantile(hit_lat[kind], 0.5)
+    out = {
+        "metric": f"analytics kinds, {len(pool)} x gnm({args.batch_nodes},"
+        f"{args.batch_edges}), {args.repeats} repeats",
+        "value": round(metrics["mst_solve_p50_s"] * 1e3, 3),
+        "unit": "ms (mst solve p50; per-kind keys in metrics)",
+        **{
+            name: (round(value, 6) if name.endswith("_s") else value)
+            for name, value in metrics.items()
+        },
+    }
+    print(json.dumps(out))
+    if args.metrics_out:
+        with open(args.metrics_out, "w") as f:
+            json.dump(
+                {
+                    "schema": "ghs-bench-metrics-v1",
+                    "config": {"workload": "gate-analytics-bench-v1"},
+                    "metrics": metrics,
+                },
+                f,
+                indent=2,
+            )
+            f.write("\n")
+    return 0 if wrong == 0 else 1
+
+
 def run_sharded_bench(args) -> int:
     """Oversize-lane serving metrics: cold staging vs warm device-resident
     re-solve on the mesh (``parallel/lane.py``), plus the donated-buffer
@@ -1183,6 +1292,13 @@ def main(argv=None) -> int:
         "--no-verify, which skips the RMAT run's oracle check",
     )
     p.add_argument(
+        "--kinds", action="store_true",
+        help="per-kind analytics latency bench (gate-analytics-bench-v1): "
+        "p50 of each query kind (mst, components, k_msf, bottleneck, "
+        "path_max) through the service, cold (the kind's own solve) and "
+        "warm (per-kind cache / O(tree) derive) — docs/ANALYTICS.md",
+    )
+    p.add_argument(
         "--kernel", choices=["auto", "pallas", "xla"], default=None,
         help="per-level solver kernel (docs/KERNELS.md): 'pallas' = fused "
         "Pallas TPU kernels, 'xla' = the plain two-step path, 'auto' "
@@ -1201,6 +1317,8 @@ def main(argv=None) -> int:
         set_default_kernel(args.kernel)
     if args.verify:
         return run_verify_bench(args)
+    if args.kinds:
+        return run_kinds_bench(args)
     if args.fleet_tcp:
         return run_fleet_tcp_bench(args)
     if args.update_stream:

@@ -1,0 +1,17 @@
+"""analytics/ — the multi-query front door over the serving stack.
+
+One registry (:mod:`analytics.kinds`) maps each supported query *kind* —
+``mst``, ``components``, ``k_msf``, ``bottleneck``, ``path_max`` — to its
+solver entry, result schema, NetworkX oracle, verify adapter, and default
+SLO class; thin wrappers (:mod:`analytics.solvers`) derive every kind from
+the same GHS/Borůvka level loop the MST path runs. See ``docs/ANALYTICS.md``.
+"""
+
+from distributed_ghs_implementation_tpu.analytics.kinds import (  # noqa: F401
+    KINDS,
+    KindSpec,
+    cache_token,
+    get,
+    known,
+    parse_params,
+)

@@ -57,7 +57,10 @@ from distributed_ghs_implementation_tpu.batch.lanes import (
 from distributed_ghs_implementation_tpu.batch.policy import BatchPolicy
 from distributed_ghs_implementation_tpu.graphs.edgelist import Graph
 from distributed_ghs_implementation_tpu.obs.events import BUS
-from distributed_ghs_implementation_tpu.obs.slo import current_class
+from distributed_ghs_implementation_tpu.obs.slo import (
+    current_class,
+    current_kind,
+)
 from distributed_ghs_implementation_tpu.utils.resilience import (
     FAULTS,
     IncidentLog,
@@ -77,10 +80,13 @@ class PendingSolve:
     ``cls`` snapshots the submitting request's SLO class tag
     (``obs.slo.current_class``) — the worker thread that eventually forms
     the batch has no request context of its own, so queue-wait telemetry
-    is attributed from the tag captured here at submit time.
+    is attributed from the tag captured here at submit time. ``kind``
+    snapshots the analytics query kind the same way (``None`` == mst):
+    batch forming keys on it, so lanes stay kind-homogeneous.
     """
 
-    __slots__ = ("graph", "event", "result", "error", "enqueued_at", "cls")
+    __slots__ = ("graph", "event", "result", "error", "enqueued_at", "cls",
+                 "kind")
 
     def __init__(self, graph: Graph):
         self.graph = graph
@@ -89,6 +95,7 @@ class PendingSolve:
         self.error: Optional[BaseException] = None
         self.enqueued_at = time.monotonic()
         self.cls = current_class()
+        self.kind = current_kind()
 
     def wait(self, timeout: Optional[float] = None) -> MSTResult:
         if not self.event.wait(timeout):
@@ -285,18 +292,28 @@ class BatchEngine:
     # ------------------------------------------------------------------
     def _take_batch(self) -> Optional[List[PendingSolve]]:
         """Under the lock: pop a full bucket, or the oldest item's bucket
-        once its wait expires. ``None`` means keep waiting."""
+        once its wait expires. ``None`` means keep waiting.
+
+        The forming key is ``(kind, shape bucket)``: every admitted solve
+        is a plain MSF solve regardless of query kind (components submits
+        its index-weighted twin), so mixing kinds would be *numerically*
+        fine — homogeneity is kept so one lane-mate's failure, retry, or
+        supervision incident never blurs across kinds in the per-kind SLO
+        and incident telemetry (docs/ANALYTICS.md).
+        """
         if not self._queue:
             return None
         by_bucket: Dict[tuple, List[PendingSolve]] = {}
         for p in self._queue:
-            by_bucket.setdefault(bucket_key(p.graph), []).append(p)
+            by_bucket.setdefault(
+                (p.kind, bucket_key(p.graph)), []
+            ).append(p)
         for members in by_bucket.values():
             if len(members) >= self.policy.max_lanes:
                 return members[: self.policy.max_lanes]
         oldest = self._queue[0]
         if self._clock() - oldest.enqueued_at >= self.policy.max_wait_s:
-            members = by_bucket[bucket_key(oldest.graph)]
+            members = by_bucket[(oldest.kind, bucket_key(oldest.graph))]
             return members[: self.policy.max_lanes]
         return None
 

@@ -1604,11 +1604,22 @@ class FleetRouter:
             certify_claim,
         )
 
+        # Analytics kinds certify with their own adapters (the request's
+        # kind travels on the forwarding probe, so a forwarded hit is
+        # verified kind-correctly). A path_max response's edge payload IS
+        # the owner's MST, so it certifies as an mst claim.
+        kind = str(request.get("kind", "mst"))
+        if kind == "path_max":
+            kind = "mst"
         try:
             return certify_claim(
                 request["num_nodes"], request["edges"],
                 response["mst_edges"],
                 total_weight=response.get("total_weight"), engine="np",
+                kind=kind,
+                k=request.get("k"),
+                num_components=response.get("num_components"),
+                bottleneck_weight=response.get("bottleneck_weight"),
             )
         except Exception as e:  # noqa: BLE001 — a crash here would turn
             # the designed reject-and-re-solve path into an unhandled
@@ -1661,6 +1672,16 @@ class FleetRouter:
         if not (ow.alive and ow.ready.is_set() and not ow.draining):
             return None, False  # a draining owner is leaving: don't queue on it
         probe = {"op": "solve", "digest": key, "cached_only": True}
+        # The query kind (and its parameters) must travel on the probe:
+        # per-kind cache keys mean the owner's mst entry says nothing
+        # about its components entry, and a kind-blind probe would serve
+        # an MST answer to a components query (docs/ANALYTICS.md).
+        kind = str(request.get("kind", "mst"))
+        if kind != "mst":
+            probe["kind"] = kind
+            for param in ("k", "u", "v", "labels_out"):
+                if param in request:
+                    probe[param] = request[param]
         verifiable = (
             self.config.verify_forward
             and "edges" in request and "num_nodes" in request

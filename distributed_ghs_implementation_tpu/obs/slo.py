@@ -97,6 +97,59 @@ def tagged_class(cls: Optional[str]):
         _current_class.reset(token)
 
 
+# -- query kinds -------------------------------------------------------------
+#
+# The analytics front door (``analytics/``) serves five query *kinds* over
+# the same stack: ``mst`` (the default), ``components``, ``k_msf``,
+# ``bottleneck``, ``path_max``. Each kind gets a default SLO class so a
+# request that names a kind but no ``slo_class`` still lands in a stable,
+# per-kind latency bucket (and picks up any per-class verify policy the
+# operator configured). ``mst`` maps to ``None`` on purpose: pre-analytics
+# traffic must keep its historical untagged telemetry shape.
+
+KIND_CLASS_DEFAULTS: Dict[str, Optional[str]] = {
+    "mst": None,
+    "components": "components",
+    "k_msf": "k_msf",
+    "bottleneck": "bottleneck",
+    "path_max": "path_max",
+}
+
+
+def default_class_for_kind(kind) -> Optional[str]:
+    """Default SLO class for a query ``kind`` (``None`` for ``mst``/unknown)."""
+    return KIND_CLASS_DEFAULTS.get(str(kind)) if kind is not None else None
+
+
+_current_kind: "contextvars.ContextVar[Optional[str]]" = contextvars.ContextVar(
+    "ghs_query_kind", default=None
+)
+
+
+def current_kind() -> Optional[str]:
+    """The query kind of the current request context (``None`` == ``mst``).
+
+    Like :func:`current_class` this is a thread/context-scoped side channel:
+    the batch engine snapshots it at submit time so forming lanes stay
+    kind-homogeneous without threading a ``kind`` argument through the
+    scheduler API.
+    """
+    return _current_kind.get()
+
+
+@contextlib.contextmanager
+def tagged_kind(kind: Optional[str]):
+    """Scope the current thread of work to query kind ``kind`` (``None`` no-op)."""
+    if kind is None:
+        yield
+        return
+    token = _current_kind.set(str(kind))
+    try:
+        yield
+    finally:
+        _current_kind.reset(token)
+
+
 class ClassStats:
     """Per-class accumulator: outcome counts + latency/solve reservoirs.
 

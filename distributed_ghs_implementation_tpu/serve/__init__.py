@@ -14,7 +14,12 @@ The serving layer over the solver stack (``docs/SERVING.md``):
 * ``service``   — the JSONL request/response loop behind ``ghs serve``.
 """
 
-from distributed_ghs_implementation_tpu.serve.dynamic import DynamicMST, Update
+from distributed_ghs_implementation_tpu.serve.dynamic import (
+    DynamicMST,
+    Update,
+    components_via_unionfind,
+    tree_path_max,
+)
 from distributed_ghs_implementation_tpu.serve.scheduler import SolveScheduler
 from distributed_ghs_implementation_tpu.serve.service import MSTService, serve_loop
 from distributed_ghs_implementation_tpu.serve.store import ResultStore, solve_cache_key
@@ -25,6 +30,8 @@ __all__ = [
     "ResultStore",
     "SolveScheduler",
     "Update",
+    "components_via_unionfind",
     "serve_loop",
     "solve_cache_key",
+    "tree_path_max",
 ]

@@ -67,13 +67,28 @@ class Update:
         )
 
 
-def _components_via_unionfind(
+def components_via_unionfind(
     num_nodes: int, eu: np.ndarray, ev: np.ndarray
 ) -> np.ndarray:
-    """Connected-component root labels via the solver's own primitives:
-    repeated ``fragment_moe`` (per-fragment minimum outgoing edge) +
-    ``hook_and_compress`` rounds — Borůvka connectivity, converging in
-    ``<= ceil(log2 n)`` rounds."""
+    """Connected-component root labels via the solver's own primitives.
+
+    Public API (the analytics ``components`` kind and the stream layer both
+    use it): repeated ``fragment_moe`` (per-fragment minimum outgoing edge)
+    + ``hook_and_compress`` rounds — Borůvka connectivity, converging in
+    ``<= ceil(log2 n)`` rounds. The weight key is the edge *index* (any
+    all-distinct rank yields the same connectivity), which is exactly the
+    "weight-free" instantiation of the GHS level loop.
+
+    Args:
+        num_nodes: node count ``n``; labels are returned for every node.
+        eu, ev: endpoint arrays (any integer dtype; orientation and order
+            do not matter — both directions are added internally).
+
+    Returns:
+        ``int64`` array of length ``n``: each node's fragment root. Two
+        nodes are connected iff their labels are equal; isolated nodes
+        label themselves.
+    """
     import jax.numpy as jnp
 
     from distributed_ghs_implementation_tpu.ops.segment_ops import fragment_moe
@@ -98,6 +113,86 @@ def _components_via_unionfind(
             return np.asarray(fragment, dtype=np.int64)
         fragment, _ = hook_and_compress(has, dstf, fragment)
     raise RuntimeError("union-find connectivity did not converge")  # unreachable
+
+
+#: Historical private name, kept as an alias for in-repo callers and tests
+#: that predate the analytics promotion.
+_components_via_unionfind = components_via_unionfind
+
+
+def tree_path_max(
+    num_nodes: int,
+    tu: np.ndarray,
+    tv: np.ndarray,
+    tw: np.ndarray,
+    a: int,
+    b: int,
+) -> Optional[int]:
+    """Maximum-weight edge on the unique forest path between ``a`` and ``b``.
+
+    Public API (the analytics ``path_max`` kind queries it directly; the
+    dynamic-update cycle rule uses it via :meth:`DynamicMST._tree_path_max`).
+    Edges are compared by the solver's total order — lexicographic
+    ``(w, u, v)`` — so ties break exactly as the MST solver breaks them,
+    and for an MST the returned edge is the *minimax* (bottleneck-optimal)
+    answer for the pair.
+
+    Args:
+        num_nodes: node count the forest spans.
+        tu, tv, tw: the forest's edge arrays, ``tu[i] < tv[i]`` per edge
+            (any order across edges). Must actually be a forest: each node
+            pair connected by at most one path.
+        a, b: node ids.
+
+    Returns:
+        Index **into the tree arrays** of the maximum-order path edge, or
+        ``None`` when ``a == b`` or the nodes are in different fragments.
+    """
+    from scipy.sparse import coo_matrix
+    from scipy.sparse.csgraph import breadth_first_order
+
+    n = int(num_nodes)
+    tu = np.asarray(tu)
+    tv = np.asarray(tv)
+    tw = np.asarray(tw)
+    if tu.size == 0 or int(a) == int(b):
+        return None
+    adj = coo_matrix(
+        (np.ones(tu.size, dtype=np.int8), (tu, tv)), shape=(n, n)
+    ).tocsr()
+    _order, pred = breadth_first_order(
+        adj, int(a), directed=False, return_predecessors=True
+    )
+    if pred[int(b)] < 0:
+        return None  # disconnected (scipy sentinel is -9999)
+    keys = tu.astype(np.int64) * n + tv.astype(np.int64)
+    order = np.argsort(keys, kind="stable")
+    skeys = keys[order]
+    is_int = tw.dtype.kind in "iu"
+
+    def _triple(i: int):
+        w = int(tw[i]) if is_int else float(tw[i])
+        return (w, int(tu[i]), int(tv[i]))
+
+    best: Optional[int] = None
+    cur = int(b)
+    a = int(a)
+    while cur != a:
+        p = int(pred[cur])
+        lo, hi = (p, cur) if p < cur else (cur, p)
+        key = lo * n + hi
+        pos = int(np.searchsorted(skeys, key))
+        if pos >= skeys.size or skeys[pos] != key:
+            raise ValueError(f"tree edge ({lo}, {hi}) missing from arrays")
+        idx = int(order[pos])
+        if best is None or _triple(idx) > _triple(best):
+            best = idx
+        cur = p
+    return best
+
+
+#: Historical private name for the module-level path-max primitive.
+_tree_path_max = tree_path_max
 
 
 class DynamicMST:
@@ -331,34 +426,20 @@ class DynamicMST:
         return int(order[best])
 
     def _tree_path_max(self, a: int, b: int) -> Optional[int]:
-        """Index of the maximum-order edge on the tree path ``a..b``, or
-        ``None`` when ``a`` and ``b`` are in different fragments."""
-        from scipy.sparse import coo_matrix
-        from scipy.sparse.csgraph import breadth_first_order
-
-        tu = self._u[self._in_tree]
-        tv = self._v[self._in_tree]
-        if tu.size == 0:
-            return None
-        adj = coo_matrix(
-            (np.ones(tu.size, dtype=np.int8), (tu, tv)),
-            shape=(self._n, self._n),
-        ).tocsr()
-        _order, pred = breadth_first_order(
-            adj, a, directed=False, return_predecessors=True
+        """Index (into the *full* edge arrays) of the maximum-order edge on
+        the tree path ``a..b``, or ``None`` when ``a`` and ``b`` are in
+        different fragments. Thin wrapper over the public module-level
+        :func:`tree_path_max`, mapping its tree-relative index back."""
+        tree_idx = np.nonzero(self._in_tree)[0]
+        rel = tree_path_max(
+            self._n,
+            self._u[tree_idx],
+            self._v[tree_idx],
+            self._w[tree_idx],
+            a,
+            b,
         )
-        if b == a or pred[b] < 0:
-            return None  # disconnected (scipy sentinel is -9999)
-        best: Optional[int] = None
-        cur = b
-        while cur != a:
-            p = int(pred[cur])
-            lo, hi = (p, cur) if p < cur else (cur, p)
-            idx = self._find(lo, hi)
-            if best is None or self._triple(idx) > self._triple(best):
-                best = idx
-            cur = p
-        return best
+        return None if rel is None else int(tree_idx[rel])
 
     # -- structural invariants -------------------------------------------
     def _forest_ok(self) -> bool:

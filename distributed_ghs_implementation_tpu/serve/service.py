@@ -1,14 +1,37 @@
-"""The MST query service: a JSONL request/response loop over the serve stack.
+"""The graph query service: a JSONL request/response loop over the serve stack.
 
 One JSON object per input line, one JSON response line per request — a
 protocol a test, the chaos drill, or a thin network front-end can all drive
-(``ghs serve`` wires it to stdin/stdout). Requests:
+(``ghs serve`` wires it to stdin/stdout). The full protocol, op by op:
 
 * ``{"op": "solve", "num_nodes": N, "edges": [[u, v, w], ...]}`` — or
   ``{"op": "solve", "graph_path": "graph.npz"}`` — optional ``"backend"``,
-  ``"edges_out": true`` to include the MST edge list in the response.
-  Response carries the graph ``digest`` (the handle updates key on) and
-  ``source``: ``"cache"`` / ``"coalesced"`` / ``"solved"``.
+  ``"edges_out": true`` to include the answer's edge list in the response,
+  ``"cached_only": true`` to probe this host's cache by ``"digest"`` alone
+  (the fleet router's forwarding probe — a miss answers ``{"ok": false,
+  "cache_miss": true}`` without solving). Response carries the graph
+  ``digest`` (the handle updates key on) and ``source``: ``"cache"`` /
+  ``"coalesced"`` / ``"solved"``.
+
+  An optional ``"kind"`` field selects the analytics query kind
+  (``analytics/kinds.py``, docs/ANALYTICS.md) — every kind runs the same
+  GHS level loop and caches under a per-kind digest key:
+
+  - ``{"op": "solve", "kind": "mst", ...}`` — the default; the minimum
+    spanning forest.
+  - ``{"op": "solve", "kind": "components", ...}`` — connected components
+    via the weight-free solve; response adds exact ``num_components`` and,
+    with ``"labels_out": true``, the per-node ``labels`` array.
+  - ``{"op": "solve", "kind": "k_msf", "k": 3, ...}`` — the optimal
+    ``k``-forest (lightest ``n - max(k, c)`` MSF edges); response echoes
+    ``k``.
+  - ``{"op": "solve", "kind": "bottleneck", ...}`` — minimum bottleneck
+    spanning value; response adds ``bottleneck_weight`` +
+    ``bottleneck_edge``.
+  - ``{"op": "solve", "kind": "path_max", "u": 0, "v": 7, ...}`` — the
+    minimax (bottleneck-optimal) edge between two nodes; response adds
+    ``connected``, ``path_max_weight``, ``path_max_edge``.
+
 * ``{"op": "update", "digest": "...", "updates": [{"kind": "insert",
   "u": 1, "v": 2, "w": 5}, {"kind": "delete", "u": 3, "v": 4}, ...]}`` —
   incremental maintenance against the session for ``digest``; the response
@@ -30,8 +53,11 @@ protocol a test, the chaos drill, or a thin network front-end can all drive
 * ``{"op": "stats"}`` — serve counters from the ``obs`` bus + store stats.
 * ``{"op": "shutdown"}`` — acknowledge and end the loop (EOF also ends it).
 
-Errors never kill the loop: a malformed line or a failed request produces
-``{"ok": false, "error": ...}`` and the loop reads on.
+Every request may carry ``"slo_class"`` (per-class latency accounting and
+verify policy, ``obs/slo.py``); a ``kind`` query without one lands in its
+kind's default class. Errors never kill the loop: a malformed line or a
+failed request produces ``{"ok": false, "error": ...}`` and the loop reads
+on.
 """
 
 from __future__ import annotations
@@ -51,8 +77,10 @@ from distributed_ghs_implementation_tpu.graphs.edgelist import Graph
 from distributed_ghs_implementation_tpu.obs import tracing
 from distributed_ghs_implementation_tpu.obs.events import BUS
 from distributed_ghs_implementation_tpu.obs.slo import (
+    default_class_for_kind,
     sanitize_class,
     tagged_class,
+    tagged_kind,
 )
 from distributed_ghs_implementation_tpu.serve.dynamic import DynamicMST
 from distributed_ghs_implementation_tpu.serve.scheduler import SolveScheduler
@@ -63,6 +91,12 @@ from distributed_ghs_implementation_tpu.serve.store import (
 )
 
 _MAX_SESSIONS = 32  # update handles retained (LRU); results outlive them
+
+#: The protocol's op set — the dispatch table and the unknown-op error both
+#: derive from this one tuple so the message can never drift out of date
+#: again (it once predated several ops).
+_OPS = ("solve", "update", "subscribe", "publish", "poll", "stats",
+        "shutdown")
 
 
 class MSTService:
@@ -238,22 +272,41 @@ class MSTService:
         )
 
         op = request.get("op")
+        # Analytics query kind: "mst" (the historical default) unless the
+        # solve names another registered kind. Counted per kind
+        # (serve.kind.<kind>) and propagated context-scoped (tagged_kind)
+        # so the batch engine keeps forming lanes kind-homogeneous.
+        kind = str(request.get("kind", "mst")) if op == "solve" else None
         # SLO class tag: clients label each query ("hit"/"miss"/"update"/
         # ...); the label rides the serve.request span args (what
         # obs.slo joins per-class reports from) AND the thread-scoped
         # tagged_class context, so nested layers (scheduler serve.solve
         # spans, the batch engine's queue-wait histograms) attribute their
-        # telemetry to the same class without any API threading.
+        # telemetry to the same class without any API threading. A kind
+        # query without an explicit class falls into its kind's default
+        # class (obs.slo.KIND_CLASS_DEFAULTS) — mst stays untagged.
         cls = sanitize_class(request.get("slo_class"))
+        if cls is None and kind is not None:
+            cls = default_class_for_kind(kind)
         span_args = {"op": str(op)}
+        if kind is not None and kind != "mst":
+            span_args["kind"] = kind
         if cls is not None:
             span_args["cls"] = cls
-        with tagged_class(cls), tracing.front_door(cls), BUS.span(
-            "serve.request", cat="serve", **span_args
-        ) as span:
+        with tagged_class(cls), tagged_kind(kind), tracing.front_door(
+            cls
+        ), BUS.span("serve.request", cat="serve", **span_args) as span:
             BUS.count("serve.requests")
             try:
                 if op == "solve":
+                    from distributed_ghs_implementation_tpu import (
+                        analytics,
+                    )
+
+                    # Unknown kinds raise the registry's ValueError before
+                    # any solving; known kinds count per kind.
+                    analytics.get(kind)
+                    BUS.count(f"serve.kind.{kind}")
                     response = self._handle_solve(request)
                 elif op == "update":
                     response = self._handle_update(request)
@@ -269,8 +322,7 @@ class MSTService:
                     response = {"ok": True, "op": "shutdown"}
                 else:
                     raise ValueError(
-                        f"unknown op {op!r}; expected solve|update|"
-                        f"subscribe|publish|poll|stats|shutdown"
+                        f"unknown op {op!r}; expected {'|'.join(_OPS)}"
                     )
             except StaleDigest as e:
                 # Not an error so much as a re-sync point: the client's
@@ -322,6 +374,9 @@ class MSTService:
     def _handle_solve(self, request: dict) -> dict:
         if request.get("cached_only"):
             return self._handle_cached_probe(request)
+        kind = str(request.get("kind", "mst"))
+        if kind != "mst":
+            return self._handle_analytics(request, kind)
         graph = self._load_graph(request)
         backend = request.get("backend", self.backend)
         bucket = bucket_of(graph.num_nodes, graph.num_edges)
@@ -358,17 +413,171 @@ class MSTService:
         out.update(self._result_fields(result, request))
         return out
 
+    def _handle_analytics(self, request: dict, kind: str) -> dict:
+        """A non-``mst`` solve: dispatch through the analytics registry.
+
+        Every kind rides the normal scheduler path (single-flight dedup,
+        admission, batch lanes, the sharded oversize lane, supervision) —
+        ``components`` by solving the graph's index-weighted twin, the
+        rest by deriving from the graph's own MSF (which therefore shares
+        the ``mst`` cache entry; cross-kind affinity is deliberate).
+        Cacheable kinds store under their per-kind digest key, and — like
+        the mst path — every *served* answer is certified per policy with
+        the kind's own adapter, corrected transparently on failure.
+        """
+        from distributed_ghs_implementation_tpu import analytics
+        from distributed_ghs_implementation_tpu.analytics import (
+            solvers as asolvers,
+        )
+        from distributed_ghs_implementation_tpu.verify.certify import (
+            certify_components,
+            certify_k_forest,
+        )
+
+        params = analytics.parse_params(kind, request)
+        graph = self._load_graph(request)
+        backend = request.get("backend", self.backend)
+        digest = graph.digest()
+        cls = sanitize_class(request.get("slo_class"))
+        if cls is None:
+            cls = analytics.get(kind).slo_class
+        bucket = bucket_of(graph.num_nodes, graph.num_edges)
+        if warmable_single(*bucket):
+            self.seen_buckets[bucket] = None
+
+        def solve(g):
+            return self.scheduler.solve(g, backend=backend)
+
+        token = analytics.cache_token(kind, k=params.get("k"))
+        kind_key = (
+            cache_key_for_digest(digest, backend=backend, kind=token)
+            if token is not None else None
+        )
+        mst_key = cache_key_for_digest(digest, backend=backend)
+        verified = None
+        extra: dict = {}
+
+        if kind == "components":
+            result = self.store.get(kind_key, graph)
+            source = "cache"
+            if result is None:
+                result, source = asolvers.solve_components(graph, solve)
+                self.store.put(kind_key, result)
+            if self.verifier is not None:
+                def _rederive_components() -> MSTResult:
+                    # The poison may live in the connectivity twin's own
+                    # cache entry — purge it so the re-solve is honest.
+                    twin = asolvers.connectivity_graph(graph)
+                    self.store.invalidate(
+                        solve_cache_key(twin, backend=backend),
+                        reason="kind rederive",
+                    )
+                    fresh, _src = asolvers.solve_components(graph, solve)
+                    self.store.put(kind_key, fresh)
+                    return fresh
+
+                result, verified = self.verifier.check(
+                    result, cls=cls, key=kind_key, backend=backend,
+                    certify=lambda r, engine: certify_components(
+                        r.graph, r.edge_ids, engine=engine,
+                        expect_components=r.num_components,
+                    ),
+                    rederive=_rederive_components,
+                )
+            if request.get("labels_out"):
+                extra["labels"] = asolvers.labels_for_forest(
+                    result
+                ).tolist()
+        elif kind == "k_msf":
+            k = params["k"]
+            result = self.store.get(kind_key, graph)
+            source = "cache"
+            if result is None:
+                result, source, full = asolvers.solve_k_msf(graph, solve, k)
+                self._remember(digest, full, backend)
+                self.store.put(kind_key, result)
+            if self.verifier is not None:
+                def _rederive_k_msf() -> MSTResult:
+                    # Trimming is local; a bad k-forest implicates the
+                    # underlying MSF entry, so purge that too.
+                    self.store.invalidate(mst_key, reason="kind rederive")
+                    fresh, _src, full = asolvers.solve_k_msf(
+                        graph, solve, k
+                    )
+                    self._remember(digest, full, backend)
+                    self.store.put(kind_key, fresh)
+                    return fresh
+
+                result, verified = self.verifier.check(
+                    result, cls=cls, key=kind_key, backend=backend,
+                    certify=lambda r, engine: certify_k_forest(
+                        r.graph, r.edge_ids, k, engine=engine,
+                    ),
+                    rederive=_rederive_k_msf,
+                )
+            extra["k"] = k
+        else:
+            # bottleneck / path_max: scalar reductions over the graph's
+            # own (certified) MSF — never separately store-cached; the
+            # shared mst entry is the cache.
+            result, source = solve(graph)
+            if self.verifier is not None:
+                result, verified = self.verifier.check(
+                    result, cls=cls, key=mst_key, backend=backend,
+                )
+            self._remember(digest, result, backend)
+            if kind == "bottleneck":
+                bn = asolvers.bottleneck_of(result)
+                extra["bottleneck_weight"] = None if bn is None else bn[0]
+                extra["bottleneck_edge"] = (
+                    None if bn is None else [bn[1], bn[2]]
+                )
+            else:  # path_max
+                ans = asolvers.path_max_of(result, params["u"], params["v"])
+                extra.update({
+                    "u": params["u"], "v": params["v"],
+                    "connected": ans["connected"],
+                    "path_max_weight": ans["weight"],
+                    "path_max_edge": (
+                        None if ans["edge"] is None else list(ans["edge"])
+                    ),
+                })
+
+        out = {
+            "ok": True,
+            "op": "solve",
+            "kind": kind,
+            "digest": digest,
+            "source": source,
+            "cached": source != "solved",
+        }
+        if verified is not None:
+            out["verified"] = verified
+        out.update(self._result_fields(result, request))
+        out.update(extra)
+        return out
+
     def _handle_cached_probe(self, request: dict) -> dict:
         """A ``cached_only`` solve: answer from the store (memory LRU, or
         this host's disk layer) by digest alone — never solve. This is the
         fleet router's cross-host forwarding probe: the frame carries only
         the digest (no edge list), so a hit ships one cached result over
         the wire and a miss costs a single tiny round trip before the
-        dispatch target solves locally (``docs/FLEET.md``)."""
+        dispatch target solves locally (``docs/FLEET.md``).
+
+        Probes are kind-aware: a ``kind`` probe answers from its own
+        per-kind key (never the mst entry — kind-correctness is the whole
+        point of the per-kind keys), and the derived kinds (``k_msf``,
+        ``bottleneck``, ``path_max``) additionally fall back to *deriving*
+        from the cached mst entry — O(tree) host work, honoring the
+        never-solve contract."""
         digest = request.get("digest")
         if not digest:
             raise ValueError("cached_only solve needs a digest")
+        kind = str(request.get("kind", "mst"))
         backend = request.get("backend", self.backend)
+        if kind != "mst":
+            return self._kind_probe(request, kind, str(digest), backend)
         result = self.store.get(
             cache_key_for_digest(str(digest), backend=backend),
             record_miss=False,
@@ -389,6 +598,84 @@ class MSTService:
             "cached": True,
         }
         out.update(self._result_fields(result, request))
+        return out
+
+    def _kind_probe(
+        self, request: dict, kind: str, digest: str, backend: str
+    ) -> dict:
+        """The non-``mst`` arm of :meth:`_handle_cached_probe`."""
+        from distributed_ghs_implementation_tpu import analytics
+        from distributed_ghs_implementation_tpu.analytics import (
+            solvers as asolvers,
+        )
+
+        params = analytics.parse_params(kind, request)
+        token = analytics.cache_token(kind, k=params.get("k"))
+        extra: dict = {}
+        result = None
+        if token is not None:
+            result = self.store.get(
+                cache_key_for_digest(digest, backend=backend, kind=token),
+                record_miss=False,
+            )
+        if result is None and kind in ("k_msf", "bottleneck", "path_max"):
+            # Derivable kinds: a cached mst entry answers without solving.
+            # components is NOT derived here — its canonical cache entry is
+            # the connectivity forest, and a probe must never plant a
+            # different edge set under the kind key.
+            mst_cached = self.store.get(
+                cache_key_for_digest(digest, backend=backend),
+                record_miss=False,
+            )
+            if mst_cached is not None:
+                if kind == "k_msf":
+                    result = asolvers.trim_to_k_forest(
+                        mst_cached, params["k"]
+                    )
+                    self.store.put(
+                        cache_key_for_digest(
+                            digest, backend=backend, kind=token
+                        ),
+                        result,
+                        memory_only=True,
+                    )
+                else:
+                    result = mst_cached
+        BUS.count("serve.probe.hit" if result is not None
+                  else "serve.probe.miss")
+        if result is None:
+            return {"ok": False, "op": "solve", "kind": kind,
+                    "digest": digest, "cache_miss": True,
+                    "error": f"cache_miss: {digest} ({kind}) "
+                             f"not cached here"}
+        if kind == "k_msf":
+            extra["k"] = params["k"]
+        elif kind == "bottleneck":
+            bn = asolvers.bottleneck_of(result)
+            extra["bottleneck_weight"] = None if bn is None else bn[0]
+            extra["bottleneck_edge"] = None if bn is None else [bn[1], bn[2]]
+        elif kind == "path_max":
+            ans = asolvers.path_max_of(result, params["u"], params["v"])
+            extra.update({
+                "u": params["u"], "v": params["v"],
+                "connected": ans["connected"],
+                "path_max_weight": ans["weight"],
+                "path_max_edge": (
+                    None if ans["edge"] is None else list(ans["edge"])
+                ),
+            })
+        elif kind == "components" and request.get("labels_out"):
+            extra["labels"] = asolvers.labels_for_forest(result).tolist()
+        out = {
+            "ok": True,
+            "op": "solve",
+            "kind": kind,
+            "digest": digest,
+            "source": "cache",
+            "cached": True,
+        }
+        out.update(self._result_fields(result, request))
+        out.update(extra)
         return out
 
     def _handle_update(self, request: dict) -> dict:

@@ -50,7 +50,9 @@ from distributed_ghs_implementation_tpu.utils.locking import (
 )
 
 
-def solve_cache_key(graph: Graph, *, backend: str = "device") -> str:
+def solve_cache_key(
+    graph: Graph, *, backend: str = "device", kind: str = "mst"
+) -> str:
     """The cache identity of one solve: content digest + solver config.
 
     ``backend`` is the *requested* entry (e.g. ``"device"``), not the rung a
@@ -61,15 +63,30 @@ def solve_cache_key(graph: Graph, *, backend: str = "device") -> str:
     lane (``parallel/lane.py``) caches under its requested ``"device"``
     key, so the repeat query is a hit regardless of which path solved it
     (tests/test_lane.py pins the memory and disk round trips).
+
+    ``kind`` is the analytics query-kind token (``"mst"``, ``"components"``,
+    ``"k_msf4"``, ...): a components answer for a digest must never collide
+    with the MST answer for the same digest, so non-``mst`` kinds append the
+    token as a third key segment. ``"mst"`` keeps the historical two-segment
+    key so pre-analytics disk caches stay readable in place.
     """
-    return cache_key_for_digest(graph.digest(), backend=backend)
+    return cache_key_for_digest(graph.digest(), backend=backend, kind=kind)
 
 
-def cache_key_for_digest(digest: str, *, backend: str = "device") -> str:
+def cache_key_for_digest(
+    digest: str, *, backend: str = "device", kind: str = "mst"
+) -> str:
     """:func:`solve_cache_key` for an already-computed digest — the stream
     layer evicts superseded chain ancestors by digest alone, without
-    holding the ancestor graph."""
-    return f"{digest}:{backend}"
+    holding the ancestor graph. Non-``mst`` ``kind`` tokens become a third
+    ``:``-separated segment (must be filename-safe: ``[a-z0-9_]``)."""
+    base = f"{digest}:{backend}"
+    if kind == "mst":
+        return base
+    token = str(kind)
+    if not token or not all(ch.isalnum() or ch == "_" for ch in token):
+        raise ValueError(f"bad cache kind token {kind!r}")
+    return f"{base}:{token}"
 
 
 def _disk_path(disk_dir: str, key: str) -> str:
@@ -196,14 +213,27 @@ class ResultStore:
         capacity pressure — for a long-lived subscribed graph that is the
         whole LRU filled with dead ancestors. Disk entries stay (the
         bounded sweep handles those): a late query for an old chain link
-        is still answerable, just not at the cost of memory. Returns
-        whether an entry was dropped (``serve.store.chain_evicted``).
+        is still answerable, just not at the cost of memory.
+
+        Kind variants ride along: analytics entries key as
+        ``{digest}:{backend}:{kind}`` (see :func:`cache_key_for_digest`), so
+        evicting the base ``{digest}:{backend}`` ancestor also drops every
+        kind-variant sibling — a superseded graph's components/k-MSF answers
+        are exactly as dead as its MST. Returns whether any entry was
+        dropped (``serve.store.chain_evicted`` counts each).
         """
+        dropped = 0
+        prefix = key + ":"
         with self._lock:
-            if self._mem.pop(key, None) is None:
-                return False
-        BUS.count("serve.store.chain_evicted")
-        return True
+            victims = [
+                k for k in self._mem if k == key or k.startswith(prefix)
+            ]
+            for k in victims:
+                self._mem.pop(k, None)
+                dropped += 1
+        for _ in range(dropped):
+            BUS.count("serve.store.chain_evicted")
+        return dropped > 0
 
     def stats(self) -> dict:
         with self._lock:

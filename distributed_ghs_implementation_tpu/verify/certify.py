@@ -54,6 +54,8 @@ REASONS = (
     "bad_edge_ids",   # out of range / duplicate claimed edge ids
     "cycle",          # claimed edges close a cycle (not a forest)
     "not_spanning",   # component count differs from the input graph
+    "cross_edge",     # components claim: a graph edge crosses two claimed
+                      # components (the forest is not maximal)
     "not_minimal",    # a non-tree edge beats a tree edge on its path
     "unknown_edge",   # a claimed (u, v) pair is not an input edge
     "weight_mismatch",  # claimed total weight != recomputed edge sum
@@ -443,6 +445,209 @@ def certify_result(result, *, engine: str = "auto") -> Certificate:
     )
 
 
+# -- analytics kind adapters -------------------------------------------------
+#
+# Per-kind certificates for the analytics front door (``analytics/``). Each
+# certifies a *served answer*, not a recompute: the components adapter
+# proves partition exactness from forest validity + the cross-edge check,
+# and the k-forest adapter reduces to the rank-order MSF certificate on the
+# rank-prefix subgraph (the "relaxed spanning predicate").
+
+
+def certify_components(
+    graph: Graph,
+    edge_ids: np.ndarray,
+    *,
+    engine: str = "auto",
+    expect_components: Optional[int] = None,
+) -> Certificate:
+    """Certify a connectivity answer: ``edge_ids`` must be a *maximal*
+    spanning forest of ``graph``.
+
+    Two checks, jointly exact: (1) the claimed edges form a forest
+    (``bad_edge_ids`` / ``cycle``), so the claimed partition can only
+    *refine* the graph's true partition (tree edges are graph edges); and
+    (2) no graph edge crosses two claimed components (``cross_edge``), so
+    the true partition also refines the claimed one. Refinement both ways
+    is equality — a passing certificate proves the served labels are THE
+    component partition, with no oracle in the loop.
+    """
+    t0 = time.perf_counter()
+    engine = _resolve_engine(engine)
+    n, m = graph.num_nodes, graph.num_edges
+
+    def done(cert: Certificate) -> Certificate:
+        cert.engine = engine
+        cert.check_s = time.perf_counter() - t0
+        BUS.count("verify.checks")
+        BUS.record("verify.check_s", cert.check_s)
+        return cert
+
+    ids = np.asarray(edge_ids, dtype=np.int64).ravel()
+    if ids.size and (ids.min() < 0 or ids.max() >= m):
+        return done(_fail(
+            "bad_edge_ids",
+            f"edge id out of range [0, {m}): [{ids.min()}, {ids.max()}]",
+        ))
+    if np.unique(ids).size != ids.size:
+        return done(_fail(
+            "bad_edge_ids",
+            f"{ids.size - np.unique(ids).size} duplicate edge ids claimed",
+        ))
+    tu, tv = graph.u[ids], graph.v[ids]
+    tree_labels = _components(n, tu, tv)
+    c_tree = int(np.unique(tree_labels).size) if n else 0
+    if ids.size != n - c_tree:
+        return done(_fail(
+            "cycle",
+            f"{ids.size} claimed edges over {c_tree} components "
+            f"(a forest has exactly {n - c_tree})",
+            num_tree_edges=int(ids.size), num_components=c_tree,
+        ))
+    if m:
+        cross = tree_labels[graph.u] != tree_labels[graph.v]
+        if cross.any():
+            worst = np.nonzero(cross)[0][:4]
+            return done(_fail(
+                "cross_edge",
+                f"{int(cross.sum())} graph edges cross claimed components "
+                f"(e.g. edge ids {worst.tolist()}) — forest not maximal",
+                num_tree_edges=int(ids.size), num_components=c_tree,
+                violations=int(cross.sum()),
+            ))
+    if expect_components is not None and int(expect_components) != c_tree:
+        return done(_fail(
+            "metadata_mismatch",
+            f"result metadata claims {expect_components} components, "
+            f"certificate finds {c_tree}",
+            num_tree_edges=int(ids.size),
+            num_components=c_tree, graph_components=c_tree,
+        ))
+    return done(Certificate(
+        ok=True, reason=None,
+        num_tree_edges=int(ids.size), expected_edges=n - c_tree,
+        num_components=c_tree, graph_components=c_tree,
+    ))
+
+
+def certify_k_forest(
+    graph: Graph,
+    edge_ids: np.ndarray,
+    k: int,
+    *,
+    engine: str = "auto",
+) -> Certificate:
+    """Certify an optimal-``k``-forest answer (the ``k_msf`` kind).
+
+    The target size is ``n - k'`` with ``k' = min(n, max(k, c_graph))`` —
+    the *relaxed spanning predicate* (fewer than ``c_graph`` parts is
+    infeasible, more than ``n`` is meaningless). Optimality reduces to the
+    rank-order MSF certificate on a subgraph: with ``r* = max`` solver
+    rank over the claimed edges, the claim is the optimal ``k'``-forest
+    iff it is THE MSF of the rank-prefix subgraph ``{edges with rank <=
+    r*}`` and has exactly ``n - k'`` edges (Kruskal's partial forest after
+    processing rank ``r*`` is precisely the prefix subgraph's MSF). The
+    heavy lifting is the existing :func:`certify_edge_ids` cycle
+    certificate, run on that subgraph.
+    """
+    t0 = time.perf_counter()
+    engine = _resolve_engine(engine)
+    n, m = graph.num_nodes, graph.num_edges
+
+    def done(cert: Certificate) -> Certificate:
+        cert.engine = engine
+        cert.check_s = time.perf_counter() - t0
+        return cert
+
+    ids = np.asarray(edge_ids, dtype=np.int64).ravel()
+    if ids.size and (ids.min() < 0 or ids.max() >= m):
+        BUS.count("verify.checks")
+        return done(_fail(
+            "bad_edge_ids",
+            f"edge id out of range [0, {m}): [{ids.min()}, {ids.max()}]",
+        ))
+    if np.unique(ids).size != ids.size:
+        BUS.count("verify.checks")
+        return done(_fail(
+            "bad_edge_ids",
+            f"{ids.size - np.unique(ids).size} duplicate edge ids claimed",
+        ))
+    c_graph = (
+        int(np.unique(_components(n, graph.u, graph.v)).size) if n else 0
+    )
+    k_eff = min(n, max(int(k), c_graph))
+    want = n - k_eff
+    if ids.size != want:
+        BUS.count("verify.checks")
+        return done(_fail(
+            "not_spanning",
+            f"k-forest claim has {ids.size} edges; k={k} over a "
+            f"{c_graph}-component graph requires exactly {want} "
+            f"(relaxed k' = {k_eff})",
+            num_tree_edges=int(ids.size), expected_edges=want,
+            graph_components=c_graph,
+        ))
+    if want == 0:
+        BUS.count("verify.checks")
+        return done(Certificate(
+            ok=True, reason=None, num_tree_edges=0, expected_edges=0,
+            num_components=k_eff, graph_components=c_graph,
+        ))
+    rank = _edge_ranks(graph)
+    r_star = int(rank[ids].max())
+    mask = rank <= r_star
+    # Direct constructor: the masked arrays keep the canonical sorted
+    # order, and positions in the subgraph map back via cumsum.
+    sub = Graph(n, graph.u[mask], graph.v[mask], graph.w[mask])
+    sub_pos = np.cumsum(mask) - 1
+    inner = certify_edge_ids(sub, sub_pos[ids], engine=engine)
+    if not inner.ok:
+        inner.detail = (
+            f"[k_msf prefix subgraph, rank <= {r_star}] " + inner.detail
+        )
+        return done(inner)
+    return done(Certificate(
+        ok=True, reason=None,
+        num_tree_edges=int(ids.size), expected_edges=want,
+        num_components=k_eff, graph_components=c_graph,
+    ))
+
+
+def certify_bottleneck(
+    graph: Graph,
+    edge_ids: np.ndarray,
+    *,
+    bottleneck_weight=None,
+    engine: str = "auto",
+    expect_components: Optional[int] = None,
+    atol: float = 1e-6,
+) -> Certificate:
+    """Certify a bottleneck answer: the full MSF certificate plus the
+    claimed scalar against the recomputed max-tree-edge weight (the MSF's
+    max edge weight is the graph's minimum bottleneck spanning value, and
+    identical across all MSTs)."""
+    cert = certify_edge_ids(
+        graph, edge_ids, engine=engine, expect_components=expect_components,
+    )
+    if not cert.ok:
+        return cert
+    ids = np.asarray(edge_ids, dtype=np.int64).ravel()
+    actual = float(graph.w[ids].max()) if ids.size else None
+    if bottleneck_weight is not None and (
+        actual is None or abs(actual - float(bottleneck_weight)) > atol
+    ):
+        return _fail(
+            "weight_mismatch",
+            f"claimed bottleneck weight {bottleneck_weight} != recomputed "
+            f"{actual}",
+            num_tree_edges=cert.num_tree_edges,
+            num_components=cert.num_components,
+            graph_components=cert.graph_components,
+            engine=cert.engine,
+        )
+    return cert
+
+
 def certify_claim(
     num_nodes: int,
     edges: Sequence,
@@ -451,6 +656,10 @@ def certify_claim(
     total_weight=None,
     engine: str = "np",
     atol: float = 1e-6,
+    kind: str = "mst",
+    k: Optional[int] = None,
+    num_components: Optional[int] = None,
+    bottleneck_weight=None,
 ) -> Certificate:
     """Certify a *payload-shaped* claim: the request's raw edge list plus
     a response's ``mst_edges`` pairs (and optional claimed total weight).
@@ -462,6 +671,12 @@ def certify_claim(
     edge fails ``unknown_edge``; a claimed weight that disagrees with the
     recomputed edge sum fails ``weight_mismatch`` even when the edge set
     itself is plausible (the corruption a bit-flipped weight field is).
+
+    ``kind`` selects the analytics adapter for forwarded non-MST answers:
+    ``components`` (against the claimed ``num_components``), ``k_msf``
+    (requires ``k``), ``bottleneck`` (against the claimed
+    ``bottleneck_weight``); the default certifies an MST claim. All kinds
+    share the edge-mapping and total-weight checks above.
     """
     t0 = time.perf_counter()
 
@@ -519,6 +734,21 @@ def certify_claim(
                 f"{recomputed}",
                 engine=engine,
             ))
+    if kind == "components":
+        return done(certify_components(
+            graph, ids, engine=engine, expect_components=num_components,
+        ))
+    if kind == "k_msf":
+        if k is None:
+            BUS.count("verify.checks")
+            return done(_fail(
+                "malformed_claim", "k_msf claim without k", engine=engine,
+            ))
+        return done(certify_k_forest(graph, ids, int(k), engine=engine))
+    if kind == "bottleneck":
+        return done(certify_bottleneck(
+            graph, ids, bottleneck_weight=bottleneck_weight, engine=engine,
+        ))
     return done(certify_edge_ids(graph, ids, engine=engine))
 
 

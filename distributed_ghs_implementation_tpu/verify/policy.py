@@ -168,11 +168,21 @@ class AsyncAuditor:
                 )
                 self._thread.start()
 
-    def submit(self, result, *, cls: Optional[str] = None, key=None) -> bool:
-        """Queue one result for audit; ``False`` when dropped (full)."""
+    def submit(
+        self,
+        result,
+        *,
+        cls: Optional[str] = None,
+        key=None,
+        certify: Optional[Callable] = None,
+    ) -> bool:
+        """Queue one result for audit; ``False`` when dropped (full).
+        ``certify`` overrides the default MST certificate — the analytics
+        kinds audit with their own adapters (``certify(result, engine) ->
+        Certificate``)."""
         self._ensure_thread()
         try:
-            self._q.put_nowait((result, cls, key))
+            self._q.put_nowait((result, cls, key, certify))
         except queue.Full:
             BUS.count("verify.audit.dropped")
             return False
@@ -187,9 +197,11 @@ class AsyncAuditor:
             except queue.Empty:
                 self._idle.set()
                 continue
-            result, cls, key = item
+            result, cls, key, certify = item
             try:
-                cert = certify_result(result, engine=self.engine)
+                cert = (certify or certify_result)(
+                    result, engine=self.engine
+                )
                 if cert.ok:
                     BUS.count("verify.audit.ok")
                 else:
@@ -255,38 +267,64 @@ class ResultVerifier:
         if self.invalidate is not None:
             self.invalidate(key, result.graph.digest())
 
-    def audit(self, result, *, cls: Optional[str], key) -> Optional[str]:
+    def audit(
+        self,
+        result,
+        *,
+        cls: Optional[str],
+        key,
+        certify: Optional[Callable] = None,
+    ) -> Optional[str]:
         """Async-only verification for paths where inline correction has
         no safe shape (incremental update sessions, stream commits — the
         response is gone before an audit could retract it). ``full``
         classes audit every result, ``sample`` classes on cadence; a
-        failure evicts the entry so the next solve re-derives it."""
+        failure evicts the entry so the next solve re-derives it.
+        ``certify`` selects a non-MST adapter (see :meth:`check`)."""
         mode = self.policy.mode_for(cls)
         if mode == "off":
             return None
         if mode == "full" or self.policy.should_sample(cls):
-            self.auditor.submit(result, cls=cls, key=key)
+            self.auditor.submit(result, cls=cls, key=key, certify=certify)
             return "audit"
         return None
 
-    def check(self, result, *, cls: Optional[str], key, backend: str):
+    def check(
+        self,
+        result,
+        *,
+        cls: Optional[str],
+        key,
+        backend: str,
+        certify: Optional[Callable] = None,
+        rederive: Optional[Callable] = None,
+    ):
         """Verify ``result`` per policy; returns ``(result, verified)``
         where ``verified`` is ``"full"`` / ``"audit"`` / ``None`` and the
         returned result is the CORRECTED one when inline certification
         failed. Raises ``VerificationError`` only when even the fresh
         re-solve fails its certificate (systemic — a broken checker or a
         broken solver; serving either blind would be worse than erroring).
+
+        The analytics kinds pass their own adapters: ``certify(result,
+        engine) -> Certificate`` replaces the MST certificate, and
+        ``rederive() -> result`` replaces the injected ``resolve`` for the
+        correction path (a kind answer is re-derived by its own solver
+        wrapper, not by re-solving an MST).
         """
         mode = self.policy.mode_for(cls)
         if mode == "off":
             return result, None
         if mode == "sample":
             if self.policy.should_sample(cls):
-                self.auditor.submit(result, cls=cls, key=key)
+                self.auditor.submit(
+                    result, cls=cls, key=key, certify=certify
+                )
                 return result, "audit"
             return result, None
         # mode == "full": inline, with transparent correction.
-        cert = certify_result(result, engine=self.policy.engine)
+        check_fn = certify or certify_result
+        cert = check_fn(result, engine=self.policy.engine)
         if cert.ok:
             BUS.count("verify.pass")
             return result, "full"
@@ -297,13 +335,16 @@ class ResultVerifier:
         )
         if self.invalidate is not None:
             self.invalidate(key, result.graph.digest())
-        if self.resolve is None:
+        if rederive is None and self.resolve is None:
             raise VerificationError(
                 f"certificate failed ({cert.reason}: {cert.detail}) and no "
                 f"re-solve path is attached"
             )
-        corrected = self.resolve(result.graph, backend)
-        recheck = certify_result(corrected, engine=self.policy.engine)
+        if rederive is not None:
+            corrected = rederive()
+        else:
+            corrected = self.resolve(result.graph, backend)
+        recheck = check_fn(corrected, engine=self.policy.engine)
         if not recheck.ok:
             BUS.count("verify.unrecoverable")
             raise VerificationError(

@@ -1,0 +1,444 @@
+"""Analytics front door (round 22, docs/ANALYTICS.md): the kind registry,
+per-kind solvers vs their NetworkX oracles, per-kind store isolation, the
+kind-aware serve protocol and probe derivation rules, the verify adapters,
+batch kind-homogeneity, and the promoted public helpers."""
+
+import os
+
+import numpy as np
+import pytest
+
+from distributed_ghs_implementation_tpu import analytics
+from distributed_ghs_implementation_tpu.analytics import solvers as asolvers
+from distributed_ghs_implementation_tpu.api import minimum_spanning_forest
+from distributed_ghs_implementation_tpu.graphs.edgelist import Graph
+from distributed_ghs_implementation_tpu.graphs.generators import gnm_random_graph
+from distributed_ghs_implementation_tpu.obs.events import BUS
+from distributed_ghs_implementation_tpu.serve.service import MSTService
+from distributed_ghs_implementation_tpu.serve.store import (
+    ResultStore,
+    cache_key_for_digest,
+    solve_cache_key,
+)
+
+
+@pytest.fixture(autouse=True)
+def _clean_global_bus():
+    BUS.enable()
+    BUS.clear()
+    yield
+    BUS.enable()
+    BUS.clear()
+
+
+def _edges(g):
+    return [[int(a), int(b), int(c)] for a, b, c in zip(g.u, g.v, g.w)]
+
+
+def _host_solve(g):
+    return minimum_spanning_forest(g, backend="host"), "solved"
+
+
+def _ragged_graph(seed: int) -> Graph:
+    """Two random blocks plus isolated tail nodes — multi-component on
+    purpose, so partition/k-forest edge cases are exercised."""
+    a = gnm_random_graph(30, 70, seed=seed)
+    b = gnm_random_graph(20, 45, seed=seed + 1)
+    u = np.concatenate([a.u, b.u + a.num_nodes])
+    v = np.concatenate([a.v, b.v + a.num_nodes])
+    w = np.concatenate([a.w, b.w])
+    return Graph.from_arrays(a.num_nodes + b.num_nodes + 2, u, v, w)
+
+
+# ----------------------------------------------------------------------
+# Registry
+# ----------------------------------------------------------------------
+def test_registry_kinds_and_unknown_error():
+    assert analytics.known() == (
+        "mst", "components", "k_msf", "bottleneck", "path_max"
+    )
+    assert analytics.get(None).name == "mst"  # the historical default
+    with pytest.raises(ValueError, match="unknown kind"):
+        analytics.get("diameter")
+    # Registry rows resolve to real callables without eager jax imports.
+    spec = analytics.get("components")
+    assert spec.solver is asolvers.solve_components
+    assert spec.oracle is asolvers.oracle_components
+    assert spec.slo_class == "components"
+    assert analytics.get("mst").slo_class is None  # telemetry back-compat
+
+
+def test_cache_tokens_and_param_validation():
+    assert analytics.cache_token("mst") == "mst"
+    assert analytics.cache_token("components") == "components"
+    assert analytics.cache_token("k_msf", k=4) == "k_msf4"
+    assert analytics.cache_token("path_max") is None  # never store-cached
+    assert analytics.parse_params("k_msf", {"k": "3"}) == {"k": 3}
+    with pytest.raises(ValueError, match="integer 'k'"):
+        analytics.parse_params("k_msf", {})
+    with pytest.raises(ValueError, match="k must be >= 1"):
+        analytics.parse_params("k_msf", {"k": 0})
+    with pytest.raises(ValueError, match="'u' and 'v'"):
+        analytics.parse_params("path_max", {"u": 1})
+    # Kind tokens become disk filenames: non-filename-safe tokens refuse.
+    with pytest.raises(ValueError, match="bad cache kind token"):
+        cache_key_for_digest("d" * 8, kind="k-msf:4")
+
+
+# ----------------------------------------------------------------------
+# Solvers vs NetworkX oracles
+# ----------------------------------------------------------------------
+@pytest.mark.parametrize("seed", [0, 7])
+def test_components_solver_matches_networkx(seed):
+    g = _ragged_graph(seed)
+    result, _src = asolvers.solve_components(g, _host_solve)
+    assert result.graph is g  # kind entries digest-validate as the original
+    served = asolvers.partition_from_labels(asolvers.labels_for_forest(result))
+    assert served == asolvers.oracle_components(g)
+    # The forest is a complete certificate of its own partition.
+    from distributed_ghs_implementation_tpu.verify.certify import (
+        certify_components,
+    )
+
+    cert = certify_components(
+        g, result.edge_ids, expect_components=result.num_components
+    )
+    assert cert.ok, cert.detail
+
+
+@pytest.mark.parametrize("k", [1, 2, 3, 10, 52])
+def test_k_msf_solver_matches_oracle(k):
+    g = _ragged_graph(3)
+    trimmed, _src, full = asolvers.solve_k_msf(g, _host_solve, k)
+    want = asolvers.oracle_k_msf_weight(g, k)
+    assert int(g.w[trimmed.edge_ids].sum()) == want
+    # k' = min(n, max(k, c)): never fewer parts than the graph has.
+    assert trimmed.num_components == min(
+        g.num_nodes, max(k, full.num_components)
+    )
+
+
+def test_k_msf_early_exit_counterexample():
+    # Borůvka's level 1 adds MOEs {1, 2, 10} and reaches exactly 3
+    # fragments with weight 13 — but the optimal 3-forest weighs 8 (the
+    # lightest 3 of the 4 MSF edges). Trimming must find 8, proving the
+    # early-exit shortcut is not what ships.
+    g = Graph.from_edges(
+        6, [(0, 1, 1), (2, 3, 2), (0, 2, 5), (4, 5, 10)]
+    )
+    trimmed, _src, _full = asolvers.solve_k_msf(g, _host_solve, 3)
+    total = int(g.w[trimmed.edge_ids].sum())
+    assert total == asolvers.oracle_k_msf_weight(g, 3) == 8
+    assert total != 13
+
+
+def test_bottleneck_and_path_max_match_oracle():
+    g = _ragged_graph(11)
+    _res, _src, bn = asolvers.solve_bottleneck(g, _host_solve)
+    assert bn is not None and bn[0] == asolvers.oracle_bottleneck(g)
+
+    result, _src2, _ = asolvers.solve_path_max(g, _host_solve, 0, 0)
+    rng = np.random.default_rng(5)
+    pairs = [(0, 1), (0, g.num_nodes - 1), (2, 2)] + [
+        tuple(int(x) for x in rng.integers(0, g.num_nodes, 2))
+        for _ in range(6)
+    ]
+    for u, v in pairs:
+        got = asolvers.path_max_of(result, u, v)
+        want = asolvers.oracle_path_max(g, u, v)
+        assert got["connected"] == want["connected"], (u, v)
+        assert got["weight"] == want["weight"], (u, v)
+    with pytest.raises(ValueError, match="out of range"):
+        asolvers.path_max_of(result, 0, g.num_nodes)
+
+
+# ----------------------------------------------------------------------
+# Per-kind store isolation (satellite: keys must not collide)
+# ----------------------------------------------------------------------
+def test_store_per_kind_entries_and_disk_files(tmp_path):
+    g = gnm_random_graph(40, 90, seed=5)
+    mst = minimum_spanning_forest(g, backend="host")
+    comp, _src = asolvers.solve_components(g, _host_solve)
+    k2, _src2, _full = asolvers.solve_k_msf(g, _host_solve, 2)
+
+    store = ResultStore(capacity=8, disk_dir=str(tmp_path))
+    mst_key = solve_cache_key(g, backend="host")
+    comp_key = solve_cache_key(g, backend="host", kind="components")
+    k2_key = solve_cache_key(g, backend="host", kind="k_msf2")
+    assert len({mst_key, comp_key, k2_key}) == 3
+    assert comp_key == mst_key + ":components"  # mst keeps the 2-segment key
+
+    store.put(mst_key, mst)
+    store.put(comp_key, comp)
+    store.put(k2_key, k2)
+    assert len(store) == 3
+    # One npz + integrity sidecar per kind on disk.
+    for key in (mst_key, comp_key, k2_key):
+        path = os.path.join(str(tmp_path), key.replace(":", "_") + ".npz")
+        assert os.path.exists(path), key
+        assert os.path.exists(path + ".sha256"), key
+
+    # Each key round-trips ITS OWN edge set through a cold store.
+    cold = ResultStore(capacity=8, disk_dir=str(tmp_path))
+    for key, put in ((mst_key, mst), (comp_key, comp), (k2_key, k2)):
+        got = cold.get(key, g)
+        assert got is not None and np.array_equal(got.edge_ids, put.edge_ids)
+
+    # evict_chain on the base key drops the kind siblings with it.
+    assert store.evict_chain(mst_key)
+    assert len(store) == 0
+    assert BUS.counters().get("serve.store.chain_evicted", 0) == 3
+
+    # Quarantining one kind's entry leaves the other kinds servable.
+    assert store.invalidate(comp_key, reason="test poison")
+    fresh = ResultStore(capacity=8, disk_dir=str(tmp_path))
+    assert fresh.get(comp_key, g) is None
+    assert fresh.get(mst_key, g) is not None
+    assert fresh.get(k2_key, g) is not None
+
+
+# ----------------------------------------------------------------------
+# Service protocol: kinds end to end
+# ----------------------------------------------------------------------
+def test_service_answers_every_kind_oracle_exact():
+    svc = MSTService()
+    g = _ragged_graph(21)
+    base = {"op": "solve", "num_nodes": g.num_nodes, "edges": _edges(g)}
+
+    comp = svc.handle({**base, "kind": "components", "labels_out": True})
+    assert comp["ok"] and comp["kind"] == "components"
+    assert comp["slo_class"] == "components"  # the kind's default class
+    assert (
+        asolvers.partition_from_labels(comp["labels"])
+        == asolvers.oracle_components(g)
+    )
+    assert comp["num_components"] == len(asolvers.oracle_components(g))
+
+    kf = svc.handle({**base, "kind": "k_msf", "k": 3})
+    assert kf["ok"] and kf["k"] == 3 and kf["slo_class"] == "k_msf"
+    assert kf["total_weight"] == asolvers.oracle_k_msf_weight(g, 3)
+
+    bn = svc.handle({**base, "kind": "bottleneck"})
+    assert bn["ok"] and bn["slo_class"] == "bottleneck"
+    assert bn["bottleneck_weight"] == asolvers.oracle_bottleneck(g)
+
+    pm = svc.handle({**base, "kind": "path_max", "u": 0, "v": g.num_nodes - 1})
+    want = asolvers.oracle_path_max(g, 0, g.num_nodes - 1)
+    assert pm["ok"] and pm["slo_class"] == "path_max"
+    assert pm["connected"] == want["connected"]
+    assert pm["path_max_weight"] == want["weight"]
+
+    # Untagged mst stays untagged; an explicit class beats the default.
+    mst = svc.handle(dict(base))
+    assert mst["ok"] and "slo_class" not in mst
+    gold = svc.handle({**base, "kind": "components", "slo_class": "gold"})
+    assert gold["slo_class"] == "gold"
+
+    counters = BUS.counters()
+    for kind in ("components", "k_msf", "bottleneck", "path_max"):
+        assert counters.get(f"serve.kind.{kind}", 0) >= 1, kind
+    assert counters.get("serve.kind.mst", 0) == 1
+
+
+def test_service_unknown_kind_and_unknown_op():
+    svc = MSTService()
+    g = gnm_random_graph(10, 20, seed=1)
+    bad = svc.handle({
+        "op": "solve", "kind": "diameter",
+        "num_nodes": g.num_nodes, "edges": _edges(g),
+    })
+    assert not bad["ok"] and "unknown kind" in bad["error"]
+    assert "path_max" in bad["error"]  # the full accepted list is named
+    nop = svc.handle({"op": "solv"})
+    assert not nop["ok"] and "unknown op" in nop["error"]
+    assert "solve" in nop["error"] and "update" in nop["error"]
+    # Malformed kind params error client-side, before any solving.
+    fresh = BUS.counters().get("serve.scheduler.fresh_solve", 0)
+    nok = svc.handle({
+        "op": "solve", "kind": "k_msf",
+        "num_nodes": g.num_nodes, "edges": _edges(g),
+    })
+    assert not nok["ok"] and "integer 'k'" in nok["error"]
+    assert BUS.counters().get("serve.scheduler.fresh_solve", 0) == fresh
+
+
+def test_service_kind_cache_keys_do_not_collide():
+    svc = MSTService()
+    g = gnm_random_graph(50, 130, seed=9)
+    base = {"op": "solve", "num_nodes": g.num_nodes, "edges": _edges(g)}
+    first = svc.handle({**base, "kind": "components"})
+    assert first["ok"] and not first["cached"]
+    # Same digest, different kind: MUST miss the components entry.
+    mst = svc.handle(dict(base))
+    assert mst["ok"] and not mst["cached"]
+    again = svc.handle({**base, "kind": "components"})
+    assert again["ok"] and again["cached"]
+    assert again["num_components"] == first["num_components"]
+
+
+def test_service_kind_probe_derivation_rules():
+    svc = MSTService()
+    g = gnm_random_graph(45, 120, seed=14)
+    solved = svc.handle(
+        {"op": "solve", "num_nodes": g.num_nodes, "edges": _edges(g)}
+    )
+    digest = solved["digest"]
+
+    def probe(kind, **extra):
+        return svc.handle({
+            "op": "solve", "cached_only": True, "digest": digest,
+            "kind": kind, **extra,
+        })
+
+    fresh = BUS.counters().get("serve.scheduler.fresh_solve", 0)
+    # Derived kinds answer from the cached mst entry without solving...
+    bn = probe("bottleneck")
+    assert bn["ok"] and bn["bottleneck_weight"] == asolvers.oracle_bottleneck(g)
+    pm = probe("path_max", u=0, v=g.num_nodes - 1)
+    assert pm["ok"]
+    assert pm["path_max_weight"] == asolvers.oracle_path_max(
+        g, 0, g.num_nodes - 1
+    )["weight"]
+    kf = probe("k_msf", k=2)
+    assert kf["ok"] and kf["total_weight"] == asolvers.oracle_k_msf_weight(g, 2)
+    # ... components never derives: its canonical entry is a different
+    # edge set, so an mst-only digest is a kind miss, not a wrong answer.
+    cp = probe("components")
+    assert not cp["ok"] and cp.get("cache_miss")
+    counters = BUS.counters()
+    assert counters.get("serve.probe.hit", 0) == 3
+    assert counters.get("serve.probe.miss", 0) == 1
+    assert counters.get("serve.scheduler.fresh_solve", 0) == fresh  # no solves
+
+    # After a full components solve the kind probe hits its own key.
+    svc.handle({
+        "op": "solve", "kind": "components",
+        "num_nodes": g.num_nodes, "edges": _edges(g),
+    })
+    cp2 = probe("components")
+    assert cp2["ok"] and cp2["cached"]
+
+
+# ----------------------------------------------------------------------
+# Verify adapters
+# ----------------------------------------------------------------------
+def test_certify_components_failure_modes():
+    from distributed_ghs_implementation_tpu.verify.certify import (
+        certify_components,
+    )
+
+    g = Graph.from_edges(3, [(0, 1, 1), (1, 2, 2)])
+    # A valid but NON-MAXIMAL forest: {0-1} leaves graph edge 1-2
+    # crossing two claimed components.
+    cert = certify_components(g, np.array([0]))
+    assert not cert.ok and cert.reason == "cross_edge"
+    # Metadata disagreeing with the certified count fails too.
+    cert = certify_components(g, np.array([0, 1]), expect_components=2)
+    assert not cert.ok and cert.reason == "metadata_mismatch"
+    cert = certify_components(g, np.array([0, 1]), expect_components=1)
+    assert cert.ok
+
+
+def test_certify_k_forest_failure_modes():
+    from distributed_ghs_implementation_tpu.verify.certify import (
+        certify_k_forest,
+    )
+
+    g = Graph.from_edges(4, [(0, 1, 1), (1, 2, 2), (2, 3, 3), (0, 3, 4)])
+    # Canonical (u, v)-sorted ids: 0=(0,1,w1) 1=(0,3,w4) 2=(1,2,w2)
+    # 3=(2,3,w3). MSF = {w1, w2, w3}; the optimal 2-forest is {w1, w2}.
+    good = certify_k_forest(g, np.array([0, 2]), 2)
+    assert good.ok, good.detail
+    wrong_size = certify_k_forest(g, np.array([0]), 2)
+    assert not wrong_size.ok and wrong_size.reason == "not_spanning"
+    # Right size, wrong edges: {w1, w3} is not the rank-prefix MSF.
+    not_optimal = certify_k_forest(g, np.array([0, 3]), 2)
+    assert not not_optimal.ok
+    assert "k_msf prefix subgraph" in not_optimal.detail
+
+
+def test_certify_bottleneck_scalar_mismatch():
+    from distributed_ghs_implementation_tpu.verify.certify import (
+        certify_bottleneck,
+    )
+
+    g = Graph.from_edges(3, [(0, 1, 1), (1, 2, 5), (0, 2, 7)])
+    ids = minimum_spanning_forest(g, backend="host").edge_ids
+    assert certify_bottleneck(g, ids, bottleneck_weight=5).ok
+    bad = certify_bottleneck(g, ids, bottleneck_weight=7)
+    assert not bad.ok and bad.reason == "weight_mismatch"
+
+
+def test_certify_claim_kind_dispatch():
+    from distributed_ghs_implementation_tpu.verify.certify import certify_claim
+
+    g = _ragged_graph(31)
+    comp, _src = asolvers.solve_components(g, _host_solve)
+    pairs = [
+        [int(a), int(b)]
+        for a, b in zip(g.u[comp.edge_ids], g.v[comp.edge_ids])
+    ]
+    cert = certify_claim(
+        g.num_nodes, _edges(g), pairs,
+        kind="components", num_components=comp.num_components,
+    )
+    assert cert.ok, cert.detail
+    lying = certify_claim(
+        g.num_nodes, _edges(g), pairs,
+        kind="components", num_components=comp.num_components + 1,
+    )
+    assert not lying.ok
+    missing_k = certify_claim(g.num_nodes, _edges(g), pairs, kind="k_msf")
+    assert not missing_k.ok and missing_k.reason == "malformed_claim"
+
+
+# ----------------------------------------------------------------------
+# Batch lanes stay kind-homogeneous
+# ----------------------------------------------------------------------
+def test_batch_forming_splits_lanes_by_kind():
+    from distributed_ghs_implementation_tpu.batch.engine import (
+        BatchEngine,
+        BatchPolicy,
+        PendingSolve,
+    )
+    from distributed_ghs_implementation_tpu.obs.slo import tagged_kind
+
+    engine = BatchEngine(policy=BatchPolicy(max_lanes=2))
+    graphs = [gnm_random_graph(60, 150, seed=s) for s in range(4)]
+    pending = []
+    for i, g in enumerate(graphs):  # interleave mst / components submits
+        with tagged_kind(None if i % 2 == 0 else "components"):
+            pending.append(PendingSolve(g))
+    engine._queue = list(pending)
+    batch = engine._take_batch()
+    # Four same-bucket solves are queued, but a lane never mixes kinds.
+    assert batch is not None and len(batch) == 2
+    assert len({p.kind for p in batch}) == 1
+
+
+# ----------------------------------------------------------------------
+# Promoted public helpers (satellite 1)
+# ----------------------------------------------------------------------
+def test_promoted_helpers_are_public_with_aliases():
+    from distributed_ghs_implementation_tpu import serve
+    from distributed_ghs_implementation_tpu.serve import dynamic
+
+    assert serve.components_via_unionfind is dynamic.components_via_unionfind
+    assert serve.tree_path_max is dynamic.tree_path_max
+    # The historical private names stay importable as exact aliases.
+    assert dynamic._components_via_unionfind is dynamic.components_via_unionfind
+    assert dynamic._tree_path_max is dynamic.tree_path_max
+
+    labels = serve.components_via_unionfind(
+        5, np.array([0, 2]), np.array([1, 3])
+    )
+    assert labels.shape == (5,)
+    assert labels[0] == labels[1] and labels[2] == labels[3]
+    assert len({int(labels[0]), int(labels[2]), int(labels[4])}) == 3
+
+    tu = np.array([0, 1])
+    tv = np.array([1, 2])
+    tw = np.array([5, 3])
+    assert serve.tree_path_max(3, tu, tv, tw, 0, 2) == 0  # w=5 edge
+    assert serve.tree_path_max(3, tu, tv, tw, 1, 1) is None
+    assert serve.tree_path_max(4, tu, tv, tw, 0, 3) is None  # disconnected

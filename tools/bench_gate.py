@@ -173,6 +173,35 @@ KINDS = {
     "audit_failed": "exact",
     "mutation_rejected": "exact",
     "verify_failed_clean": "exact",
+    # gate-analytics-v1 (tools/load_drill.py --kinds-mixed): the analytics
+    # front door gates PER KIND — wrong_<kind> is the silent-wrong-answer
+    # failure mode reborn in that query class (a wrong components
+    # partition or minimax value is exactly as disqualifying as a wrong
+    # MST weight), so every one is an exact zero. The served/probe/store
+    # counts are deterministic for the seeded deck: a changed count means
+    # the per-kind cache keys, the probe derivation rules, or the
+    # update-path cache sharing changed — never jitter. <kind>_p50_s
+    # latencies need no override (the _s suffix gates them as wall-time
+    # ceilings); wrong_results / verify_failed / verify_corrected are
+    # already exact above.
+    "wrong_mst": "exact",
+    "wrong_components": "exact",
+    "wrong_k_msf": "exact",
+    "wrong_bottleneck": "exact",
+    "wrong_path_max": "exact",
+    "served_mst": "exact",
+    "served_components": "exact",
+    "served_k_msf": "exact",
+    "served_bottleneck": "exact",
+    "served_path_max": "exact",
+    "hit_leg_fresh_solves": "exact",
+    "probe_hits": "exact",
+    "probe_misses": "exact",
+    "store_files": "exact",
+    "update_streams": "exact",
+    "update_mst_hits": "exact",
+    "fleet_served": "exact",
+    "fleet_wrong_results": "exact",
     # gate-trace-v1 (tools/load_drill.py --trace-dir): the trace-join
     # contract is exact — every rooted trace in the merged multi-process
     # trace must resolve each of its spans to a parent (orphan_spans is a

@@ -107,6 +107,7 @@ WORKLOAD_FLEET_ROUTER = "gate-fleet-router-v1"
 WORKLOAD_FLEET_PARTITION = "gate-fleet-partition-v1"
 WORKLOAD_OVERSIZE = "gate-oversize-v1"
 WORKLOAD_VERIFY = "gate-verify-v1"
+WORKLOAD_KINDS = "gate-analytics-v1"
 WORKLOAD_STREAM = "gate-stream-v1"
 WORKLOAD_STREAM_FLEET = "gate-stream-fleet-v1"
 WORKLOAD_STREAM_KILL = "gate-stream-kill-v1"
@@ -116,6 +117,11 @@ DEFAULT_BASELINE = os.path.join(
     os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
     "docs",
     "BENCH_BASELINE_LOAD.json",
+)
+ANALYTICS_BASELINE = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    "docs",
+    "BENCH_BASELINE_ANALYTICS.json",
 )
 
 # Shape buckets the deck draws from (nodes, edges): hit/miss/batch classes
@@ -2264,6 +2270,443 @@ def run_corrupt_drill(args) -> dict:
     }
 
 
+# ----------------------------------------------------------------------
+# Analytics drill (gate-analytics-v1): every query kind, oracle-exact
+# ----------------------------------------------------------------------
+ANALYTICS_KINDS = ("mst", "components", "k_msf", "bottleneck", "path_max")
+ANALYTICS_K = 3  # the deck's k-MSF target fragment count
+
+
+def _kind_request(g, kind: str, cls: Optional[str]) -> dict:
+    """A full solve request for ``kind`` over ``g``. ``cls=None`` drops the
+    ``slo_class`` tag so the service applies the kind's own default class
+    (``obs.slo.KIND_CLASS_DEFAULTS`` — part of what the drill exercises).
+    ``path_max`` endpoints are pinned at ``(0, n-1)``: deterministic, and
+    disconnected by construction on the two-block graphs."""
+    req = _graph_request(g, cls or "miss")
+    if cls is None:
+        del req["slo_class"]
+    if kind != "mst":
+        req["kind"] = kind
+    if kind == "components":
+        req["labels_out"] = True
+    elif kind == "k_msf":
+        req["k"] = ANALYTICS_K
+    elif kind == "path_max":
+        req["u"], req["v"] = 0, g.num_nodes - 1
+    return req
+
+
+def _two_block_graph(seed: int):
+    """Deliberately disconnected deck member: two G(n,m) blocks plus three
+    isolated tail nodes — the non-mst kinds then see real forests (multi-
+    component partitions, the relaxed k-forest spanning predicate, and a
+    disconnected ``path_max`` endpoint pair)."""
+    from distributed_ghs_implementation_tpu.graphs.edgelist import Graph
+    from distributed_ghs_implementation_tpu.graphs.generators import (
+        gnm_random_graph,
+    )
+
+    a = gnm_random_graph(40, 110, seed=seed)
+    b = gnm_random_graph(30, 80, seed=seed + 17)
+    return Graph.from_arrays(
+        a.num_nodes + b.num_nodes + 3,
+        np.concatenate([a.u, b.u + a.num_nodes]),
+        np.concatenate([a.v, b.v + a.num_nodes]),
+        np.concatenate([a.w, b.w]),
+    )
+
+
+def _kind_oracles(g) -> dict:
+    """Per-kind NetworkX ground truth for one graph — every served answer
+    in every leg is compared against these, EXACTLY (each oracle answers in
+    a tie-independent representation; see analytics/solvers.py)."""
+    from distributed_ghs_implementation_tpu.analytics import (
+        solvers as asolvers,
+    )
+    from distributed_ghs_implementation_tpu.utils.verify import (
+        networkx_mst_weight,
+    )
+
+    parts = asolvers.oracle_components(g)
+    return {
+        "mst": networkx_mst_weight(g),
+        "components": parts,
+        "k_eff": min(g.num_nodes, max(ANALYTICS_K, len(parts))),
+        "k_msf": asolvers.oracle_k_msf_weight(g, ANALYTICS_K),
+        "bottleneck": asolvers.oracle_bottleneck(g),
+        "path_max": asolvers.oracle_path_max(g, 0, g.num_nodes - 1),
+    }
+
+
+def run_kinds_drill(args) -> dict:
+    """The analytics drill (``gate-analytics-v1``): all five query kinds
+    served through the real front door, every answer checked EXACTLY
+    against its NetworkX oracle (``wrong_results == 0`` gates per kind —
+    a wrong components partition or minimax value is the silent-wrong-MST
+    failure mode reborn in a new query class). Five legs:
+
+    A. **Miss** — a seeded pool (connected + deliberately disconnected
+       graphs) queried with every kind through a verify-enabled disk-store
+       service; per-kind p50 solve latency recorded client-side.
+    B. **Hit** — the full deck repeated: every answer must come from cache
+       (zero fresh solves, EXACT) and still match its oracle — the
+       per-kind keys must hand back the RIGHT kind's entry.
+    C. **Probes + store isolation** — ``cached_only`` probes per kind
+       (the fleet's forwarding frame): all five hit kind-correctly on a
+       fully-queried digest; on an mst-only digest the ``components``
+       probe must MISS (per-kind keys never collide, and components never
+       derives) while the derivable kinds answer from the mst entry; a
+       fresh service on the same directory disk-hits a kind entry; the
+       store's npz census is exact (per-kind files per digest).
+    D. **Update** — reweight windows through ``op: update``; the digest
+       chain is validated against a client-side rebuild, the updated mst
+       entry must answer the post-update mst query from cache, and every
+       kind is re-checked against fresh oracles of the mutated graph
+       (components rides the unchanged connectivity twin's cache — the
+       deliberate cross-kind affinity).
+    E. **Fleet** — a 2-worker pipe fleet with response verification ON:
+       all five kinds answer through the router (``certify_claim``'s
+       per-kind adapters certify each payload router-side), plus a repeat
+       to prove cross-request affinity inside the fleet.
+    """
+    import tempfile
+
+    from distributed_ghs_implementation_tpu.analytics import (
+        solvers as asolvers,
+    )
+    from distributed_ghs_implementation_tpu.graphs.edgelist import Graph
+    from distributed_ghs_implementation_tpu.graphs.generators import (
+        gnm_random_graph,
+    )
+    from distributed_ghs_implementation_tpu.obs.events import BUS, quantile
+    from distributed_ghs_implementation_tpu.serve.service import MSTService
+
+    BUS.enable()
+    BUS.clear()
+    t_start = time.perf_counter()
+    checks: List[dict] = []
+
+    def check(name: str, ok: bool, detail: str = "") -> None:
+        checks.append({"name": name, "ok": bool(ok), "detail": str(detail)})
+        if not ok:
+            print(f"CHECK FAIL {name}: {detail}", file=sys.stderr)
+
+    spec = args.verify or "full"
+    store_dir = tempfile.mkdtemp(prefix="ghs-analytics-store-")
+    pool = [
+        gnm_random_graph(90, 260, seed=args.seed + 900 + i)
+        for i in range(4)
+    ] + [
+        _two_block_graph(args.seed + 950),
+        _two_block_graph(args.seed + 975),
+    ]
+    U = 2  # update streams (leg D)
+
+    wrong = {k: 0 for k in ANALYTICS_KINDS}
+    served = {k: 0 for k in ANALYTICS_KINDS}
+
+    def check_kind(resp: dict, kind: str, oracles: dict, where: str) -> bool:
+        served[kind] += 1
+        good = bool(resp.get("ok"))
+        if kind == "mst":
+            good = good and resp.get("total_weight") == oracles["mst"]
+        elif kind == "components":
+            got = asolvers.partition_from_labels(resp.get("labels") or [])
+            good = good and (
+                resp.get("num_components") == len(oracles["components"])
+                and got == oracles["components"]
+            )
+        elif kind == "k_msf":
+            good = good and (
+                resp.get("total_weight") == oracles["k_msf"]
+                and resp.get("num_components") == oracles["k_eff"]
+                and resp.get("k") == ANALYTICS_K
+            )
+        elif kind == "bottleneck":
+            good = good and (
+                resp.get("bottleneck_weight") == oracles["bottleneck"]
+            )
+        else:  # path_max — compare the minimax VALUE (the edge can tie)
+            pm = oracles["path_max"]
+            good = good and (
+                resp.get("connected") == pm["connected"]
+                and resp.get("path_max_weight") == pm["weight"]
+            )
+        if not good:
+            wrong[kind] += 1
+            print(
+                f"WRONG RESULT [{where}/{kind}]: "
+                f"{json.dumps(resp, default=str)[:400]}",
+                file=sys.stderr,
+            )
+        return good
+
+    # -- A: miss leg — every kind, oracle-checked, latency-sampled -------
+    svc = MSTService(backend="device", disk_dir=store_dir, verify=spec)
+    lat = {k: [] for k in ANALYTICS_KINDS}
+    oracle_of = {}  # digest of pool[i] -> its oracle dict
+    for g in pool:
+        oracles = _kind_oracles(g)
+        oracle_of[g.digest()] = oracles
+        for kind in ANALYTICS_KINDS:
+            t0 = time.perf_counter()
+            resp = svc.handle(
+                _kind_request(g, kind, "miss" if kind == "mst" else None)
+            )
+            lat[kind].append(time.perf_counter() - t0)
+            check_kind(resp, kind, oracles, "miss")
+            if spec == "full" and resp.get("verified") != "full":
+                check("miss.verified_full", False,
+                      f"{kind}: verified={resp.get('verified')}")
+    check(
+        "miss.served_exact",
+        all(wrong[k] == 0 for k in ANALYTICS_KINDS),
+        f"wrong={wrong}",
+    )
+
+    # -- B: hit leg — cached answers, kind-correct, zero fresh solves ----
+    pre = dict(BUS.counters())
+    uncached = 0
+    for g in pool:
+        oracles = oracle_of[g.digest()]
+        for kind in ANALYTICS_KINDS:
+            resp = svc.handle(_kind_request(g, kind, "hit"))
+            check_kind(resp, kind, oracles, "hit")
+            if not resp.get("cached"):
+                uncached += 1
+    hit_fresh = int(
+        BUS.counters().get("serve.scheduler.fresh_solve", 0)
+        - pre.get("serve.scheduler.fresh_solve", 0)
+    )
+    check("hit.all_cached", uncached == 0, f"uncached={uncached}")
+    check("hit.zero_fresh_solves", hit_fresh == 0, f"fresh={hit_fresh}")
+
+    # -- C: kind probes + store isolation --------------------------------
+    pre = dict(BUS.counters())
+    d0 = pool[0].digest()
+    oracles0 = oracle_of[d0]
+
+    def _probe(svc_, digest: str, kind: str, n: int) -> dict:
+        req = {"op": "solve", "cached_only": True, "digest": digest}
+        if kind != "mst":
+            req["kind"] = kind
+        if kind == "components":
+            req["labels_out"] = True
+        elif kind == "k_msf":
+            req["k"] = ANALYTICS_K
+        elif kind == "path_max":
+            req["u"], req["v"] = 0, n - 1
+        return svc_.handle(req)
+
+    for kind in ANALYTICS_KINDS:
+        resp = _probe(svc, d0, kind, pool[0].num_nodes)
+        check_kind(resp, kind, oracles0, "probe")
+
+    # An mst-only digest: the components probe must MISS (per-kind keys
+    # never collide with the mst entry, and components never derives —
+    # its canonical cache entry is the connectivity forest); the derived
+    # kinds answer from the cached mst entry without solving.
+    g_extra = gnm_random_graph(70, 200, seed=args.seed + 990)
+    oracles_extra = _kind_oracles(g_extra)
+    resp = svc.handle(_kind_request(g_extra, "mst", "miss"))
+    check_kind(resp, "mst", oracles_extra, "extra")
+    d_extra = resp["digest"]
+    resp = _probe(svc, d_extra, "components", g_extra.num_nodes)
+    check(
+        "probe.components_no_collision",
+        not resp.get("ok") and resp.get("cache_miss") is True,
+        f"components probe on an mst-only digest answered: {resp}",
+    )
+    for kind in ("k_msf", "bottleneck", "path_max"):
+        resp = _probe(svc, d_extra, kind, g_extra.num_nodes)
+        check_kind(resp, kind, oracles_extra, "probe-derive")
+
+    delta = {
+        k: BUS.counters().get(k, 0) - pre.get(k, 0)
+        for k in ("serve.probe.hit", "serve.probe.miss")
+    }
+    probe_hits = int(delta["serve.probe.hit"])
+    probe_misses = int(delta["serve.probe.miss"])
+    check(
+        "probe.counts_exact",
+        probe_hits == 8 and probe_misses == 1,
+        f"hits={probe_hits} misses={probe_misses} expected 8/1",
+    )
+
+    # A fresh service on the same directory must answer a kind query from
+    # the DISK layer (a full request, not a probe: the disk round trip
+    # needs the graph to rebuild the result) — with zero fresh solves.
+    svc2 = MSTService(backend="device", disk_dir=store_dir, verify=spec)
+    pre2 = dict(BUS.counters())
+    resp = svc2.handle(_kind_request(pool[0], "components", "hit"))
+    check_kind(resp, "components", oracles0, "disk-restart")
+    disk_delta = {
+        k: BUS.counters().get(k, 0) - pre2.get(k, 0)
+        for k in ("serve.store.disk_hit", "serve.scheduler.fresh_solve")
+    }
+    check(
+        "restart.kind_disk_hit",
+        disk_delta["serve.store.disk_hit"] == 1
+        and disk_delta["serve.scheduler.fresh_solve"] == 0
+        and bool(resp.get("cached")),
+        f"{disk_delta} cached={resp.get('cached')}",
+    )
+
+    # -- D: update leg — digest chain + post-update kind queries ---------
+    update_mst_hits = 0
+    for si, g in enumerate(pool[:U]):
+        rngu = np.random.default_rng(args.seed + 1300 + si)
+        idx = rngu.choice(g.num_edges, size=3, replace=False)
+        w2 = g.w.copy()
+        updates = []
+        for j in idx:
+            new_w = int(w2[j]) + 7 + si
+            w2[j] = new_w
+            updates.append({
+                "kind": "reweight",
+                "u": int(g.u[j]), "v": int(g.v[j]), "w": new_w,
+            })
+        resp = svc.handle({
+            "op": "update", "digest": g.digest(), "updates": updates,
+            "slo_class": "update",
+        })
+        g2 = Graph.from_arrays(g.num_nodes, g.u, g.v, w2)
+        check(
+            f"update.digest_chain.{si}",
+            bool(resp.get("ok")) and resp.get("digest") == g2.digest(),
+            f"server {resp.get('digest')} vs client {g2.digest()}",
+        )
+        oracles2 = _kind_oracles(g2)
+        for kind in ANALYTICS_KINDS:
+            resp2 = svc.handle(_kind_request(g2, kind, "update"))
+            check_kind(resp2, kind, oracles2, f"post-update/{si}")
+            if kind == "mst" and resp2.get("cached"):
+                update_mst_hits += 1
+    check(
+        "update.mst_served_from_update_cache",
+        update_mst_hits == U,
+        f"cached mst answers post-update: {update_mst_hits}/{U}",
+    )
+
+    # Store census, EXACT: per pool digest {mst, components kind entry,
+    # k_msf kind entry, connectivity-twin mst} = 4 files; the extra graph
+    # adds its mst file; each update stream adds {updated mst, components
+    # kind, k_msf kind} = 3 — the twin is reweight-invariant (same
+    # endpoints, index weights), so its phase-A entry is REUSED, and
+    # bottleneck/path_max never store separately. Probe-derived k_msf
+    # entries are memory-only by design.
+    n_files = sum(
+        1 for e in os.scandir(store_dir) if e.name.endswith(".npz")
+    )
+    expected_files = 4 * len(pool) + 1 + 3 * U
+    check(
+        "store.per_kind_census_exact",
+        n_files == expected_files,
+        f"{n_files} npz files, expected {expected_files}",
+    )
+
+    # -- E: fleet leg — all kinds through the router, certified ----------
+    from distributed_ghs_implementation_tpu.fleet.router import (
+        FleetConfig,
+        FleetRouter,
+    )
+
+    fleet_pool = [
+        gnm_random_graph(80, 230, seed=args.seed + 1500),
+        _two_block_graph(args.seed + 1600),
+    ]
+    fleet_oracles = [_kind_oracles(g) for g in fleet_pool]
+    cfg = FleetConfig(
+        workers=2, verify=spec, verify_responses=True,
+        ready_timeout_s=240.0, request_timeout_s=120.0,
+    )
+    fleet_wrong = fleet_served = 0
+    pre = dict(BUS.counters())
+    with FleetRouter(cfg) as router:
+        for g, oracles in zip(fleet_pool, fleet_oracles):
+            for kind in ANALYTICS_KINDS:
+                req = _kind_request(g, kind, "fleet")
+                req["edges_out"] = True  # router-side claim certification
+                resp = router.handle(req)
+                fleet_served += 1
+                if not check_kind(resp, kind, oracles, "fleet"):
+                    fleet_wrong += 1
+        # Cross-request affinity inside the fleet: the repeat must still
+        # be kind-correct (same digest, same owner, cached kind entry).
+        req = _kind_request(fleet_pool[0], "components", "fleet")
+        req["edges_out"] = True
+        resp = router.handle(req)
+        fleet_served += 1
+        if not check_kind(resp, "components", fleet_oracles[0], "fleet-rep"):
+            fleet_wrong += 1
+    fleet_rejected = int(
+        BUS.counters().get("fleet.response.rejected", 0)
+        - pre.get("fleet.response.rejected", 0)
+    )
+    check("fleet.kinds_exact", fleet_wrong == 0, f"wrong={fleet_wrong}")
+    check(
+        "fleet.no_rejected_responses", fleet_rejected == 0,
+        f"rejected={fleet_rejected}",
+    )
+
+    counters = BUS.counters()
+    total_wrong = sum(wrong.values())
+    check("wrong_results_zero", total_wrong == 0, f"wrong={wrong}")
+    metrics = {
+        "wrong_results": total_wrong,
+        "hit_leg_fresh_solves": hit_fresh,
+        "probe_hits": probe_hits,
+        "probe_misses": probe_misses,
+        "store_files": n_files,
+        "update_streams": U,
+        "update_mst_hits": update_mst_hits,
+        "fleet_served": fleet_served,
+        "fleet_wrong_results": fleet_wrong,
+        "verify_failed": int(counters.get("verify.failed", 0)),
+        "verify_corrected": int(counters.get("verify.corrected", 0)),
+    }
+    for k in ANALYTICS_KINDS:
+        metrics[f"wrong_{k}"] = wrong[k]
+        metrics[f"served_{k}"] = served[k]
+        metrics[f"{k}_p50_s"] = float(quantile(lat[k], 0.5))
+    ok = all(c["ok"] for c in checks)
+    return {
+        "schema": REPORT_SCHEMA,
+        "config": {
+            "workload": WORKLOAD_KINDS,
+            "seed": args.seed,
+            "pool": len(pool),
+            "kinds": list(ANALYTICS_KINDS),
+            "k": ANALYTICS_K,
+            "update_streams": U,
+            "fleet_workers": 2,
+            "verify": spec,
+        },
+        "wall_s": round(time.perf_counter() - t_start, 3),
+        "ok": ok,
+        "checks": checks,
+        "chaos": {},
+        "events_dropped": BUS.dropped,
+        "slo": {"classes": {}},
+        "fleet": {
+            "workers": 2, "transport": "pipe",
+            "served": fleet_served, "rejected": fleet_rejected,
+        },
+        "gate_metrics": {
+            "schema": "ghs-bench-metrics-v1",
+            "config": {
+                "workload": WORKLOAD_KINDS,
+                "seed": args.seed,
+                "pool": len(pool),
+                "k": ANALYTICS_K,
+                "update_streams": U,
+            },
+            "metrics": metrics,
+        },
+    }
+
+
 def run_gate(report: dict, baseline_path: str, time_tolerance: float):
     """Compare the report's gate metrics against the committed baseline
     (reusing bench_gate's classification); returns ``(ok, lines)``."""
@@ -2384,6 +2827,14 @@ def main(argv=None) -> int:
                    "at least 1)")
     p.add_argument("--elastic-max", type=int, default=None, metavar="N",
                    help="with --elastic: pool ceiling (default fleet + 1)")
+    p.add_argument("--kinds-mixed", action="store_true",
+                   help="run the analytics drill (gate-analytics-v1): all "
+                   "five query kinds (mst, components, k_msf, bottleneck, "
+                   "path_max) over miss/hit/probe/update traffic plus a "
+                   "2-worker fleet leg with response certification, every "
+                   "answer checked EXACTLY against its NetworkX oracle "
+                   "and the per-kind store keys proven non-colliding "
+                   "(docs/ANALYTICS.md)")
     p.add_argument("--corrupt-store", type=int, default=None, metavar="K",
                    help="run the corruption audit drill (gate-verify-v1): "
                    "flip seeded bytes inside K live store npz files "
@@ -2476,9 +2927,22 @@ def main(argv=None) -> int:
         if args.fleet or args.kill_router or args.partition is not None:
             p.error("--corrupt-store is its own scenario (it spins its "
                     "own one-worker fleet leg via --payload-chaos)")
+    if args.kinds_mixed:
+        if (args.fleet or args.corrupt_store is not None or args.kill_router
+                or args.partition is not None or args.test_echo
+                or args.elastic or args.update_heavy or args.oversize_heavy):
+            p.error("--kinds-mixed is its own scenario (it spins its own "
+                    "2-worker fleet leg internally)")
+        # The bare-flag baseline default points at the load baseline;
+        # retarget it at the analytics one for this workload.
+        if args.gate_baseline == DEFAULT_BASELINE:
+            args.gate_baseline = ANALYTICS_BASELINE
+        if args.update_baseline == DEFAULT_BASELINE:
+            args.update_baseline = ANALYTICS_BASELINE
 
     report = (
-        run_corrupt_drill(args) if args.corrupt_store is not None
+        run_kinds_drill(args) if args.kinds_mixed
+        else run_corrupt_drill(args) if args.corrupt_store is not None
         else run_drill(args)
     )
     if args.output:
