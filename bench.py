@@ -28,6 +28,7 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
 import sys
 import time
 
@@ -872,6 +873,170 @@ def run_kinds_bench(args) -> int:
     return 0 if wrong == 0 else 1
 
 
+def run_tuned_bench(args) -> int:
+    """Tuned vs default selector (``gate-tune-v1``): warm solve p50 on
+    the batch-lane and sharded (mesh) paths with a TuningRecord installed
+    vs the bare probe heuristic, plus the deterministic record-consult
+    count the gate pins exactly.
+
+    The record comes from ``--tune-record`` (written by ``ghs tune``) or,
+    absent that, a dry in-process search over exactly the buckets this
+    bench drives — dry records pin ``xla`` winners on any backend, so the
+    bench is deterministic everywhere (docs/KERNELS.md "Autotuning").
+    ``tune_record_hits`` counts the measured-tier selections
+    (``kernel.selected.measured``) the tuned phase made — one per batched
+    dispatch (warm resident mesh re-solves reuse their staged programs
+    without re-resolving) — so it gates exactly against
+    ``docs/BENCH_BASELINE_TUNE.json``: a drop means the record stopped
+    being consulted (a wiring regression, never jitter). Both phases'
+    results are checked edge-for-edge against each other.
+    """
+    import tempfile
+
+    import jax
+    import numpy as np
+
+    from distributed_ghs_implementation_tpu.batch import lanes as lanes_mod
+    from distributed_ghs_implementation_tpu.graphs.generators import (
+        gnm_random_graph,
+    )
+    from distributed_ghs_implementation_tpu.obs.events import BUS
+    from distributed_ghs_implementation_tpu.tune import (
+        load_and_install,
+        save_record,
+        search,
+    )
+    from distributed_ghs_implementation_tpu.tune.measure import mesh_bucket
+
+    BUS.enable()
+    BUS.clear()
+    lanes = args.batch_lanes or 8
+    graphs = [
+        gnm_random_graph(args.batch_nodes, args.batch_edges, seed=SEED * 1000 + i)
+        for i in range(lanes)
+    ]
+    n_pad, m_pad = lanes_mod.bucket_of(args.batch_nodes, args.batch_edges)
+    buckets = [(n_pad, m_pad, lanes, "fused"), (n_pad, m_pad, 0, "fused")]
+
+    use_mesh = jax.device_count() >= 2
+    mesh_graph = None
+    lane = None
+    if use_mesh:
+        from distributed_ghs_implementation_tpu.parallel.lane import ShardedLane
+
+        mesh_graph = gnm_random_graph(
+            args.sharded_nodes, args.sharded_edges, seed=SEED
+        )
+        buckets.append(
+            mesh_bucket(
+                args.sharded_nodes, args.sharded_edges, jax.device_count()
+            )
+        )
+        lane = ShardedLane(kernel=args.kernel)
+
+    def _warm_p50(fn):
+        fn()  # warm (compile on the first phase, cache hit after)
+        times = []
+        for _ in range(args.repeats):
+            t0 = time.perf_counter()
+            fn()
+            times.append(time.perf_counter() - t0)
+        return _pctl(times, 0.50)
+
+    def _batch():
+        return lanes_mod.solve_lanes(
+            graphs, lanes=lanes, mode="fused", kernel=None
+        )
+
+    # Phase 1 — default selector (no record installed anywhere).
+    default_p50 = _warm_p50(_batch)
+    default_ids = [r[0] for r in _batch()]
+    mesh_default_p50 = None
+    if use_mesh:
+        mesh_default_p50 = _warm_p50(lambda: lane.solve(mesh_graph))
+
+    # Phase 2 — the tuned selector: install, re-measure the same work.
+    record_path = args.tune_record
+    if not record_path:
+        record = search(buckets, repeats=1, dry=True)
+        record_path = os.path.join(
+            tempfile.mkdtemp(prefix="ghs-tune-bench-"), "tuning.json"
+        )
+        save_record(record, record_path)
+    installed = load_and_install(record_path)
+    if installed < 1:
+        print("TUNED BENCH FAILED: record installed 0 buckets",
+              file=sys.stderr)
+        return 1
+    before = BUS.counters().get("kernel.selected.measured", 0)
+    tuned_p50 = _warm_p50(_batch)
+    tuned_ids = [r[0] for r in _batch()]
+    mesh_tuned_p50 = None
+    if use_mesh:
+        mesh_tuned_p50 = _warm_p50(lambda: lane.solve(mesh_graph))
+    tune_record_hits = int(
+        BUS.counters().get("kernel.selected.measured", 0) - before
+    )
+
+    if not all(np.array_equal(a, b) for a, b in zip(default_ids, tuned_ids)):
+        print("TUNED BENCH PARITY FAILED: tuned vs default edge ids",
+              file=sys.stderr)
+        return 1
+    if tune_record_hits < 1:
+        print("TUNED BENCH FAILED: the installed record was never "
+              "consulted (kernel.selected.measured did not count)",
+              file=sys.stderr)
+        return 1
+
+    total_weight = int(sum(
+        int(g.w[ids].sum()) for g, ids in zip(graphs, tuned_ids)
+    ))
+    out = {
+        "metric": f"tuned vs default selector, {lanes}-lane "
+        f"gnm({args.batch_nodes},{args.batch_edges})"
+        + (f" + mesh gnm({args.sharded_nodes},{args.sharded_edges})"
+           if use_mesh else ""),
+        "value": round(default_p50 / tuned_p50, 3),
+        "unit": "x (batch warm p50, default/tuned)",
+        "default_warm_p50_s": round(default_p50, 4),
+        "tuned_warm_p50_s": round(tuned_p50, 4),
+        "tune_record_hits": tune_record_hits,
+        "tuned_entries": installed,
+        "record": record_path,
+    }
+    if use_mesh:
+        out["mesh_default_warm_p50_s"] = round(mesh_default_p50, 4)
+        out["mesh_tuned_warm_p50_s"] = round(mesh_tuned_p50, 4)
+    print(json.dumps(out))
+    if args.metrics_out:
+        metrics = {
+            "default_warm_p50_s": default_p50,
+            "tuned_warm_p50_s": tuned_p50,
+            "tune_record_hits": tune_record_hits,
+            "tuned_entries": installed,
+            "mst_weight": total_weight,
+        }
+        if use_mesh:
+            metrics["mesh_default_warm_p50_s"] = mesh_default_p50
+            metrics["mesh_tuned_warm_p50_s"] = mesh_tuned_p50
+        with open(args.metrics_out, "w") as f:
+            json.dump(
+                {
+                    "schema": "ghs-bench-metrics-v1",
+                    "config": {
+                        "workload": f"tuned-{lanes}lane-gnm"
+                        f"({args.batch_nodes},{args.batch_edges})-seed{SEED}"
+                        f"-{jax.device_count()}dev-r{args.repeats}",
+                    },
+                    "metrics": metrics,
+                },
+                f,
+                indent=2,
+            )
+            f.write("\n")
+    return 0
+
+
 def run_sharded_bench(args) -> int:
     """Oversize-lane serving metrics: cold staging vs warm device-resident
     re-solve on the mesh (``parallel/lane.py``), plus the donated-buffer
@@ -1244,6 +1409,19 @@ def main(argv=None) -> int:
                    help="oversize workload nodes for --sharded-lane")
     p.add_argument("--sharded-edges", type=int, default=140_000)
     p.add_argument(
+        "--tuned", action="store_true",
+        help="measure the tuned vs default kernel selector instead of the "
+        "RMAT bench: warm solve p50 on the batch-lane (and, with >= 2 "
+        "devices, mesh) paths with a TuningRecord installed, plus the "
+        "exact record-consult count gate-tune-v1 pins "
+        "(docs/BENCH_BASELINE_TUNE.json, docs/KERNELS.md \"Autotuning\")",
+    )
+    p.add_argument(
+        "--tune-record", default=None, metavar="PATH",
+        help="with --tuned: install this ghs-tuning-v1 record (from `ghs "
+        "tune`) instead of running a dry in-process search",
+    )
+    p.add_argument(
         "--fleet-tcp", action="store_true",
         help="measure network-fleet transport overhead instead of the RMAT "
         "bench: router-hop p50/p95 over TCP sockets vs subprocess pipes on "
@@ -1325,6 +1503,8 @@ def main(argv=None) -> int:
         return run_update_stream_bench(args)
     if args.stream_sharded:
         return run_stream_sharded_bench(args)
+    if args.tuned:
+        return run_tuned_bench(args)
     if args.sharded_lane:
         return run_sharded_bench(args)
     if args.batch_lanes:

@@ -189,9 +189,11 @@ def _get_solver(
     ``pallas_kernels.kernel_choice`` when ``None``) is part of the cache
     key, and every compile event carries it — the ``compile.*`` taxonomy
     distinguishes kernel variants (``compile.kernel.pallas`` /
-    ``compile.kernel.xla``).
+    ``compile.kernel.xla``). Resolution passes the solver bucket, so an
+    installed TuningRecord's measured winner applies per bucket
+    (docs/KERNELS.md "Autotuning").
     """
-    kernel = _pk.kernel_choice(kernel)
+    kernel = _pk.kernel_choice(kernel, bucket=(n_pad, m_pad, lanes, mode))
     key = (n_pad, m_pad, lanes, mode, kernel)
     while True:
         with _CACHE_LOCK:
@@ -251,7 +253,7 @@ def precompile_bucket(
             f"bucket ({n_pad}, {m_pad}) x {lanes} lanes exceeds int32 id "
             "space; no request-path stack can ever use this solver"
         )
-    kernel = _pk.kernel_choice(kernel)
+    kernel = _pk.kernel_choice(kernel, bucket=(n_pad, m_pad, lanes, mode))
     with _CACHE_LOCK:
         cached = (n_pad, m_pad, lanes, mode, kernel) in _SOLVER_CACHE
     if cached:
@@ -385,7 +387,10 @@ def execute_stacked(
     only consumes per-call device buffers), so the retry is exact and
     the request never sees the failure.
     """
-    kernel = _pk.kernel_choice(kernel)
+    kernel = _pk.kernel_choice(
+        kernel,
+        bucket=(stacked.n_pad, stacked.m_pad, stacked.lanes, stacked.mode),
+    )
     try:
         solver = _get_solver(
             stacked.n_pad, stacked.m_pad, stacked.lanes, stacked.mode,

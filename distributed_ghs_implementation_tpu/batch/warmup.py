@@ -64,7 +64,17 @@ from distributed_ghs_implementation_tpu.obs.events import BUS
 
 RECORD_SCHEMA = "ghs-warmup-buckets-v1"
 
+#: Lane modes a warmup record may carry. ``lanes == 0`` entries use a
+#: placeholder mode (they never reach the lane compiler), but it still
+#: must be one of these — an unknown string is a corrupt record.
+VALID_RECORD_MODES = ("fused", "vmap")
+
 _INT32_MAX = np.iinfo(np.int32).max
+
+
+class WarmupRecordError(ValueError):
+    """A malformed warmup record entry, named precisely — a bad record
+    must fail boot with *which entry* is bad, not a bare unpack error."""
 
 #: Single-graph warm ceiling: buckets past these never run the fused iota
 #: kernel (``solve_graph`` routes them to the rank solver), so warming
@@ -111,6 +121,14 @@ class WarmupPlan:
     request-time ``compile.hit`` whichever variant the process serves
     with — the zero-request-time-compiles property covers kernel
     variants (docs/KERNELS.md).
+
+    ``tuning`` is the path of a ``ghs-tuning-v1`` TuningRecord
+    (``tune/record.py``) to install *before* any bucket resolves: warmup
+    then precompiles each bucket's *measured* winner (per-bucket
+    ``kernel_choice`` with the bucket key), so the warmed variant is the
+    one a tuned request-time resolution will hit. A missing or stale
+    record installs nothing and warmup proceeds on the probe heuristic —
+    degrade, never error.
     """
 
     buckets: Tuple[Tuple[int, int], ...] = ()
@@ -121,6 +139,7 @@ class WarmupPlan:
     mesh_buckets: Tuple[Tuple[int, int], ...] = ()
     stream_buckets: Tuple[Tuple[int, int], ...] = ()
     kernel: Optional[str] = None
+    tuning: Optional[str] = None
 
     def is_empty(self) -> bool:
         return (
@@ -225,8 +244,41 @@ def save_bucket_record(
     return len(keys)
 
 
+def _validate_record_entry(path: str, i: int, entry) -> SolverKey:
+    """One record entry -> a SolverKey, or :class:`WarmupRecordError`
+    naming the offending entry (index + repr)."""
+
+    def bad(why: str) -> WarmupRecordError:
+        return WarmupRecordError(
+            f"{path}: bucket entry #{i} {entry!r}: {why}"
+        )
+
+    if not isinstance(entry, (list, tuple)) or len(entry) != 4:
+        raise bad("expected [n_pad, m_pad, lanes, mode]")
+    n, m, lanes, mode = entry
+    for name, v in (("n_pad", n), ("m_pad", m), ("lanes", lanes)):
+        if isinstance(v, bool) or not isinstance(v, int):
+            raise bad(f"{name} must be an int, got {type(v).__name__}")
+    if n < 1 or m < 1:
+        raise bad(f"shape ({n}, {m}) must be positive")
+    if lanes < 0:
+        raise bad(f"lanes {lanes} must be >= 0")
+    if not isinstance(mode, str) or mode not in VALID_RECORD_MODES:
+        raise bad(
+            f"unknown mode {mode!r} (expected one of {VALID_RECORD_MODES})"
+        )
+    return (n, m, lanes, mode)
+
+
 def load_bucket_record(path: str) -> WarmupPlan:
-    """Load a recorded bucket file into a replayable :class:`WarmupPlan`."""
+    """Load a recorded bucket file into a replayable :class:`WarmupPlan`.
+
+    Every entry is validated before any is used — a malformed entry
+    (wrong arity, non-int sizes, negative sizes, unknown mode) raises a
+    typed :class:`WarmupRecordError` naming it, so an operator fixing a
+    hand-edited record sees *which* line is bad instead of a bare
+    unpacking traceback mid-boot.
+    """
     with open(path) as f:
         record = json.load(f)
     if record.get("schema") != RECORD_SCHEMA:
@@ -234,9 +286,15 @@ def load_bucket_record(path: str) -> WarmupPlan:
             f"{path}: bad warmup record schema {record.get('schema')!r} "
             f"(expected {RECORD_SCHEMA})"
         )
+    buckets = record.get("buckets", [])
+    if not isinstance(buckets, list):
+        raise WarmupRecordError(
+            f"{path}: 'buckets' must be a list, got "
+            f"{type(buckets).__name__}"
+        )
     keys = tuple(
-        (int(n), int(m), int(lanes), str(mode))
-        for n, m, lanes, mode in record.get("buckets", [])
+        _validate_record_entry(path, i, entry)
+        for i, entry in enumerate(buckets)
     )
     return WarmupPlan(keys=keys)
 
@@ -278,6 +336,7 @@ def plan_from_flags(
     mesh_buckets: Optional[str] = None,
     stream_buckets: Optional[str] = None,
     kernel: Optional[str] = None,
+    tuning: Optional[str] = None,
 ) -> Optional[WarmupPlan]:
     """A :class:`WarmupPlan` from the serve-CLI flag surface, or ``None``.
 
@@ -313,6 +372,8 @@ def plan_from_flags(
     merged = merge_plans(*plans)
     if kernel and kernel != "auto":
         merged = dataclasses.replace(merged, kernel=kernel)
+    if tuning:
+        merged = dataclasses.replace(merged, tuning=tuning)
     return merged
 
 
@@ -323,6 +384,7 @@ def merge_plans(*plans: WarmupPlan) -> WarmupPlan:
     stream_buckets: List[Tuple[int, int]] = []
     keys: List[SolverKey] = []
     lanes, mode, warm_single, kernel = 0, "fused", True, None
+    tuning = None
     for p in plans:
         for b in p.buckets:
             if b not in buckets:
@@ -341,12 +403,14 @@ def merge_plans(*plans: WarmupPlan) -> WarmupPlan:
             mode = p.mode
         warm_single = warm_single and p.warm_single
         kernel = kernel or p.kernel
+        tuning = tuning or p.tuning
     return WarmupPlan(
         buckets=tuple(buckets), lanes=lanes, mode=mode,
         keys=tuple(keys), warm_single=warm_single,
         mesh_buckets=tuple(mesh_buckets),
         stream_buckets=tuple(stream_buckets),
         kernel=kernel,
+        tuning=tuning,
     )
 
 
@@ -368,6 +432,7 @@ def summarize_report(report: Optional[dict]) -> Optional[dict]:
         "stream_warmed": report.get("stream_warmed", 0),
         "stream_sharded_warmed": report.get("stream_sharded_warmed", 0),
         "kernel": report.get("kernel"),
+        "tuned_entries": report.get("tuned_entries", 0),
         "wall_s": round(float(report.get("wall_s", 0.0)), 3),
     }
 
@@ -410,6 +475,18 @@ def run_warmup(plan: WarmupPlan, *, lane=None) -> dict:
         kernel_choice,
     )
 
+    tuned_entries = 0
+    if plan.tuning:
+        # Install the tuning record FIRST: every bucket below resolves
+        # through the measured-auto tier, so the warmed variant is the
+        # tuned one requests will hit. Miss/stale installs nothing
+        # (tune.record.miss/stale on the bus) and the probe heuristic
+        # carries the warmup — boot never dies on a bad record.
+        from distributed_ghs_implementation_tpu.tune.record import (
+            load_and_install,
+        )
+
+        tuned_entries = load_and_install(plan.tuning)
     kernel = kernel_choice(plan.kernel)
     report = {
         "buckets": 0,
@@ -422,10 +499,17 @@ def run_warmup(plan: WarmupPlan, *, lane=None) -> dict:
         "stream_warmed": 0,
         "stream_sharded_warmed": 0,
         "kernel": kernel,
+        "tuned_entries": tuned_entries,
         "wall_s": 0.0,
     }
     if plan.is_empty():
         return report
+
+    # The raw request threads into per-bucket resolution below, so an
+    # installed TuningRecord's measured winner applies bucket by bucket;
+    # after a fallback the sticky disable_pallas makes every later
+    # resolution land on "xla" regardless.
+    request = plan.kernel
 
     def _warm_fallback(site: str, ex: Exception) -> None:
         # The same degrade-never-error contract the request path has
@@ -433,9 +517,10 @@ def run_warmup(plan: WarmupPlan, *, lane=None) -> dict:
         # the sticky process fallback and the rest of the phase — and the
         # retried site — warms the XLA variant serving will now resolve.
         # Boot must not die on the kernel the process won't even run.
-        nonlocal kernel
+        nonlocal kernel, request
         disable_pallas(f"warmup[{site}]: {type(ex).__name__}: {ex}")
         kernel = "xla"
+        request = "xla"
         report["kernel"] = "xla"
 
     t0 = time.perf_counter()
@@ -460,14 +545,17 @@ def run_warmup(plan: WarmupPlan, *, lane=None) -> dict:
                 report["skipped"] += 1
                 continue
             report["buckets"] += 1
+            bkern = kernel_choice(
+                request, bucket=(n_pad, m_pad, lanes, mode)
+            )
             try:
                 fresh = precompile_bucket(
-                    n_pad, m_pad, lanes, mode, kernel=kernel
+                    n_pad, m_pad, lanes, mode, kernel=bkern
                 )
             except ValueError:
                 raise  # geometry rejections are never kernel faults
             except Exception as ex:  # noqa: BLE001 — kernel fallback
-                if kernel != "pallas":
+                if bkern != "pallas":
                     raise
                 _warm_fallback(f"bucket {n_pad}x{m_pad}", ex)
                 fresh = precompile_bucket(
@@ -483,12 +571,17 @@ def run_warmup(plan: WarmupPlan, *, lane=None) -> dict:
             for n_pad, m_pad in sorted(shapes):
                 if not warmable_single(n_pad, m_pad):
                     continue  # routed to the rank solver, never this kernel
+                # The single-graph path resolves at its shape-only bucket
+                # (lanes=0), the key single buckets tune under.
+                skern = kernel_choice(
+                    request, bucket=(n_pad, m_pad, 0, "fused")
+                )
                 try:
-                    _warm_single_graph_kernel(n_pad, m_pad, kernel)
+                    _warm_single_graph_kernel(n_pad, m_pad, skern)
                 except ValueError:
                     raise  # geometry rejections are never kernel faults
                 except Exception as ex:  # noqa: BLE001 — kernel fallback
-                    if kernel != "pallas":
+                    if skern != "pallas":
                         raise
                     _warm_fallback(f"single {n_pad}x{m_pad}", ex)
                     _warm_single_graph_kernel(n_pad, m_pad, "xla")

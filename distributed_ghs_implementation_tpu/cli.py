@@ -443,6 +443,7 @@ def _cmd_serve(args) -> int:
             warmup_stream_buckets=args.warmup_stream_buckets,
             compile_cache_dir=args.compile_cache_dir,
             no_compile_cache=args.no_compile_cache,
+            tune_record=args.tune_record,
             obs_dir=args.fleet_obs_dir,
             sharded_lane_workers=args.sharded_lane,
             stream_dir=args.stream_dir,
@@ -517,6 +518,18 @@ def _cmd_serve(args) -> int:
         if cache_dir:
             print(f"compile cache: {cache_dir}", file=sys.stderr)
 
+    if args.tune_record:
+        # Measured kernel winners land before the first kernel_choice —
+        # warmup's precompiles included (stale/missing degrades to the
+        # probe heuristic, never an error).
+        from distributed_ghs_implementation_tpu.tune import load_and_install
+
+        installed = load_and_install(args.tune_record)
+        print(
+            f"tune record: {installed} bucket(s) from {args.tune_record}",
+            file=sys.stderr,
+        )
+
     from distributed_ghs_implementation_tpu.batch.warmup import plan_from_flags
 
     warmup_plan = plan_from_flags(
@@ -526,6 +539,7 @@ def _cmd_serve(args) -> int:
         mesh_buckets=args.warmup_mesh_buckets,
         stream_buckets=args.warmup_stream_buckets,
         kernel=args.kernel,
+        tuning=args.tune_record,
     )
 
     service = MSTService(
@@ -566,6 +580,62 @@ def _cmd_serve(args) -> int:
                 f"warmup record: {count} bucket(s) -> {args.warmup_record}",
                 file=sys.stderr,
             )
+
+
+def _cmd_tune(args) -> int:
+    """Offline kernel autotuner: enumerate the valid kernel x geometry
+    candidates per bucket, score them (seeded, warm-then-median, parity-
+    gated), and persist a machine-fingerprinted ``ghs-tuning-v1`` record
+    that ``kernel_choice``'s auto tier consults per bucket
+    (docs/KERNELS.md "Autotuning"). Off TPU — and always with ``--dry``
+    — winners deterministically pin ``xla``, so two runs yield
+    byte-identical records (CI's gate-tune-v1 asserts exactly that)."""
+    from distributed_ghs_implementation_tpu.batch import warmup as warmup_mod
+    from distributed_ghs_implementation_tpu.tune import (
+        default_record_path,
+        save_record,
+        search,
+    )
+    from distributed_ghs_implementation_tpu.tune.measure import mesh_bucket
+
+    lanes = max(0, args.lanes)
+    buckets = []
+    if args.buckets:
+        for n, m in warmup_mod.parse_bucket_list(args.buckets):
+            if lanes >= 1:
+                buckets.append((n, m, lanes, args.mode))
+            # The single-graph (miss-path) variant serves the same shapes.
+            buckets.append((n, m, 0, "fused"))
+    if args.warmup_record:
+        # A --warmup-record file from a serving run: tune exactly the
+        # buckets real traffic compiled.
+        plan = warmup_mod.load_bucket_record(args.warmup_record)
+        buckets.extend(tuple(k) for k in plan.keys)
+    if args.mesh_buckets:
+        import jax
+
+        n_dev = jax.device_count()
+        for n, m in warmup_mod.parse_mesh_bucket_list(args.mesh_buckets):
+            buckets.append(mesh_bucket(n, m, n_dev))
+    if not buckets:
+        raise SystemExit(
+            "tune: nothing to tune; pass --buckets, --warmup-record, "
+            "and/or --mesh-buckets"
+        )
+    record = search(buckets, repeats=args.repeats, dry=args.dry)
+    out = args.out or default_record_path()
+    save_record(record, out)
+    print(json.dumps({
+        "path": out,
+        "fingerprint": record["fingerprint"],
+        "backend": record["backend"],
+        "pinned": record["pinned"],
+        "buckets": len(record["entries"]),
+        "winners": {
+            k: e["kernel"] for k, e in sorted(record["entries"].items())
+        },
+    }, indent=2, sort_keys=True))
+    return 0
 
 
 def _cmd_bench(args) -> int:
@@ -920,7 +990,62 @@ def build_parser() -> argparse.ArgumentParser:
         "interval x miss threshold = 5s); tune UP on congested WANs, "
         "DOWN for faster failover on a quiet LAN",
     )
+    srv.add_argument(
+        "--tune-record", default=None, metavar="PATH",
+        help="install this ghs-tuning-v1 record (written by `ghs tune`) "
+        "so the auto kernel tier uses measured per-bucket winners; "
+        "stale or missing records degrade to the probe heuristic. "
+        "Fleet mode shares the path with every worker, like the "
+        "persistent compile cache (docs/KERNELS.md \"Autotuning\")",
+    )
     srv.set_defaults(fn=_cmd_serve)
+
+    tn = sub.add_parser(
+        "tune",
+        help="offline kernel autotuner: measure per-bucket kernel/geometry "
+        "winners into a machine-fingerprinted record for `serve "
+        "--tune-record` (docs/KERNELS.md \"Autotuning\")",
+    )
+    tn.add_argument(
+        "--buckets",
+        help="tune these workload shapes: comma-separated NODESxEDGES "
+        "(bucketed exactly like requests) or 'auto' for the default "
+        "warmup ladder",
+    )
+    tn.add_argument(
+        "--lanes", type=int, default=0,
+        help="also tune the batched lane solver at this lane count "
+        "(matches serve --batch-lanes; 0 = single-graph buckets only)",
+    )
+    tn.add_argument(
+        "--mode", choices=("fused", "vmap"), default="fused",
+        help="lane execution mode the lane buckets tune (with --lanes)",
+    )
+    tn.add_argument(
+        "--warmup-record", metavar="PATH",
+        help="seed the bucket list from a serve --warmup-record file: "
+        "tune exactly the buckets real traffic compiled",
+    )
+    tn.add_argument(
+        "--mesh-buckets",
+        help="also tune the sharded lane's kernels for these RAW "
+        "NODESxEDGES oversize workloads (per-device proxy measurement)",
+    )
+    tn.add_argument(
+        "--repeats", type=int, default=5,
+        help="timed calls per candidate after the warm call (median wins)",
+    )
+    tn.add_argument(
+        "--dry", action="store_true",
+        help="skip all timing and pin xla winners on any backend — the "
+        "deterministic CI mode (two runs are byte-identical)",
+    )
+    tn.add_argument(
+        "--out", metavar="PATH",
+        help="record path (default: the fingerprinted path under "
+        "$GHS_TUNE_DIR or ~/.cache/ghs-tune)",
+    )
+    tn.set_defaults(fn=_cmd_tune)
 
     b = sub.add_parser("bench", help="run the benchmark (see bench.py)")
     b.add_argument("--scale", type=int, default=22)

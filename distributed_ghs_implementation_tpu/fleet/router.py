@@ -118,6 +118,7 @@ class FleetConfig:
     warmup_replay: Optional[str] = None
     compile_cache_dir: Optional[str] = None
     no_compile_cache: bool = False
+    tune_record: Optional[str] = None  # ghs-tuning-v1 record (all workers)
     queue_depth: int = 64
     shed_classes: Tuple[str, ...] = ()
     # Oversize routing: the first K worker slots own a mesh-sharded solve
@@ -660,6 +661,8 @@ class FleetRouter:
             argv += ["--compile-cache-dir", cfg.compile_cache_dir]
         if cfg.no_compile_cache:
             argv += ["--no-compile-cache"]
+        if cfg.tune_record:
+            argv += ["--tune-record", cfg.tune_record]
         if cfg.obs_dir:
             os.makedirs(cfg.obs_dir, exist_ok=True)
             argv += ["--obs-jsonl", os.path.join(

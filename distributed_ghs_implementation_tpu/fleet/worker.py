@@ -179,6 +179,17 @@ def _build_service(args):
         # the first worker to compile a bucket pays; its siblings and every
         # restarted incarnation reload the executable.
         enable_persistent_cache(args.compile_cache_dir)
+    if args.tune_record:
+        # Shared exactly like the compile cache: one offline `cli tune`
+        # run's record (machine-fingerprinted, integrity-checked) makes
+        # every worker's auto tier measured. Miss/stale installs nothing
+        # and the probe heuristic serves — a worker never refuses to boot
+        # over a tuning file (tune.record.miss/stale on the bus).
+        from distributed_ghs_implementation_tpu.tune.record import (
+            load_and_install,
+        )
+
+        load_and_install(args.tune_record)
     return MSTService(
         backend=args.backend,
         store_capacity=args.store_capacity,
@@ -192,6 +203,7 @@ def _build_service(args):
             buckets=args.warmup_buckets, replay=args.warmup_replay,
             lanes=args.batch_lanes, mesh_buckets=args.warmup_mesh_buckets,
             stream_buckets=args.warmup_stream_buckets,
+            tuning=args.tune_record,
         ),
         # -1 = the bare flag: a lane over all of this worker's devices.
         sharded_lane=(True if args.sharded_lane == -1
@@ -219,6 +231,15 @@ def _hello_for(args, warmup_summary=None) -> dict:
         "kernel": os.environ.get("GHS_KERNEL", "auto"),
         "verify": args.verify or "off",
     }
+    if not args.test_echo:
+        # Measured-selection provenance (None = probe heuristic): the
+        # stats op shows which workers serve on a TuningRecord and which
+        # machine/fingerprint measured it. Echo workers never import jax.
+        from distributed_ghs_implementation_tpu.ops.pallas_kernels import (
+            tuned_summary,
+        )
+
+        caps["tuned"] = tuned_summary()
     if warmup_summary is not None:
         caps["warmup"] = warmup_summary
     return build_hello(
@@ -450,6 +471,9 @@ def build_parser() -> argparse.ArgumentParser:
                    "docs/VERIFICATION.md)")
     p.add_argument("--compile-cache-dir", default=None)
     p.add_argument("--no-compile-cache", action="store_true")
+    p.add_argument("--tune-record", default=None,
+                   help="ghs-tuning-v1 TuningRecord to install (shared "
+                        "across workers like the compile cache)")
     p.add_argument("--obs-jsonl", default=None,
                    help="export this worker's bus events here on drain")
     p.add_argument("--test-echo", action="store_true",

@@ -46,13 +46,23 @@ filter+compaction work):
   one Mosaic regression degrades throughput, never availability.
 
 Every wrapper also has a shape guard (``*_shape_ok``): geometries past
-the VMEM budget (fragment table > ``_TABLE_MAX_ELEMS``, hook arrays >
-``_HOOK_MAX_NODES``) or off the tiling grid route back to the XLA form
-at trace time, so ``kernel="pallas"`` is always safe to request.
+the VMEM budget (fragment table > ``KernelGeometry.table_max_elems``,
+hook arrays > ``KernelGeometry.hook_max_nodes``) or off the tiling grid
+route back to the XLA form at trace time, so ``kernel="pallas"`` is
+always safe to request.
+
+The block/budget numbers live in :class:`KernelGeometry` — an immutable,
+validated knob surface the offline autotuner (``tune/space.py``) searches
+over. The module default is the hand-derived geometry the kernels shipped
+with; :func:`set_geometry` / :func:`geometry_scope` override it
+process-wide (what ``tune/measure.py`` uses to compile each candidate and
+what installing a TuningRecord with a Pallas winner applies).
 """
 
 from __future__ import annotations
 
+import contextlib
+import dataclasses
 import functools
 import math
 import os
@@ -66,22 +76,117 @@ from distributed_ghs_implementation_tpu.obs.events import BUS
 
 INT32_MAX = np.iinfo(np.int32).max
 
-#: VPU lane width — flat e-sized arrays reshape to ``(rows, 128)``.
+#: VPU lane width — flat e-sized arrays reshape to ``(rows, 128)``. A
+#: hardware fact, not a tunable: every geometry is expressed in 128-lane
+#: rows.
 _LANES = 128
 
-#: Fragment-table ceiling for table-resident kernels: the whole table must
-#: sit in VMEM beside the streamed blocks (1M int32 = 4 MB of ~16 MB).
-_TABLE_MAX_ELEMS = 1 << 20
 
-#: Hook+compress ceiling: the kernel holds the parent array plus take
-#: temporaries in VMEM for every jump (2^19 int32 = 2 MB per buffer).
-_HOOK_MAX_NODES = 1 << 19
+@dataclasses.dataclass(frozen=True)
+class KernelGeometry:
+    """The tunable VMEM/tiling knobs of the fused kernels.
 
-#: Elements per streamed ELL block (rows x width).
-_ELL_BLOCK_ELEMS = 1 << 15
+    Defaults are the hand-derived shipping geometry; the autotuner
+    (``tune/``) searches the validated neighborhood. Every field is a
+    power of two — block sizes must divide the padded (power-of-two)
+    row counts exactly because Pallas grids have no remainder step —
+    and is capped at a hard VMEM ceiling so no candidate can even be
+    *constructed* past the budget.
 
-#: Row cap per streamed flat block (rows of 128 lanes).
-_FLAT_BLOCK_ROWS = 256
+    * ``table_max_elems`` — fragment-table ceiling for table-resident
+      kernels: the whole table sits in VMEM beside the streamed blocks
+      (1M int32 = 4 MB of ~16 MB at the default).
+    * ``hook_max_nodes`` — hook+compress ceiling: the kernel holds the
+      parent array plus take temporaries in VMEM for every jump
+      (2^19 int32 = 2 MB per buffer at the default).
+    * ``ell_block_elems`` — elements per streamed ELL block
+      (rows x width).
+    * ``flat_block_rows`` — row cap per streamed flat block (rows of
+      ``_LANES`` lanes).
+    """
+
+    table_max_elems: int = 1 << 20
+    hook_max_nodes: int = 1 << 19
+    ell_block_elems: int = 1 << 15
+    flat_block_rows: int = 256
+
+    #: Hard ceilings (class-level, not fields): int32 elems that still fit
+    #: a ~16 MB VMEM beside the streamed blocks / loop temporaries.
+    _CEILINGS = {
+        "table_max_elems": 1 << 22,
+        "hook_max_nodes": 1 << 20,
+        "ell_block_elems": 1 << 18,
+        "flat_block_rows": 1 << 12,
+    }
+
+    def __post_init__(self):
+        for name, ceiling in self._CEILINGS.items():
+            v = getattr(self, name)
+            if not isinstance(v, int) or isinstance(v, bool) or v < 1:
+                raise ValueError(
+                    f"KernelGeometry.{name} must be a positive int, got {v!r}"
+                )
+            if v & (v - 1):
+                raise ValueError(
+                    f"KernelGeometry.{name} must be a power of two "
+                    f"(Pallas grids have no remainder step), got {v}"
+                )
+            if v > ceiling:
+                raise ValueError(
+                    f"KernelGeometry.{name}={v} exceeds the VMEM ceiling "
+                    f"{ceiling}"
+                )
+
+    def to_json(self) -> dict:
+        return {
+            "table_max_elems": self.table_max_elems,
+            "hook_max_nodes": self.hook_max_nodes,
+            "ell_block_elems": self.ell_block_elems,
+            "flat_block_rows": self.flat_block_rows,
+        }
+
+    @classmethod
+    def from_json(cls, data: dict) -> "KernelGeometry":
+        fields = {f.name for f in dataclasses.fields(cls)}
+        unknown = set(data) - fields
+        if unknown:
+            raise ValueError(
+                f"unknown KernelGeometry field(s) {sorted(unknown)}"
+            )
+        return cls(**{k: int(v) for k, v in data.items()})
+
+
+DEFAULT_GEOMETRY = KernelGeometry()
+_GEOMETRY: KernelGeometry = DEFAULT_GEOMETRY
+
+
+def geometry() -> KernelGeometry:
+    """The process's active kernel geometry (trace-time reads)."""
+    return _GEOMETRY
+
+
+def set_geometry(geom: KernelGeometry | None) -> None:
+    """Override the process geometry (``None`` restores the default).
+    Takes effect at the next trace — already-compiled executables keep
+    the geometry they compiled with (it is baked into the program)."""
+    global _GEOMETRY
+    if geom is not None and not isinstance(geom, KernelGeometry):
+        raise TypeError(f"expected KernelGeometry or None, got {type(geom)}")
+    _GEOMETRY = DEFAULT_GEOMETRY if geom is None else geom
+
+
+@contextlib.contextmanager
+def geometry_scope(geom: KernelGeometry):
+    """Trace candidate kernels under a temporary geometry (the autotuner's
+    measurement scope); restores the previous geometry on exit."""
+    global _GEOMETRY
+    prev = _GEOMETRY
+    set_geometry(geom)
+    try:
+        yield geom
+    finally:
+        _GEOMETRY = prev
+
 
 VALID_KERNELS = ("auto", "pallas", "xla")
 
@@ -90,6 +195,10 @@ _DEFAULT_KERNEL: str | None = None  # set_default_kernel (serve --kernel)
 _DISABLED_REASON: str | None = None  # sticky runtime fallback
 _PROBE_RESULT: bool | None = None
 _PROBE_ERROR: str | None = None
+# Measured per-bucket winners from an installed TuningRecord
+# (tune/record.py install_record): (n_pad, m_pad, lanes, mode) -> kernel.
+_TUNED_KERNELS: dict | None = None
+_TUNED_SOURCE: dict | None = None  # {"fingerprint", "path", "entries"}
 
 
 def _interpret() -> bool:
@@ -161,11 +270,53 @@ def disable_pallas(reason: str) -> None:
         BUS.count("kernel.fallback")
 
 
-def kernel_choice(override: str | None = None) -> str:
+def set_tuned_kernels(
+    mapping: dict | None, source: dict | None = None
+) -> None:
+    """Install measured per-bucket winners (``tune/record.py``'s
+    ``install_record`` is the one caller). ``mapping`` maps solver buckets
+    ``(n_pad, m_pad, lanes, mode)`` to ``"pallas" | "xla"``; ``None``
+    uninstalls. ``source`` is a small provenance dict surfaced by
+    :func:`tuned_summary` / :func:`kernel_report` (and the fleet hello's
+    ``caps["tuned"]``)."""
+    global _TUNED_KERNELS, _TUNED_SOURCE
+    if mapping is not None:
+        for bucket, win in mapping.items():
+            if win not in ("pallas", "xla"):
+                raise ValueError(
+                    f"tuned winner for bucket {bucket!r} must be "
+                    f"pallas|xla, got {win!r}"
+                )
+    with _LOCK:
+        _TUNED_KERNELS = dict(mapping) if mapping is not None else None
+        _TUNED_SOURCE = dict(source) if source is not None else None
+
+
+def tuned_summary() -> dict | None:
+    """Provenance of the installed TuningRecord (``None`` when the process
+    runs on the probe heuristic alone)."""
+    with _LOCK:
+        if _TUNED_KERNELS is None:
+            return None
+        out = dict(_TUNED_SOURCE or {})
+        out.setdefault("entries", len(_TUNED_KERNELS))
+        return out
+
+
+def kernel_choice(
+    override: str | None = None, *, bucket: tuple | None = None
+) -> str:
     """Resolve the effective kernel: per-solve override > process default
-    (``set_default_kernel``) > ``GHS_KERNEL`` env > auto (Pallas on TPU
-    when the probe passes, XLA everywhere else). Requests for an
-    unavailable Pallas degrade to ``"xla"`` — never an error."""
+    (``set_default_kernel``) > ``GHS_KERNEL`` env > measured auto (an
+    installed TuningRecord's winner for ``bucket``) > probe auto (Pallas
+    on TPU when the probe passes, XLA everywhere else). Requests for an
+    unavailable Pallas degrade to ``"xla"`` — never an error.
+
+    ``bucket`` is the solver bucket ``(n_pad, m_pad, lanes, mode)`` being
+    resolved; per-bucket call sites (``batch/lanes``, the sharded lane,
+    warmup) pass it so ``auto`` can consult the measured winners. The
+    sticky :func:`disable_pallas` fallback outranks a measured Pallas
+    winner — a record is a measurement, not an availability proof."""
     request = override or _DEFAULT_KERNEL or os.environ.get("GHS_KERNEL") or "auto"
     if request not in VALID_KERNELS:
         raise ValueError(
@@ -177,8 +328,18 @@ def kernel_choice(override: str | None = None) -> str:
         return "xla"
     if request == "pallas":
         return "pallas" if pallas_supported() else "xla"
-    # auto: only pick Pallas where it runs compiled — interpret mode is a
-    # parity tool, not a throughput path.
+    # auto, measured tier: a TuningRecord for THIS machine pins the
+    # bucket's winner (kernel.selected.measured proves selections are
+    # measurements, not guesses).
+    if bucket is not None and _TUNED_KERNELS:
+        win = _TUNED_KERNELS.get(tuple(bucket))
+        if win is not None:
+            if win == "pallas" and not pallas_supported():
+                return "xla"
+            BUS.count("kernel.selected.measured")
+            return win
+    # auto, probe tier: only pick Pallas where it runs compiled —
+    # interpret mode is a parity tool, not a throughput path.
     if jax.default_backend() == "tpu" and pallas_supported():
         return "pallas"
     return "xla"
@@ -194,17 +355,23 @@ def kernel_report() -> dict:
         "resolved": kernel_choice(),
         "disabled_reason": _DISABLED_REASON,
         "probe_error": _PROBE_ERROR,
+        "tuned": tuned_summary(),
+        "geometry": _GEOMETRY.to_json(),
     }
 
 
 def _reset_for_tests() -> None:
     """Clear sticky selection state (tests simulate a process restart)."""
     global _DEFAULT_KERNEL, _DISABLED_REASON, _PROBE_RESULT, _PROBE_ERROR
+    global _TUNED_KERNELS, _TUNED_SOURCE, _GEOMETRY
     with _LOCK:
         _DEFAULT_KERNEL = None
         _DISABLED_REASON = None
         _PROBE_RESULT = None
         _PROBE_ERROR = None
+        _TUNED_KERNELS = None
+        _TUNED_SOURCE = None
+        _GEOMETRY = DEFAULT_GEOMETRY
 
 
 # ---------------------------------------------------------------------------
@@ -223,20 +390,30 @@ def _pow2_factor(x: int, cap: int) -> int:
     return min(cap_pow2, x & (-x))
 
 
-def ell_shape_ok(num_nodes: int, rows: int, width: int) -> bool:
-    return 0 < num_nodes <= _TABLE_MAX_ELEMS and rows > 0 and width > 0
+def ell_shape_ok(
+    num_nodes: int, rows: int, width: int,
+    geom: KernelGeometry | None = None,
+) -> bool:
+    g = geom if geom is not None else _GEOMETRY
+    return 0 < num_nodes <= g.table_max_elems and rows > 0 and width > 0
 
 
-def flat_shape_ok(num_nodes: int, num_slots: int) -> bool:
+def flat_shape_ok(
+    num_nodes: int, num_slots: int, geom: KernelGeometry | None = None
+) -> bool:
+    g = geom if geom is not None else _GEOMETRY
     return (
-        0 < num_nodes <= _TABLE_MAX_ELEMS
+        0 < num_nodes <= g.table_max_elems
         and num_slots >= _LANES
         and num_slots % _LANES == 0
     )
 
 
-def hook_shape_ok(num_nodes: int) -> bool:
-    return 0 < num_nodes <= _HOOK_MAX_NODES
+def hook_shape_ok(
+    num_nodes: int, geom: KernelGeometry | None = None
+) -> bool:
+    g = geom if geom is not None else _GEOMETRY
+    return 0 < num_nodes <= g.hook_max_nodes
 
 
 # ---------------------------------------------------------------------------
@@ -293,7 +470,9 @@ def fused_ell_row_min(fragment, verts, dstb, rankb):
     from jax.experimental import pallas as pl
 
     rows, width = dstb.shape
-    block = _pow2_factor(rows, max(1, _ELL_BLOCK_ELEMS // max(1, width)))
+    block = _pow2_factor(
+        rows, max(1, _GEOMETRY.ell_block_elems // max(1, width))
+    )
     grid = (rows // block,)
     return pl.pallas_call(
         _ell_row_min_kernel,
@@ -317,7 +496,7 @@ def fused_gather_key(fragment, src, dst, rank):
 
     e = src.shape[0]
     rows = e // _LANES
-    block = _pow2_factor(rows, _FLAT_BLOCK_ROWS)
+    block = _pow2_factor(rows, _GEOMETRY.flat_block_rows)
     shape2 = (rows, _LANES)
     blk = pl.BlockSpec((block, _LANES), lambda i: (i, 0))
     fsrc, key = pl.pallas_call(

@@ -213,6 +213,11 @@ class ShardedLane:
             kernel_choice,
         )
 
+        # The raw request is kept so per-bucket dispatch can re-resolve
+        # through the measured-auto tier (an installed TuningRecord's
+        # "mesh" entries, keyed (n_pad, m_pad, n_dev, "mesh")); self.kernel
+        # stays the construction-time resolution for stats and the repin.
+        self._kernel_request = kernel
         self.kernel = kernel_choice(kernel)
         self.capacity = capacity
         self.max_update_frac = max_update_frac
@@ -504,6 +509,21 @@ class ShardedLane:
                 # by a concurrent refresh for the dispatch's duration.
                 self._release(digest)
 
+    def _bucket_kernel(self, n_pad: int, m_pad: int) -> str:
+        """Per-bucket kernel resolution at dispatch: an installed
+        TuningRecord's ``mesh`` entry — keyed ``(n_pad, m_pad, n_dev,
+        "mesh")`` — can pin this bucket's measured winner; otherwise this
+        resolves exactly like construction did. The sticky
+        ``disable_pallas`` fallback (tripped by this lane's own repin too)
+        outranks any measured Pallas winner inside ``kernel_choice``."""
+        from distributed_ghs_implementation_tpu.ops.pallas_kernels import (
+            kernel_choice,
+        )
+
+        return kernel_choice(
+            self._kernel_request, bucket=(n_pad, m_pad, self.n_dev, "mesh")
+        )
+
     def _dispatch_solve(
         self,
         res: ResidentGraph,
@@ -519,6 +539,7 @@ class ShardedLane:
         boundaries the priority gate hooks."""
         mesh = self.mesh
         n_pad, m_pad = res.n_pad, res.m_pad
+        kern = self._bucket_kernel(n_pad, m_pad)
 
         def checkpoint():
             if yield_fn is not None:
@@ -529,9 +550,9 @@ class ShardedLane:
             edges=graph.num_edges, devices=self.n_dev, resident=resident,
         ) as span:
             _note_dispatch(
-                ("head", n_pad, m_pad, self.n_dev, self.kernel, mesh), phase
+                ("head", n_pad, m_pad, self.n_dev, kern, mesh), phase
             )
-            head = make_rank_sharded_head(mesh, self.kernel)
+            head = make_rank_sharded_head(mesh, kern)
             fragment, mst, fa, fb, stats = head(
                 res.vmin0, res.parent1, res.ra, res.rb
             )
@@ -542,10 +563,10 @@ class ShardedLane:
                 and self.n_dev * _bucket_size(cmax) > _FINISH_GATHER_MAX_SLOTS
             ):
                 _note_dispatch(
-                    ("level", n_pad, m_pad, self.n_dev, self.kernel, mesh),
+                    ("level", n_pad, m_pad, self.n_dev, kern, mesh),
                     phase,
                 )
-                level_fn = make_rank_sharded_level(mesh, kernel=self.kernel)
+                level_fn = make_rank_sharded_level(mesh, kernel=kern)
                 fragment, mst, fa, fb, lstats = level_fn(fragment, mst, fa, fb)
                 total, cmax, progressed = (
                     int(x) for x in jax.device_get(lstats)
@@ -559,11 +580,11 @@ class ShardedLane:
                 max_levels = _max_levels(n_pad)
                 _note_dispatch(
                     ("finish", n_pad, m_pad, fs_local, max_levels,
-                     self.n_dev, self.kernel, mesh),
+                     self.n_dev, kern, mesh),
                     phase,
                 )
                 finish = make_rank_sharded_finish(
-                    mesh, fs_local, max_levels, kernel=self.kernel
+                    mesh, fs_local, max_levels, kernel=kern
                 )
                 fragment, mst, extra = finish(fragment, mst, fa, fb)
                 lv += int(extra)

@@ -1,0 +1,58 @@
+"""Offline kernel autotuner: measured per-bucket kernel selection.
+
+The selector's ``auto`` tier used to be a pure heuristic — "Pallas on TPU
+when the probe passes, XLA everywhere else" — with the Pallas block
+shapes themselves hardcoded guesses. This package replaces the guess with
+a measurement, the same empirical bar the reference paper holds itself
+to (correctness by external oracle, timing by measurement):
+
+* :mod:`tune.space` — enumerate the valid candidates per solver bucket
+  (kernel x :class:`~..ops.pallas_kernels.KernelGeometry` knobs, with the
+  trace-time shape/VMEM guards as hard validity filters);
+* :mod:`tune.measure` — the seeded offline search: interpret-mode parity
+  check before any candidate is trusted, warm-then-median timing with
+  the bench conventions, bad candidates scored dead instead of crashing
+  the search; on non-TPU hosts winners deterministically pin ``xla``
+  (Pallas off-TPU is interpret mode — a parity tool, not a throughput
+  path), which is what makes the whole subsystem CI-testable;
+* :mod:`tune.record` — the persisted ``ghs-tuning-v1`` TuningRecord,
+  keyed by the machine fingerprint of ``utils/compile_cache`` and
+  protected by the round-19 integrity pattern (atomic writes + sha256
+  sidecars); staleness guards invalidate it when the jax version,
+  backend, or capability probe changes.
+
+Installing a record (``record.install_record``) makes it load-bearing:
+``pallas_kernels.kernel_choice``'s ``auto`` tier consults the measured
+winner for the bucket being resolved (``kernel.selected.measured`` on
+the obs bus), falling back to the probe heuristic for unknown buckets.
+``cli tune`` is the front end; docs/KERNELS.md "Autotuning" is the
+operator story.
+"""
+
+from distributed_ghs_implementation_tpu.tune.measure import search
+from distributed_ghs_implementation_tpu.tune.record import (
+    RECORD_SCHEMA,
+    default_record_path,
+    install_record,
+    load_and_install,
+    load_record,
+    save_record,
+)
+from distributed_ghs_implementation_tpu.tune.space import (
+    Candidate,
+    enumerate_candidates,
+    raw_space_size,
+)
+
+__all__ = [
+    "Candidate",
+    "RECORD_SCHEMA",
+    "default_record_path",
+    "enumerate_candidates",
+    "install_record",
+    "load_and_install",
+    "load_record",
+    "raw_space_size",
+    "save_record",
+    "search",
+]

@@ -1,0 +1,304 @@
+"""The seeded offline search: score candidates, pick measured winners.
+
+Per bucket the search enumerates the valid candidates (``tune/space.py``)
+and, for each, compiles a *fresh, uncached* executable under that
+candidate's geometry (``pallas_kernels.geometry_scope`` — the shared
+lane-solver cache is deliberately bypassed: its key has no geometry
+dimension, and the search must never poison a serving cache or reuse a
+different candidate's program). Scoring follows the bench conventions:
+one warm call (pays compile + first dispatch), then the median of
+``repeats`` timed calls on a seeded per-bucket workload.
+
+Trust discipline:
+
+* **Parity before trust** — a Pallas candidate's outputs are compared
+  element-exactly against the bucket's XLA reference before its timing
+  can win (off-TPU this is the interpret-mode parity check CPU CI runs).
+  A mismatch scores the candidate dead (``tune.search.rejected``).
+* **Failure carve-outs** — any exception while compiling or running a
+  candidate (a Mosaic lowering error, a geometry ValueError, an OOM)
+  marks that candidate dead and the search continues; the search itself
+  never trips the process's sticky ``disable_pallas`` fallback and never
+  crashes on a bad candidate.
+* **CPU pin** — off TPU, Pallas runs in interpret mode, which is a
+  correctness tool, not a throughput path: every Pallas candidate scores
+  as fallback and the winner deterministically pins ``xla`` (``source:
+  "cpu-pin"``). With ``dry=True`` the same pin applies on any backend
+  (``"dry-pin"``) and timing is skipped entirely — two dry runs produce
+  identical records byte for byte, which CI's ``gate-tune-v1`` asserts.
+
+Mesh buckets (``mode="mesh"``) score on the per-device flat proxy: the
+rank-sharded programs call the same fused kernels shard-locally at the
+per-device shapes, so the proxy measures the kernels the mesh actually
+runs, without needing a device mesh inside the tuner.
+"""
+
+from __future__ import annotations
+
+import functools
+import time
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+
+from distributed_ghs_implementation_tpu.batch import lanes as lanes_mod
+from distributed_ghs_implementation_tpu.graphs.generators import (
+    gnm_random_graph,
+)
+from distributed_ghs_implementation_tpu.models.boruvka import _solve_from_iota
+from distributed_ghs_implementation_tpu.obs.events import BUS
+from distributed_ghs_implementation_tpu.ops import pallas_kernels as _pk
+from distributed_ghs_implementation_tpu.tune import record as record_mod
+from distributed_ghs_implementation_tpu.tune import space as space_mod
+
+#: The repo-wide bench seed (bench.py) — the search is a benchmark too.
+SEED = 24
+
+Bucket = record_mod.Bucket
+
+
+def normalize_buckets(buckets: Iterable[Sequence]) -> List[Bucket]:
+    """Dedupe + canonicalize a bucket list (sorted, ints, validated)."""
+    seen = set()
+    for b in buckets:
+        n, m, lanes, mode = b
+        key = (int(n), int(m), max(0, int(lanes)), str(mode))
+        if key[3] not in space_mod.VALID_MODES:
+            raise ValueError(
+                f"unknown bucket mode {key[3]!r} in tune bucket {b!r}"
+            )
+        seen.add(key)
+    return sorted(seen)
+
+
+def mesh_bucket(num_nodes: int, num_edges: int, n_dev: int) -> Bucket:
+    """The mesh-lane bucket a RAW oversize workload stages at on an
+    ``n_dev``-device mesh — mirrors ``ShardedLane.pad_shape`` (bucket
+    sizes, rank width rounded up to the 8*n_dev byte-alignment unit)."""
+    import math
+
+    from distributed_ghs_implementation_tpu.models.boruvka import _bucket_size
+
+    n_dev = max(1, int(n_dev))
+    n_pad = _bucket_size(max(1, num_nodes))
+    unit = 8 * n_dev
+    m_pad = int(math.ceil(_bucket_size(max(1, num_edges)) / unit) * unit)
+    return (n_pad, m_pad, n_dev, "mesh")
+
+
+def _bucket_seed(seed: int, n_pad: int, m_pad: int) -> int:
+    return (seed ^ (n_pad * 1_000_003 + m_pad)) & 0x7FFFFFFF
+
+
+def _bucket_graph(n_pad: int, m_pad: int, seed: int):
+    """A seeded workload graph that pads into exactly this bucket, or
+    ``None`` when no simple graph can (next-pow2 inflation past the
+    distinct-pair count — such buckets carry no measurable traffic)."""
+    n = max(2, n_pad)
+    m = min(m_pad, n * (n - 1) // 2)
+    if lanes_mod.bucket_of(n, m) != (n_pad, m_pad):
+        return None
+    return gnm_random_graph(
+        n, m, seed=_bucket_seed(seed, n_pad, m_pad), ensure_connected=False
+    )
+
+
+def _lane_runner(graph, n_pad, m_pad, lanes, mode, candidate):
+    """A zero-arg callable running one *uncached* lane-solver dispatch
+    for the candidate; returns comparable host arrays."""
+    stacked = lanes_mod.stack_lanes(
+        [graph] * min(lanes, 2), lanes=lanes, mode=mode
+    )
+    with _pk.geometry_scope(candidate.geometry):
+        solver = lanes_mod._compile_bucket(
+            n_pad, m_pad, lanes, mode, candidate.kernel
+        )
+
+    def run():
+        return jax.device_get(solver(*stacked.arrays))
+
+    return run
+
+
+def _single_runner(graph, n_pad, m_pad, candidate):
+    """Uncached single-graph (and mesh per-device proxy) dispatch."""
+    src, dst, rank, ra, rb = graph.rank_arrays(
+        pad_edges_to=2 * m_pad, pad_ranks_to=m_pad
+    )
+    with _pk.geometry_scope(candidate.geometry):
+        fn = jax.jit(
+            functools.partial(
+                _solve_from_iota, num_nodes=n_pad, kernel=candidate.kernel
+            )
+        ).lower(src, dst, rank, ra, rb).compile()
+
+    def run():
+        return jax.device_get(fn(src, dst, rank, ra, rb))
+
+    return run
+
+
+def _make_runner(bucket: Bucket, candidate, graph):
+    n_pad, m_pad, lanes, mode = bucket
+    if mode in ("fused", "vmap") and lanes >= 1:
+        return _lane_runner(graph, n_pad, m_pad, lanes, mode, candidate)
+    return _single_runner(graph, n_pad, m_pad, candidate)
+
+
+def _outputs_equal(a, b) -> bool:
+    if a is None or b is None:
+        return False
+    for x, y in zip(a, b):
+        if not np.array_equal(np.asarray(x), np.asarray(y)):
+            return False
+    return True
+
+
+def _median(times: List[float]) -> float:
+    times = sorted(times)
+    return times[len(times) // 2]
+
+
+def search(
+    buckets: Iterable[Sequence],
+    *,
+    repeats: int = 5,
+    warm: int = 1,
+    seed: int = SEED,
+    dry: bool = False,
+) -> dict:
+    """Run the offline search over ``buckets``; returns a ``ghs-tuning-v1``
+    record dict (``tune/record.py`` persists/installs it).
+
+    ``dry`` skips all timing and pins winners (``xla``) on any backend —
+    the deterministic CI mode. Off TPU the pin applies regardless of
+    ``dry`` (interpret-mode Pallas never wins on time), so a CPU search
+    is always byte-reproducible.
+    """
+    if repeats < 1:
+        raise ValueError(f"repeats must be >= 1, got {repeats}")
+    bucket_list = normalize_buckets(buckets)
+    on_tpu = jax.default_backend() == "tpu"
+    pinned = dry or not on_tpu
+    pin_source = "dry-pin" if (dry and on_tpu) else "cpu-pin"
+    entries: Dict[Bucket, dict] = {}
+    with BUS.span(
+        "tune.search", cat="tune",
+        buckets=len(bucket_list), dry=dry, pinned=pinned,
+    ):
+        for bucket in bucket_list:
+            entries[bucket] = _search_bucket(
+                bucket, repeats=repeats, warm=warm, seed=seed,
+                pinned=pinned, pin_source=pin_source,
+            )
+    return record_mod.new_record(entries, pinned=pinned)
+
+
+def _search_bucket(
+    bucket: Bucket, *, repeats: int, warm: int, seed: int,
+    pinned: bool, pin_source: str,
+) -> dict:
+    n_pad, m_pad, lanes, mode = bucket
+    candidates = space_mod.enumerate_candidates(n_pad, m_pad, lanes, mode)
+    rejected = space_mod.raw_space_size(mode) - len(candidates)
+    for c in candidates:
+        BUS.count("tune.search.candidate")
+        BUS.instant(
+            "tune.search.candidate_detail", cat="tune",
+            bucket=record_mod.bucket_key_str(bucket), candidate=c.label(),
+        )
+    graph = _bucket_graph(n_pad, m_pad, seed)
+    if graph is None:
+        # Next-pow2 inflation past the distinct-pair count: no simple
+        # graph pads here, so there is nothing to measure — the probe
+        # heuristic keeps the bucket.
+        rejected += len(candidates) - 1
+        BUS.count("tune.search.rejected", len(candidates) - 1)
+        return {
+            "kernel": _pk.kernel_choice(),
+            "source": "unreachable",
+            "geometry": _pk.DEFAULT_GEOMETRY.to_json(),
+            "candidates": len(candidates),
+            "rejected": rejected,
+            "parity": "skipped",
+        }
+
+    reference = None  # the XLA candidate's outputs — the parity oracle
+    scores: List[Tuple[float, int]] = []  # (median_s, candidate index)
+    parity = "skipped"
+    dead = 0
+    for idx, cand in enumerate(candidates):
+        # Pinned mode never times, and only parity-checks one
+        # representative Pallas geometry (the first): off-TPU every
+        # Pallas candidate is fallback by construction, so the cheap
+        # interpret parity probe is about exercising the oracle, not
+        # ranking losers.
+        is_parity_rep = cand.kernel == "pallas" and (
+            reference is not None and parity == "skipped"
+        )
+        if pinned and cand.kernel == "pallas" and not is_parity_rep:
+            continue
+        try:
+            run = _make_runner(bucket, cand, graph)
+            out = run()
+            if cand.kernel == "xla":
+                reference = out
+            else:
+                ok = _outputs_equal(reference, out)
+                if parity != "failed":  # a parity failure is sticky
+                    parity = "ok" if ok else "failed"
+                if not ok:
+                    dead += 1
+                    BUS.count("tune.search.rejected")
+                    BUS.instant(
+                        "tune.search.parity_failed", cat="tune",
+                        bucket=record_mod.bucket_key_str(bucket),
+                        candidate=cand.label(),
+                    )
+                    continue
+            if pinned:
+                continue
+            for _ in range(max(0, warm - 1)):
+                run()
+            times = []
+            for _ in range(repeats):
+                t0 = time.perf_counter()
+                run()
+                times.append(time.perf_counter() - t0)
+            scores.append((_median(times), idx))
+        except Exception as ex:  # noqa: BLE001 — scored dead, search lives
+            dead += 1
+            BUS.count("tune.search.rejected")
+            BUS.instant(
+                "tune.search.candidate_failed", cat="tune",
+                bucket=record_mod.bucket_key_str(bucket),
+                candidate=cand.label(), error=f"{type(ex).__name__}: {ex}",
+            )
+
+    rejected += dead
+    if pinned or not scores:
+        winner = candidates[0]  # the XLA reference
+        return {
+            "kernel": winner.kernel,
+            "source": pin_source if pinned else "no-survivors",
+            "geometry": winner.geometry.to_json(),
+            "candidates": len(candidates),
+            "rejected": rejected,
+            "parity": parity,
+        }
+    scores.sort()
+    best_s, best_idx = scores[0]
+    winner = candidates[best_idx]
+    entry = {
+        "kernel": winner.kernel,
+        "source": "measured",
+        "geometry": winner.geometry.to_json(),
+        "candidates": len(candidates),
+        "rejected": rejected,
+        "parity": parity,
+        "median_s": round(best_s, 6),
+    }
+    if len(scores) > 1:
+        entry["runner_up_s"] = round(scores[1][0], 6)
+    return entry

@@ -1,0 +1,256 @@
+"""The ``ghs-tuning-v1`` TuningRecord: persisted measured winners.
+
+A record is one machine's measured per-bucket kernel selections, keyed by
+the same platform fingerprint the persistent XLA compile cache shards on
+(``utils/compile_cache._platform_fingerprint``): backend + device kind on
+accelerators, a CPU-feature digest on hosts. Persistence follows the
+round-19 integrity pattern (``utils/integrity.py``): atomic tmp+rename
+writes with an fsync, a ``.sha256`` sidecar written after the payload,
+and verification on load — a torn or tampered record is quarantined,
+never trusted.
+
+Staleness guards make the record self-invalidating: it embeds the
+fingerprint, backend, jax version, and capability-probe result it was
+measured under, and :func:`load_record` refuses (``tune.record.stale``)
+when any of them no longer match — a record measured on one machine, one
+jax, or one probe outcome says nothing about another. Loads land on the
+obs bus as ``tune.record.hit`` / ``miss`` / ``stale`` so a serving
+process can *prove* whether its selections are measured.
+
+Determinism contract: :func:`save_record` emits canonical JSON (sorted
+keys, fixed indent, no timestamps), so two runs of the same deterministic
+search produce byte-identical files — what CI's ``gate-tune-v1`` pins.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import tempfile
+from typing import Dict, Optional, Tuple
+
+import jax
+
+from distributed_ghs_implementation_tpu.obs.events import BUS
+from distributed_ghs_implementation_tpu.ops import pallas_kernels as _pk
+from distributed_ghs_implementation_tpu.utils.compile_cache import (
+    _platform_fingerprint,
+    default_cache_dir,
+)
+from distributed_ghs_implementation_tpu.utils import integrity
+
+RECORD_SCHEMA = "ghs-tuning-v1"
+
+Bucket = Tuple[int, int, int, str]  # (n_pad, m_pad, lanes, mode)
+
+#: Matches ``tune.space.VALID_MODES`` (kept literal here: record parsing
+#: must stay importable without the search machinery).
+_VALID_MODES = ("fused", "vmap", "ell", "mesh")
+
+
+class TuningRecordError(ValueError):
+    """A record file that cannot be used (bad schema, bad entry) — raised
+    only for *malformed* files; stale-but-well-formed records degrade to
+    ``None`` (the probe heuristic), never an error."""
+
+
+def bucket_key_str(bucket: Bucket) -> str:
+    n, m, lanes, mode = bucket
+    return f"{int(n)}x{int(m)}x{int(lanes)}x{mode}"
+
+
+def parse_bucket_key(key: str) -> Bucket:
+    parts = key.split("x")
+    if len(parts) != 4:
+        raise TuningRecordError(
+            f"bad tuning bucket key {key!r}; expected NxMxLANESxMODE"
+        )
+    try:
+        n, m, lanes = int(parts[0]), int(parts[1]), int(parts[2])
+    except ValueError as ex:
+        raise TuningRecordError(
+            f"bad tuning bucket key {key!r}: {ex}"
+        ) from None
+    if n < 1 or m < 1 or lanes < 0:
+        raise TuningRecordError(
+            f"bad tuning bucket key {key!r}: sizes must be positive"
+        )
+    if parts[3] not in _VALID_MODES:
+        raise TuningRecordError(
+            f"bad tuning bucket key {key!r}: unknown mode {parts[3]!r} "
+            f"(expected one of {_VALID_MODES})"
+        )
+    return (n, m, lanes, parts[3])
+
+
+def fingerprint() -> str:
+    """The machine identity records are keyed by (shared with the
+    persistent XLA compile cache, so 'same cache, same record')."""
+    return _platform_fingerprint()
+
+
+def new_record(entries: Dict[Bucket, dict], *, pinned: bool) -> dict:
+    """Assemble a record dict around measured ``entries`` (bucket ->
+    ``{"kernel", "source", "geometry", ...}``) with the staleness-guard
+    environment embedded."""
+    return {
+        "schema": RECORD_SCHEMA,
+        "fingerprint": fingerprint(),
+        "backend": jax.default_backend(),
+        "jax_version": jax.__version__,
+        "probe_ok": bool(_pk.pallas_supported()),
+        "pinned": bool(pinned),
+        "entries": {
+            bucket_key_str(b): entries[b] for b in sorted(entries)
+        },
+    }
+
+
+def default_record_path(directory: Optional[str] = None) -> str:
+    """``<dir>/tuning-<fingerprint>.json``; ``dir`` defaults to
+    ``$GHS_TUNE_DIR`` or a ``tune`` sibling of the compile-cache dir —
+    fleet workers on one host share it exactly like the XLA cache."""
+    d = directory or os.environ.get("GHS_TUNE_DIR")
+    if not d:
+        d = os.path.join(os.path.dirname(default_cache_dir()), "ghs-tune")
+    return os.path.join(d, f"tuning-{fingerprint()}.json")
+
+
+def save_record(record: dict, path: str) -> str:
+    """Atomically persist a record + its sha256 sidecar; returns ``path``.
+
+    Canonical serialization (sorted keys, fixed indent): a deterministic
+    search yields a byte-deterministic file.
+    """
+    if record.get("schema") != RECORD_SCHEMA:
+        raise TuningRecordError(
+            f"refusing to save record with schema {record.get('schema')!r}"
+        )
+    payload = json.dumps(record, indent=2, sort_keys=True) + "\n"
+    d = os.path.dirname(os.path.abspath(path))
+    os.makedirs(d, exist_ok=True)
+    fd, tmp = tempfile.mkstemp(dir=d, prefix=".tuning-", suffix=".tmp")
+    try:
+        with os.fdopen(fd, "w") as f:
+            f.write(payload)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+    except BaseException:
+        if os.path.exists(tmp):
+            os.unlink(tmp)
+        raise
+    integrity.write_sidecar(path)
+    return path
+
+
+def _stale(path: str, why: str) -> None:
+    BUS.count("tune.record.stale")
+    BUS.instant("tune.record.stale_detail", cat="tune", path=path, why=why)
+
+
+def load_record(path: str) -> Optional[dict]:
+    """Load + verify a record; ``None`` on miss or staleness (the caller
+    falls back to the probe heuristic), raises :class:`TuningRecordError`
+    only on a malformed file.
+
+    Verification order: existence (``tune.record.miss``) → sidecar
+    integrity (corrupt records are quarantined) → schema/entry shape →
+    staleness guards (fingerprint, backend, jax version, probe result —
+    any mismatch counts ``tune.record.stale``). A verified fresh record
+    counts ``tune.record.hit``.
+    """
+    if not os.path.exists(path):
+        BUS.count("tune.record.miss")
+        return None
+    try:
+        integrity.check_file(path)
+    except integrity.IntegrityError as ex:
+        integrity.quarantine(
+            path, reason=f"tuning record failed integrity: {ex}",
+            counter="tune.record.quarantined",
+        )
+        _stale(path, "integrity")
+        return None
+    with open(path) as f:
+        try:
+            record = json.load(f)
+        except json.JSONDecodeError as ex:
+            raise TuningRecordError(f"{path}: not JSON: {ex}") from None
+    if record.get("schema") != RECORD_SCHEMA:
+        raise TuningRecordError(
+            f"{path}: bad tuning record schema {record.get('schema')!r} "
+            f"(expected {RECORD_SCHEMA})"
+        )
+    entries = record.get("entries")
+    if not isinstance(entries, dict):
+        raise TuningRecordError(f"{path}: record has no entries mapping")
+    for key, entry in entries.items():
+        parse_bucket_key(key)  # raises TuningRecordError, names the key
+        if not isinstance(entry, dict) or entry.get("kernel") not in (
+            "pallas", "xla",
+        ):
+            raise TuningRecordError(
+                f"{path}: entry {key!r} has no pallas|xla winner "
+                f"(got {entry!r})"
+            )
+    # Staleness: the measuring environment must match the consuming one.
+    if record.get("fingerprint") != fingerprint():
+        _stale(path, "fingerprint")
+        return None
+    if record.get("backend") != jax.default_backend():
+        _stale(path, "backend")
+        return None
+    if record.get("jax_version") != jax.__version__:
+        _stale(path, "jax_version")
+        return None
+    if bool(record.get("probe_ok")) != bool(_pk.pallas_supported()):
+        _stale(path, "probe")
+        return None
+    BUS.count("tune.record.hit")
+    return record
+
+
+def winners(record: dict) -> Dict[Bucket, str]:
+    """``bucket -> kernel`` mapping of a (validated) record."""
+    return {
+        parse_bucket_key(key): entry["kernel"]
+        for key, entry in record.get("entries", {}).items()
+    }
+
+
+def install_record(record: dict, *, path: Optional[str] = None) -> int:
+    """Make a loaded record load-bearing: install its winners into the
+    selector's measured-auto tier (``pallas_kernels.set_tuned_kernels``)
+    and, when every Pallas winner agrees on one geometry, apply that
+    geometry process-wide (so warmed buckets compile the tuned variant).
+    Returns the number of installed bucket winners."""
+    mapping = winners(record)
+    geoms = {
+        json.dumps(entry.get("geometry"), sort_keys=True)
+        for entry in record.get("entries", {}).values()
+        if entry.get("kernel") == "pallas" and entry.get("geometry")
+    }
+    if len(geoms) == 1:
+        _pk.set_geometry(
+            _pk.KernelGeometry.from_json(json.loads(next(iter(geoms))))
+        )
+    _pk.set_tuned_kernels(
+        mapping,
+        source={
+            "fingerprint": record.get("fingerprint"),
+            "path": path,
+            "entries": len(mapping),
+            "pinned": bool(record.get("pinned")),
+        },
+    )
+    return len(mapping)
+
+
+def load_and_install(path: str) -> int:
+    """Convenience: :func:`load_record` then :func:`install_record`;
+    returns 0 (and installs nothing) on miss/stale."""
+    record = load_record(path)
+    if record is None:
+        return 0
+    return install_record(record, path=path)

@@ -1,0 +1,134 @@
+"""Candidate enumeration: the autotuner's per-bucket search space.
+
+A **candidate** is a kernel variant plus the geometry it traces under:
+``("xla", default)`` — the always-valid reference — or ``("pallas", g)``
+for every :class:`~..ops.pallas_kernels.KernelGeometry` in the knob grid
+that passes the same trace-time guards the request path applies. Guards
+are *hard validity filters*: a geometry whose fragment table cannot be
+VMEM-resident for the bucket, or whose flat slots are off the 128-lane
+grid, is not "slow", it is not a Pallas candidate at all (the wrapper
+would silently route to the XLA form, so measuring it would measure the
+wrong thing).
+
+Which knobs vary depends on the bucket's mode, because each solver path
+touches a different kernel:
+
+* lane buckets (``fused`` / ``vmap``) and mesh buckets run the flat iota
+  solve — ``fused_gather_key`` (``flat_block_rows``) and
+  ``fused_hook_compress`` (``hook_max_nodes``);
+* ``ell`` buckets run the degree-bucketed search — ``ell_block_elems``
+  and ``hook_max_nodes``.
+
+Enumeration is pure and deterministic (sorted grids, no clocks, no
+randomness): two hosts with the same bucket list derive the same
+candidate lists, which is half of what makes ``cli tune --dry`` byte-
+reproducible (the other half is the CPU pin in ``tune/measure.py``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import List, Tuple
+
+from distributed_ghs_implementation_tpu.ops.pallas_kernels import (
+    DEFAULT_GEOMETRY,
+    KernelGeometry,
+    flat_shape_ok,
+)
+
+#: The knob grids. Small on purpose: the search multiplies per bucket,
+#: and each Pallas candidate costs a parity solve before it may be timed.
+FLAT_ROW_CHOICES: Tuple[int, ...] = (128, 256, 512)
+ELL_BLOCK_CHOICES: Tuple[int, ...] = (1 << 14, 1 << 15, 1 << 16)
+HOOK_NODE_CHOICES: Tuple[int, ...] = (1 << 18, 1 << 19)
+
+#: Bucket modes the tuner understands. ``mesh`` is the sharded lane's
+#: per-bucket key space (lanes field carries the device count).
+VALID_MODES = ("fused", "vmap", "ell", "mesh")
+
+
+@dataclasses.dataclass(frozen=True)
+class Candidate:
+    """One point of the search space: a kernel and its trace geometry."""
+
+    kernel: str  # "pallas" | "xla"
+    geometry: KernelGeometry = DEFAULT_GEOMETRY
+
+    def label(self) -> str:
+        """Stable human/obs label (also the dedup key in records)."""
+        if self.kernel == "xla":
+            return "xla"
+        g = self.geometry
+        return (
+            f"pallas/ell{g.ell_block_elems}"
+            f"/flat{g.flat_block_rows}/hook{g.hook_max_nodes}"
+        )
+
+    def to_json(self) -> dict:
+        return {"kernel": self.kernel, "geometry": self.geometry.to_json()}
+
+
+def _bucket_extent(
+    n_pad: int, m_pad: int, lanes: int, mode: str
+) -> Tuple[int, int]:
+    """``(total_nodes, total_slots)`` the kernels actually see for a
+    bucket — fused lanes stack block-diagonally into one big graph, vmap
+    and mesh keep per-lane / per-device shapes."""
+    k = max(1, lanes)
+    if mode == "fused":
+        return k * n_pad, k * 2 * m_pad
+    return n_pad, 2 * m_pad
+
+
+def candidate_valid(
+    geom: KernelGeometry, n_pad: int, m_pad: int, lanes: int, mode: str
+) -> bool:
+    """Would a Pallas trace under ``geom`` actually take the fused path
+    for this bucket? The request path's own guards, applied up front."""
+    total_nodes, total_slots = _bucket_extent(n_pad, m_pad, lanes, mode)
+    if mode == "ell":
+        # ELL row geometry is data-dependent (degree buckets); the table
+        # residency bound is the shape-independent hard gate.
+        return 0 < total_nodes <= geom.table_max_elems
+    return flat_shape_ok(total_nodes, total_slots, geom)
+
+
+def raw_space_size(mode: str) -> int:
+    """Grid size before validity filtering (the denominator for
+    ``tune.search.rejected`` accounting)."""
+    if mode == "ell":
+        return 1 + len(ELL_BLOCK_CHOICES) * len(HOOK_NODE_CHOICES)
+    return 1 + len(FLAT_ROW_CHOICES) * len(HOOK_NODE_CHOICES)
+
+
+def enumerate_candidates(
+    n_pad: int, m_pad: int, lanes: int, mode: str
+) -> List[Candidate]:
+    """The valid candidates for one solver bucket, deterministic order:
+    the XLA reference first, then the Pallas grid (sorted knob order)."""
+    if mode not in VALID_MODES:
+        raise ValueError(
+            f"unknown tune bucket mode {mode!r}; expected one of "
+            f"{VALID_MODES}"
+        )
+    if n_pad < 1 or m_pad < 1 or lanes < 0:
+        raise ValueError(
+            f"bad tune bucket ({n_pad}, {m_pad}, {lanes}, {mode!r}): "
+            "sizes must be positive, lanes non-negative"
+        )
+    out: List[Candidate] = [Candidate("xla")]
+    if mode == "ell":
+        for ell in ELL_BLOCK_CHOICES:
+            for hook in HOOK_NODE_CHOICES:
+                geom = KernelGeometry(
+                    ell_block_elems=ell, hook_max_nodes=hook
+                )
+                if candidate_valid(geom, n_pad, m_pad, lanes, mode):
+                    out.append(Candidate("pallas", geom))
+        return out
+    for rows in FLAT_ROW_CHOICES:
+        for hook in HOOK_NODE_CHOICES:
+            geom = KernelGeometry(flat_block_rows=rows, hook_max_nodes=hook)
+            if candidate_valid(geom, n_pad, m_pad, lanes, mode):
+                out.append(Candidate("pallas", geom))
+    return out

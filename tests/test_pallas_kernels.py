@@ -148,15 +148,16 @@ def _staged_arrays(g):
 # Shape guards: guarded geometries take the XLA form, never an error
 # ---------------------------------------------------------------------------
 def test_shape_guards():
+    geom = pk.geometry()
     assert not pk.hook_shape_ok(0)
-    assert not pk.hook_shape_ok(pk._HOOK_MAX_NODES + 1)
-    assert pk.hook_shape_ok(pk._HOOK_MAX_NODES)
+    assert not pk.hook_shape_ok(geom.hook_max_nodes + 1)
+    assert pk.hook_shape_ok(geom.hook_max_nodes)
     assert not pk.flat_shape_ok(100, 130)  # not a lane multiple
     assert not pk.flat_shape_ok(100, 64)  # under one lane row
-    assert not pk.flat_shape_ok(pk._TABLE_MAX_ELEMS + 1, 1024)
+    assert not pk.flat_shape_ok(geom.table_max_elems + 1, 1024)
     assert pk.flat_shape_ok(100, 128)
     assert not pk.ell_shape_ok(0, 4, 4)
-    assert not pk.ell_shape_ok(pk._TABLE_MAX_ELEMS + 1, 4, 4)
+    assert not pk.ell_shape_ok(geom.table_max_elems + 1, 4, 4)
     assert pk.ell_shape_ok(100, 4, 4)
 
 

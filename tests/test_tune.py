@@ -1,0 +1,387 @@
+"""The offline kernel autotuner (round 23, ``tune/`` + docs/KERNELS.md
+"Autotuning"): geometry promotion, search-space validity, record
+persistence/staleness, the deterministic CPU-pinned dry search, and the
+selector precedence ladder with measured winners installed.
+
+Everything here runs on the CPU backend (conftest pins it), where the
+search deterministically pins ``xla`` winners — the same contract CI's
+``gate-tune-v1`` byte-checks — so the suite needs no hardware and no
+tolerance knobs.
+"""
+
+import dataclasses
+import json
+
+import pytest
+
+from distributed_ghs_implementation_tpu.batch import lanes as lanes_mod
+from distributed_ghs_implementation_tpu.graphs.generators import gnm_random_graph
+from distributed_ghs_implementation_tpu.obs.events import BUS
+from distributed_ghs_implementation_tpu.ops import pallas_kernels as pk
+from distributed_ghs_implementation_tpu.tune import measure as tune_measure
+from distributed_ghs_implementation_tpu.tune import record as tune_record
+from distributed_ghs_implementation_tpu.tune import space as tune_space
+from distributed_ghs_implementation_tpu.tune.measure import mesh_bucket, search
+from distributed_ghs_implementation_tpu.tune.record import (
+    TuningRecordError,
+    install_record,
+    load_and_install,
+    load_record,
+    parse_bucket_key,
+    save_record,
+)
+
+
+@pytest.fixture(autouse=True)
+def _clean_kernel_state(monkeypatch):
+    """Round-15 shield: no ambient GHS_KERNEL, no sticky fallback, no
+    leftover tuned state or geometry from another test."""
+    monkeypatch.delenv("GHS_KERNEL", raising=False)
+    pk._reset_for_tests()
+    yield
+    pk._reset_for_tests()
+
+
+@pytest.fixture()
+def bus():
+    BUS.enable()
+    BUS.clear()
+    yield BUS
+    BUS.enable()
+    BUS.clear()
+
+
+# ----------------------------------------------------------------------
+# Satellite 1: KernelGeometry promotion + boundary validation
+# ----------------------------------------------------------------------
+def test_geometry_defaults_match_promoted_constants():
+    g = pk.DEFAULT_GEOMETRY
+    assert g.table_max_elems == 1 << 20
+    assert g.hook_max_nodes == 1 << 19
+    assert g.ell_block_elems == 1 << 15
+    assert g.flat_block_rows == 256
+
+
+def test_geometry_json_round_trip():
+    g = pk.KernelGeometry(flat_block_rows=512)
+    assert pk.KernelGeometry.from_json(g.to_json()) == g
+
+
+def test_geometry_from_json_rejects_unknown_fields():
+    with pytest.raises((TypeError, ValueError)):
+        pk.KernelGeometry.from_json({"flat_block_rows": 256, "bogus": 1})
+
+
+@pytest.mark.parametrize("field", [
+    "table_max_elems", "hook_max_nodes", "ell_block_elems",
+    "flat_block_rows",
+])
+def test_geometry_rejects_non_power_of_two_and_nonpositive(field):
+    for bad in (0, -8, 3, 1000):
+        with pytest.raises(ValueError):
+            pk.KernelGeometry(**{field: bad})
+    with pytest.raises((TypeError, ValueError)):
+        pk.KernelGeometry(**{field: True})
+
+
+@pytest.mark.parametrize("field,ceiling", [
+    ("table_max_elems", 1 << 22),
+    ("hook_max_nodes", 1 << 20),
+    ("ell_block_elems", 1 << 18),
+    ("flat_block_rows", 1 << 12),
+])
+def test_geometry_ceilings_are_inclusive_boundaries(field, ceiling):
+    # Exactly at the VMEM ceiling is valid; one power-of-two past is not.
+    pk.KernelGeometry(**{field: ceiling})
+    with pytest.raises(ValueError):
+        pk.KernelGeometry(**{field: ceiling * 2})
+
+
+def test_set_geometry_rejects_wrong_type_and_scope_restores():
+    with pytest.raises(TypeError):
+        pk.set_geometry({"flat_block_rows": 256})
+    custom = pk.KernelGeometry(hook_max_nodes=1 << 18)
+    with pk.geometry_scope(custom):
+        assert pk.geometry() is custom
+        assert not pk.hook_shape_ok((1 << 18) + 1)
+    assert pk.geometry() == pk.DEFAULT_GEOMETRY
+    assert pk.hook_shape_ok((1 << 18) + 1)
+
+
+def test_shape_guards_at_divisibility_and_vmem_edges():
+    g = pk.KernelGeometry()
+    # Table ceiling is inclusive; the flat guard also demands whole lanes.
+    assert pk.ell_shape_ok(g.table_max_elems, 8, 8, geom=g)
+    assert not pk.ell_shape_ok(g.table_max_elems + 1, 8, 8, geom=g)
+    assert pk.flat_shape_ok(64, 128, geom=g)
+    assert not pk.flat_shape_ok(64, 127, geom=g)  # not lane-divisible
+    assert not pk.flat_shape_ok(64, 0, geom=g)
+    assert pk.hook_shape_ok(g.hook_max_nodes, geom=g)
+    assert not pk.hook_shape_ok(g.hook_max_nodes + 1, geom=g)
+
+
+def test_explicit_geom_beats_installed_geometry():
+    small = pk.KernelGeometry(table_max_elems=1 << 10)
+    pk.set_geometry(small)
+    try:
+        assert not pk.flat_shape_ok(1 << 12, 1 << 13)  # installed: too big
+        assert pk.flat_shape_ok(1 << 12, 1 << 13, geom=pk.DEFAULT_GEOMETRY)
+    finally:
+        pk.set_geometry(None)
+
+
+# ----------------------------------------------------------------------
+# tune/space.py: candidate enumeration
+# ----------------------------------------------------------------------
+def test_enumerate_candidates_xla_first_deterministic_and_valid():
+    a = tune_space.enumerate_candidates(256, 1024, 4, "fused")
+    b = tune_space.enumerate_candidates(256, 1024, 4, "fused")
+    assert a == b
+    assert a[0].kernel == "xla"
+    assert all(c.kernel == "pallas" for c in a[1:])
+    assert len(a) <= tune_space.raw_space_size("fused")
+    assert len({c.label() for c in a}) == len(a)
+
+
+def test_invalid_geometries_are_filtered_not_scored():
+    # A bucket bigger than the smallest table ceiling in the grid would
+    # admit fewer candidates than the raw grid; validity is a hard gate.
+    small = tune_space.enumerate_candidates(64, 256, 2, "fused")
+    assert all(
+        tune_space.candidate_valid(c.geometry, 64, 256, 2, "fused")
+        for c in small
+    )
+    with pytest.raises(ValueError):
+        tune_measure.normalize_buckets([(64, 256, 2, "warp")])
+
+
+def test_normalize_buckets_dedupes_and_sorts():
+    out = tune_measure.normalize_buckets(
+        [(256, 1024, 4, "fused"), (64, 256, 0, "fused"),
+         (256, 1024, 4, "fused")]
+    )
+    assert out == [(64, 256, 0, "fused"), (256, 1024, 4, "fused")]
+
+
+def test_mesh_bucket_mirrors_lane_padding():
+    from distributed_ghs_implementation_tpu.models.boruvka import _bucket_size
+
+    b = mesh_bucket(70_000, 140_000, 8)
+    n_pad, m_pad, n_dev, mode = b
+    assert (n_dev, mode) == (8, "mesh")
+    assert n_pad == _bucket_size(70_000)
+    assert m_pad >= _bucket_size(140_000) and m_pad % (8 * 8) == 0
+
+
+# ----------------------------------------------------------------------
+# tune/measure.py: the dry (pinned) search
+# ----------------------------------------------------------------------
+BUCKETS = [(64, 256, 2, "fused"), (64, 256, 0, "fused")]
+
+
+def test_dry_search_is_deterministic_and_cpu_pins_xla(bus):
+    rec_a = search(BUCKETS, dry=True)
+    rec_b = search(BUCKETS, dry=True)
+    assert rec_a == rec_b
+    assert rec_a["pinned"] is True
+    for key, entry in rec_a["entries"].items():
+        assert entry["kernel"] == "xla", key
+        assert entry["source"] == "cpu-pin"
+        assert entry["parity"] in ("ok", "skipped")
+    counters = bus.counters()
+    assert counters.get("tune.search.candidate", 0) > 0
+
+
+def test_search_scores_bad_candidate_dead_without_global_fallback(bus,
+                                                                  monkeypatch):
+    # A candidate that explodes at compile time must be rejected in place
+    # — never tripping the process-wide sticky disable_pallas.
+    real = tune_measure._make_runner
+
+    def bomb(bucket, candidate, graph):
+        if candidate.kernel == "pallas":
+            raise RuntimeError("mosaic says no")
+        return real(bucket, candidate, graph)
+
+    monkeypatch.setattr(tune_measure, "_make_runner", bomb)
+    rec = search([(64, 256, 2, "fused")], dry=True)
+    entry = next(iter(rec["entries"].values()))
+    assert entry["kernel"] == "xla"
+    assert bus.counters().get("tune.search.rejected", 0) >= 1
+    assert pk.kernel_choice("pallas") == "pallas"  # still not disabled
+
+
+def test_unreachable_bucket_reports_probe_heuristic():
+    # Padded edge count beyond C(n,2): no simple graph can land there.
+    rec = search([(4, 1024, 0, "fused")], dry=True)
+    entry = rec["entries"]["4x1024x0xfused"]
+    assert entry["source"] == "unreachable"
+    assert entry["kernel"] in ("pallas", "xla")
+
+
+# ----------------------------------------------------------------------
+# tune/record.py: persistence, staleness, integrity
+# ----------------------------------------------------------------------
+def test_record_save_is_byte_deterministic_and_round_trips(tmp_path, bus):
+    rec = search(BUCKETS, dry=True)
+    p1, p2 = str(tmp_path / "a.json"), str(tmp_path / "b.json")
+    save_record(rec, p1)
+    save_record(rec, p2)
+    assert (tmp_path / "a.json").read_bytes() == (tmp_path / "b.json").read_bytes()
+    assert (tmp_path / "a.json.sha256").exists()
+    loaded = load_record(p1)
+    assert loaded == rec
+    assert bus.counters().get("tune.record.hit", 0) >= 1
+
+
+def test_missing_record_is_a_miss_not_an_error(tmp_path, bus):
+    assert load_record(str(tmp_path / "nope.json")) is None
+    assert bus.counters().get("tune.record.miss", 0) == 1
+
+
+@pytest.mark.parametrize("field,value", [
+    ("fingerprint", "other-machine-0000"),
+    ("jax_version", "0.0.1"),
+    ("backend", "tpu"),
+    ("probe_ok", None),
+])
+def test_stale_records_degrade_to_none(tmp_path, bus, field, value):
+    rec = search(BUCKETS, dry=True)
+    if field == "probe_ok":
+        rec["probe_ok"] = not rec["probe_ok"]
+    else:
+        rec[field] = value
+    path = str(tmp_path / "stale.json")
+    save_record(rec, path)
+    assert load_record(path) is None
+    assert bus.counters().get("tune.record.stale", 0) == 1
+    assert load_and_install(path) == 0
+    assert pk.tuned_summary() is None or not pk.tuned_summary()
+
+
+def test_corrupt_record_quarantines(tmp_path, bus):
+    rec = search(BUCKETS, dry=True)
+    path = str(tmp_path / "rot.json")
+    save_record(rec, path)
+    raw = bytearray((tmp_path / "rot.json").read_bytes())
+    raw[len(raw) // 2] ^= 0x40  # bit rot inside the payload
+    (tmp_path / "rot.json").write_bytes(bytes(raw))
+    assert load_record(path) is None
+    assert bus.counters().get("tune.record.quarantined", 0) == 1
+
+
+def test_malformed_record_raises_typed_error(tmp_path):
+    path = tmp_path / "bad.json"
+    path.write_text(json.dumps({"schema": "ghs-tuning-v1", "entries": {
+        "64x256x2xfused": {"kernel": "cuda"},
+    }}))
+    with pytest.raises(TuningRecordError):
+        load_record(str(path))
+    path2 = tmp_path / "worse.json"
+    path2.write_text(json.dumps({"schema": "something-else"}))
+    with pytest.raises(TuningRecordError):
+        load_record(str(path2))
+
+
+def test_bucket_key_round_trip_and_rejection():
+    b = (256, 1024, 4, "fused")
+    assert parse_bucket_key(tune_record.bucket_key_str(b)) == b
+    for bad in ("256x1024", "axbxcxd", "1x2x3xwarp"):
+        with pytest.raises(TuningRecordError):
+            parse_bucket_key(bad)
+
+
+def test_install_record_applies_consensus_geometry_only():
+    geom = tune_space.Candidate(
+        kernel="pallas",
+        geometry=pk.KernelGeometry(flat_block_rows=512),
+    ).geometry
+    entries = {
+        (64, 256, 2, "fused"): {
+            "kernel": "pallas", "source": "measured",
+            "geometry": geom.to_json(),
+        },
+        (64, 256, 0, "fused"): {
+            "kernel": "xla", "source": "measured",
+            "geometry": pk.DEFAULT_GEOMETRY.to_json(),
+        },
+    }
+    rec = tune_record.new_record(entries, pinned=False)
+    assert install_record(rec) == 2
+    assert pk.geometry().flat_block_rows == 512  # single pallas consensus
+    pk._reset_for_tests()
+
+    split = dict(entries)
+    split[(128, 512, 2, "fused")] = {
+        "kernel": "pallas", "source": "measured",
+        "geometry": pk.KernelGeometry(flat_block_rows=128).to_json(),
+    }
+    install_record(tune_record.new_record(split, pinned=False))
+    assert pk.geometry() == pk.DEFAULT_GEOMETRY  # split verdict: default
+
+
+# ----------------------------------------------------------------------
+# Satellite 3: selector precedence with a TuningRecord installed
+# ----------------------------------------------------------------------
+BUCKET = (64, 256, 2, "fused")
+
+
+def _install(winner="xla"):
+    pk.set_tuned_kernels({BUCKET: winner}, source={"test": True})
+
+
+def test_measured_tier_needs_bucket_and_record(bus):
+    _install("xla")
+    assert pk.kernel_choice(None) == pk.kernel_choice()  # no bucket: probe
+    assert pk.kernel_choice(None, bucket=BUCKET) == "xla"
+    assert bus.counters().get("kernel.selected.measured", 0) == 1
+    assert pk.kernel_choice(None, bucket=(1, 2, 3, "fused")) == \
+        pk.kernel_choice()  # unknown bucket: probe heuristic
+
+
+def test_per_solve_override_beats_measured():
+    _install("xla")
+    assert pk.kernel_choice("pallas", bucket=BUCKET) == "pallas"
+
+
+def test_set_default_kernel_beats_measured():
+    _install("xla")
+    pk.set_default_kernel("pallas")
+    assert pk.kernel_choice(None, bucket=BUCKET) == "pallas"
+
+
+def test_env_var_beats_measured(monkeypatch):
+    _install("pallas")
+    monkeypatch.setenv("GHS_KERNEL", "xla")
+    assert pk.kernel_choice(None, bucket=BUCKET) == "xla"
+
+
+def test_sticky_disable_pallas_overrides_measured_pallas_winner(bus):
+    _install("pallas")
+    assert pk.kernel_choice(None, bucket=BUCKET) == "pallas"
+    pk.disable_pallas("test: mosaic fault")
+    assert pk.kernel_choice(None, bucket=BUCKET) == "xla"
+    # Measurements steer; they never un-break a disabled process.
+    assert bus.counters().get("kernel.selected.measured", 0) == 1
+
+
+def test_measured_tier_is_load_bearing_through_solve_lanes(tmp_path, bus):
+    rec = search(BUCKETS, dry=True)
+    path = str(tmp_path / "t.json")
+    save_record(rec, path)
+    assert load_and_install(path) == len(BUCKETS)
+    g = gnm_random_graph(60, 200, seed=3)
+    before = bus.counters().get("kernel.selected.measured", 0)
+    ids_tuned = [r[0] for r in lanes_mod.solve_lanes([g, g], lanes=2)]
+    assert bus.counters().get("kernel.selected.measured", 0) > before
+    ids_xla = [r[0] for r in lanes_mod.solve_lanes([g, g], lanes=2,
+                                                   kernel="xla")]
+    for a, b in zip(ids_tuned, ids_xla):
+        assert (a == b).all()
+
+
+def test_kernel_report_carries_tuned_and_geometry_stanzas():
+    _install("xla")
+    rep = pk.kernel_report()
+    assert rep["tuned"]["entries"] == 1
+    assert rep["geometry"] == pk.DEFAULT_GEOMETRY.to_json()

@@ -111,6 +111,85 @@ def test_load_bucket_record_rejects_bad_schema(tmp_path):
         load_bucket_record(str(path))
 
 
+@pytest.mark.parametrize("entry,why", [
+    ([64, 256, 4], "arity"),                 # 3-wide, not 4
+    ([64, "lots", 4, "fused"], "int"),       # non-int edge count
+    ([64.5, 256, 4, "fused"], "int"),        # float nodes
+    ([True, 256, 4, "fused"], "int"),        # bool is not an int here
+    ([-64, 256, 4, "fused"], "positive"),    # negative size
+    ([64, 0, 4, "fused"], "positive"),       # zero size
+    ([64, 256, -1, "fused"], "lanes"),       # negative lanes
+    ([64, 256, 4, "warp"], "mode"),          # unknown mode string
+], ids=["arity", "str-edges", "float-nodes", "bool-nodes", "neg-nodes",
+        "zero-edges", "neg-lanes", "bad-mode"])
+def test_load_bucket_record_names_the_malformed_entry(tmp_path, entry, why):
+    """Satellite (round 23): a hand-edited record with ONE bad entry
+    raises a typed WarmupRecordError naming that entry — never a bare
+    unpacking/astype traceback mid-boot."""
+    import json as _json
+
+    from distributed_ghs_implementation_tpu.batch.warmup import (
+        RECORD_SCHEMA,
+        WarmupRecordError,
+    )
+
+    path = tmp_path / "record.json"
+    path.write_text(_json.dumps({
+        "schema": RECORD_SCHEMA,
+        "buckets": [[64, 256, 4, "fused"], entry],
+    }))
+    with pytest.raises(WarmupRecordError) as exc:
+        load_bucket_record(str(path))
+    msg = str(exc.value)
+    assert "#1" in msg            # names WHICH entry
+    assert repr(entry) in msg     # and shows it verbatim
+    assert isinstance(exc.value, ValueError)  # old handlers keep working
+
+
+def test_load_bucket_record_rejects_non_list_buckets(tmp_path):
+    from distributed_ghs_implementation_tpu.batch.warmup import (
+        RECORD_SCHEMA,
+        WarmupRecordError,
+    )
+
+    path = tmp_path / "record.json"
+    path.write_text('{"schema": "%s", "buckets": {"a": 1}}' % RECORD_SCHEMA)
+    with pytest.raises(WarmupRecordError, match="list"):
+        load_bucket_record(str(path))
+
+
+def test_plan_from_flags_threads_tuning_and_merge_carries_it(tmp_path):
+    from distributed_ghs_implementation_tpu.batch.warmup import plan_from_flags
+
+    plan = plan_from_flags(buckets="64x256", lanes=2, tuning="/tmp/t.json")
+    assert plan.tuning == "/tmp/t.json"
+    merged = merge_plans(WarmupPlan(buckets=((64, 256),)), plan)
+    assert merged.tuning == "/tmp/t.json"
+
+
+def test_run_warmup_installs_plan_tuning_record(tmp_path):
+    """WarmupPlan.tuning is installed BEFORE any precompile, so warmed
+    buckets compile the measured variant (round 23)."""
+    from distributed_ghs_implementation_tpu.ops import pallas_kernels as pk
+    from distributed_ghs_implementation_tpu.tune.measure import search
+    from distributed_ghs_implementation_tpu.tune.record import save_record
+
+    pk._reset_for_tests()
+    try:
+        rec = search([(64, 256, 2, "fused")], dry=True)
+        path = str(tmp_path / "tuning.json")
+        save_record(rec, path)
+        clear_solver_cache()
+        report = run_warmup(
+            WarmupPlan(buckets=((64, 256),), lanes=2, tuning=path)
+        )
+        assert report["tuned_entries"] == 1
+        summary = pk.tuned_summary()
+        assert summary and summary["entries"] == 1
+    finally:
+        pk._reset_for_tests()
+
+
 # ----------------------------------------------------------------------
 # AOT precompilation: zero request-time compiles
 # ----------------------------------------------------------------------
@@ -150,7 +229,7 @@ def test_run_warmup_reports_compiled_vs_cached(monkeypatch):
         "buckets": 0, "compiled": 0, "cached": 0, "skipped": 0,
         "single_warmed": 0, "mesh_warmed": 0, "mesh_skipped": 0,
         "stream_warmed": 0, "stream_sharded_warmed": 0,
-        "kernel": "xla", "wall_s": 0.0,
+        "kernel": "xla", "tuned_entries": 0, "wall_s": 0.0,
     }
 
 

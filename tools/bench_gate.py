@@ -103,6 +103,10 @@ KINDS = {
     # subscription contract is exact — a notification gap or duplicate, a
     # stream forced to re-sync, or ANY fresh solve while streams are live
     # is a correctness failure, never a tolerance question.
+    # gate-tune-v1 (bench.py --tuned): how many buckets the installed
+    # TuningRecord resolved is deterministic — a drop means the record
+    # went stale or the measured tier stopped being consulted.
+    "tune_record_hits": "exact",
     "notify_gaps": "exact",
     "notify_dups": "exact",
     "drain_errors": "exact",

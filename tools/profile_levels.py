@@ -270,12 +270,26 @@ def main():
     p.add_argument("--json", help="write the ghs-level-profile-v1 report here")
     p.add_argument("--trace-dir", default=None,
                    help="write a jax profiler trace here (rmat workload)")
+    p.add_argument(
+        "--tune-record", default=None, metavar="PATH",
+        help="install this ghs-tuning-v1 record (written by `ghs tune`) "
+        "before kernel resolution, so the profiled variant is the "
+        "measured per-bucket winner; the receipt embeds the tuning "
+        "summary",
+    )
     args = p.parse_args()
 
     from distributed_ghs_implementation_tpu.ops.pallas_kernels import (
         kernel_choice,
         kernel_report,
+        tuned_summary,
     )
+
+    if args.tune_record:
+        from distributed_ghs_implementation_tpu.tune import load_and_install
+
+        installed = load_and_install(args.tune_record)
+        print(f"tune record: {installed} bucket(s) installed")
 
     resolved = kernel_choice(args.kernel)
     profile = profile_rmat if args.workload == "rmat" else profile_batch
@@ -316,6 +330,7 @@ def main():
         "workload": args.workload,
         "kernel": {"requested": args.kernel or "auto", "resolved": resolved,
                    "report": kernel_report()},
+        "tuning": tuned_summary(),
         "config": {"workload": report["workload"]},
         "levels": report["levels"],
         "stepped_s": report["stepped_s"],

@@ -1,0 +1,138 @@
+#!/usr/bin/env python
+"""Tune drill: prove a TuningRecord actually steers serving, correctly.
+
+The CI gate for the autotuner's end-to-end contract (``gate-tune-v1``).
+Given a record written by ``ghs tune`` (``--record``), the drill asserts:
+
+1. **Integrity** — the record's sha256 sidecar verifies
+   (``utils/integrity.check_file`` == ``"ok"``).
+2. **CPU pin** — on a non-TPU host every winner is exactly ``xla``
+   (interpret-mode Pallas is a parity tool, never a measured winner).
+3. **Load-bearing** — after ``install_record``, a seeded ``solve_lanes``
+   with ``kernel=None`` resolves through the measured tier:
+   ``kernel.selected.measured`` must COUNT (the record is consulted, not
+   merely parsed).
+4. **Parity** — the tuned selection, the explicit XLA path, and the
+   interpret-mode Pallas path produce edge-for-edge identical MSFs on
+   the same seeded graphs (the fallback contract, exercised end to end).
+
+Exit 0 iff every check passed; ``--output`` writes the JSON report.
+"""
+
+from __future__ import annotations
+
+import _bootstrap  # noqa: F401 — repo-root sys.path setup
+
+import argparse
+import json
+import sys
+
+import numpy as np
+
+
+def _fail(report: dict, why: str) -> int:
+    report["failed"] = why
+    print(json.dumps(report, indent=2, sort_keys=True))
+    print(f"TUNE DRILL FAILED: {why}", file=sys.stderr)
+    return 1
+
+
+def main() -> int:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--record", required=True,
+                   help="ghs-tuning-v1 record path (from `ghs tune`)")
+    p.add_argument("--lanes", type=int, default=4,
+                   help="lane count for the load-bearing solve")
+    p.add_argument("--output", help="write the JSON report here too")
+    args = p.parse_args()
+
+    import jax
+
+    from distributed_ghs_implementation_tpu.batch import lanes as lanes_mod
+    from distributed_ghs_implementation_tpu.obs.events import BUS
+    from distributed_ghs_implementation_tpu.ops import pallas_kernels as pk
+    from distributed_ghs_implementation_tpu.tune import load_record
+    from distributed_ghs_implementation_tpu.tune import record as record_mod
+    from distributed_ghs_implementation_tpu.tune.measure import _bucket_graph
+    from distributed_ghs_implementation_tpu.utils import integrity
+
+    report: dict = {"schema": "ghs-tune-drill-v1", "record": args.record,
+                    "checks": {}}
+
+    # 1. Integrity: the sidecar must verify, not merely exist.
+    state = integrity.check_file(args.record)
+    report["checks"]["integrity"] = state
+    if state != "ok":
+        return _fail(report, f"record integrity is {state!r}, wanted 'ok'")
+
+    record = load_record(args.record)
+    if record is None:
+        return _fail(report, "record failed to load (missing or stale)")
+
+    # 2. CPU pin: off TPU, every winner must be exactly xla.
+    winners = record_mod.winners(record)
+    report["checks"]["buckets"] = len(winners)
+    if jax.default_backend() != "tpu":
+        bad = {record_mod.bucket_key_str(b): k
+               for b, k in winners.items() if k != "xla"}
+        report["checks"]["cpu_pin"] = "ok" if not bad else bad
+        if bad:
+            return _fail(report, f"non-xla winners on a CPU host: {bad}")
+
+    # 3. Load-bearing: install, solve with kernel=None, demand the
+    # measured tier counted. The graphs are seeded into a lane bucket the
+    # record actually tuned (lanes-matching entry, else any lane entry).
+    installed = record_mod.install_record(record, path=args.record)
+    report["checks"]["installed"] = installed
+    if installed < 1:
+        return _fail(report, "install_record installed 0 buckets")
+
+    lane_buckets = sorted(
+        b for b in winners
+        if b[2] >= 1 and b[3] in ("fused", "vmap")
+    )
+    if not lane_buckets:
+        return _fail(report, "record has no lane-mode buckets to drill")
+    bucket = next((b for b in lane_buckets if b[2] == args.lanes),
+                  lane_buckets[0])
+    n_pad, m_pad, lanes, mode = bucket
+    graph = _bucket_graph(n_pad, m_pad, seed=7)
+    if graph is None:
+        return _fail(report, f"no seeded graph pads into bucket {bucket}")
+    graphs = [graph] * max(2, min(lanes, 4))
+
+    before = BUS.counters().get("kernel.selected.measured", 0)
+    tuned = lanes_mod.solve_lanes(graphs, lanes=lanes, mode=mode, kernel=None)
+    measured = BUS.counters().get("kernel.selected.measured", 0) - before
+    report["checks"]["measured_selections"] = measured
+    if measured < 1:
+        return _fail(report, "kernel.selected.measured did not count — "
+                             "the installed record was never consulted")
+
+    # 4. Parity: tuned vs explicit xla vs interpret-mode pallas.
+    xla = lanes_mod.solve_lanes(graphs, lanes=lanes, mode=mode, kernel="xla")
+    pal = lanes_mod.solve_lanes(graphs, lanes=lanes, mode=mode,
+                                kernel="pallas")
+    resolved_pallas = pk.kernel_choice("pallas")
+    report["checks"]["pallas_resolved"] = resolved_pallas
+    for name, other in (("tuned_vs_xla", tuned), ("pallas_vs_xla", pal)):
+        ok = all(
+            np.array_equal(a[0], b[0]) for a, b in zip(other, xla)
+        )
+        report["checks"][name] = "ok" if ok else "MISMATCH"
+        if not ok:
+            return _fail(report, f"edge parity failed: {name}")
+
+    report["tuning"] = pk.tuned_summary()
+    report["failed"] = None
+    out = json.dumps(report, indent=2, sort_keys=True)
+    print(out)
+    if args.output:
+        with open(args.output, "w") as f:
+            f.write(out + "\n")
+    print("TUNE DRILL PASSED", file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
