@@ -630,6 +630,219 @@ def run_fleet_tcp_bench(args) -> int:
     return 0
 
 
+def run_wire_bench(args) -> int:
+    """Binary wire-plane metrics (``gate-wire-v1``): what the B-frame
+    carrier (``fleet/framing.py``) buys at the front door, on the oversize
+    deck the stream seeds use (70000x3000 by default).
+
+    * **wire_binary_ingest_per_sec / wire_json_ingest_per_sec** — graphs
+      per second through the full ingest path each carrier pays per
+      request: ``read_frame`` off the wire bytes, :class:`Graph`
+      reconstruction, content digest. JSON pays ``json.loads`` over a
+      ``[[u,v,w],...]`` text list plus per-edge Python-object churn; the
+      B-frame pays a crc32, a ~200-byte header parse, and three
+      ``np.frombuffer`` views. The bench FAILS below **5x** — the ratio is
+      the round's acceptance criterion, not a tolerance question. Parity
+      is checked before anything is timed: both carriers must yield
+      byte-identical digests and edge-exact arrays vs the source graph.
+    * **wire_passthrough** — EXACT: every solve B-frame dispatched through
+      a 3-worker all-binary TCP echo fleet must take the opaque
+      passthrough path (``fleet.wire.passthrough == solve frames sent``,
+      ``fleet.wire.fallback_json == 0``): the router read the header,
+      never the edge sections.
+    * **wire_mixed_passthrough / wire_mixed_fallback_json** — EXACT: the
+      same deck through a mixed-build fleet (worker 0 spawned with
+      ``GHS_FLEET_WIRE=0``, so its hello carries no binary capability)
+      must split deterministically by ring owner — legacy-owned digests
+      degrade to folded JSON per connection, everything else stays
+      binary, and every response is still ``ok``.
+
+    Echo workers keep this jax-free and CI-cheap while exercising the
+    real framing, real sockets, and the real per-connection negotiation.
+    """
+    import io
+
+    import numpy as np
+
+    from distributed_ghs_implementation_tpu.fleet.framing import (
+        encode_bframe,
+        encode_frame,
+        read_frame,
+    )
+    from distributed_ghs_implementation_tpu.fleet.hashing import HashRing
+    from distributed_ghs_implementation_tpu.fleet.router import (
+        FleetConfig,
+        FleetRouter,
+    )
+    from distributed_ghs_implementation_tpu.graphs.edgelist import Graph
+    from distributed_ghs_implementation_tpu.graphs.generators import (
+        gnm_random_graph,
+    )
+    from distributed_ghs_implementation_tpu.obs.events import BUS
+
+    n, m = args.wire_nodes, args.wire_edges
+    deck = [
+        gnm_random_graph(n, m, seed=SEED + i)
+        for i in range(args.wire_graphs)
+    ]
+
+    # Pre-encode both carriers once: the clocks time INGEST only —
+    # read_frame + Graph reconstruction + digest — the work the front
+    # door repeats per request.
+    json_frames = [
+        encode_frame(
+            {"op": "solve", "num_nodes": g.num_nodes,
+             "edges": np.stack([g.u, g.v, g.w], axis=1).tolist()},
+            crc=True,
+        )
+        for g in deck
+    ]
+    bin_frames = [
+        encode_bframe({"op": "solve", **g.to_wire()}) for g in deck
+    ]
+
+    def _ingest_json(payload: bytes) -> Graph:
+        req = read_frame(io.BytesIO(payload))
+        return Graph.from_edges(req["num_nodes"], req["edges"])
+
+    def _ingest_bin(payload: bytes) -> Graph:
+        return Graph.from_wire(read_frame(io.BytesIO(payload)))
+
+    # Parity before anything is timed: same digest (bit-identical — the
+    # cache/store/stream identity), same edges, from either carrier.
+    for g, jf, bf in zip(deck, json_frames, bin_frames):
+        gj, gb = _ingest_json(jf), _ingest_bin(bf)
+        if not (gj.digest() == gb.digest() == g.digest()):
+            print("WIRE PARITY FAILED: digest mismatch", file=sys.stderr)
+            return 1
+        if not (np.array_equal(gb.u, g.u) and np.array_equal(gb.v, g.v)
+                and np.array_equal(gb.w, g.w)
+                and np.array_equal(gj.u, g.u)
+                and np.array_equal(gj.w, g.w)):
+            print("WIRE PARITY FAILED: edge arrays differ", file=sys.stderr)
+            return 1
+
+    def _ingest_clock(fn, frames) -> float:
+        best = float("inf")
+        for _ in range(max(1, args.repeats)):
+            t0 = time.perf_counter()
+            for payload in frames:
+                fn(payload).digest()
+            best = min(best, time.perf_counter() - t0)
+        return len(frames) / best
+
+    json_gps = _ingest_clock(_ingest_json, json_frames)
+    bin_gps = _ingest_clock(_ingest_bin, bin_frames)
+    speedup = bin_gps / json_gps
+    if speedup < 5.0:
+        print(
+            f"WIRE BENCH FAILED: binary ingest {speedup:.1f}x JSON "
+            f"(acceptance floor 5x)",
+            file=sys.stderr,
+        )
+        return 1
+
+    # All-binary fleet: every solve B-frame must dispatch opaquely.
+    BUS.enable()
+    BUS.clear()
+    requests = [{"op": "solve", **g.to_wire()} for g in deck]
+    cfg = FleetConfig(
+        workers=3, test_echo=True, transport="tcp",
+        heartbeat_interval_s=0.25, ready_timeout_s=120.0,
+        request_timeout_s=60.0,
+    )
+    with FleetRouter(cfg) as router:
+        for g, req in zip(deck, requests):
+            resp = router.handle(dict(req))
+            if not (resp.get("ok") and resp.get("digest") == g.digest()):
+                print(f"WIRE FLEET FAILED: {resp}", file=sys.stderr)
+                return 1
+    counters = BUS.counters()
+    passthrough = int(counters.get("fleet.wire.passthrough", 0))
+    fallback = int(counters.get("fleet.wire.fallback_json", 0))
+    if passthrough != len(deck) or fallback != 0:
+        print(
+            f"WIRE COUNTERS WRONG: passthrough {passthrough} fallback "
+            f"{fallback} (expected {len(deck)}/0)",
+            file=sys.stderr,
+        )
+        return 1
+
+    # Mixed-build fleet: worker 0 is a legacy build (hello without the
+    # binary capability), so exactly the ring share it owns degrades to
+    # folded JSON — per connection, never an error. The split is
+    # deterministic: seeded digests, deterministic ring.
+    BUS.clear()
+    ring = HashRing(range(3), replicas=cfg.ring_replicas)
+    expect_fallback = sum(
+        1 for g in deck if ring.assign(g.digest()) == 0
+    )
+    cfg_mixed = FleetConfig(
+        workers=3, test_echo=True, transport="tcp",
+        heartbeat_interval_s=0.25, ready_timeout_s=120.0,
+        request_timeout_s=60.0,
+        worker_env={0: {"GHS_FLEET_WIRE": "0"}},
+    )
+    with FleetRouter(cfg_mixed) as router:
+        for g, req in zip(deck, requests):
+            resp = router.handle(dict(req))
+            if not (resp.get("ok") and resp.get("digest") == g.digest()):
+                print(f"WIRE MIXED FLEET FAILED: {resp}", file=sys.stderr)
+                return 1
+    counters = BUS.counters()
+    mixed_pass = int(counters.get("fleet.wire.passthrough", 0))
+    mixed_fallback = int(counters.get("fleet.wire.fallback_json", 0))
+    if (mixed_fallback != expect_fallback
+            or mixed_pass != len(deck) - expect_fallback):
+        print(
+            f"WIRE MIXED COUNTERS WRONG: passthrough {mixed_pass} "
+            f"fallback {mixed_fallback} (expected "
+            f"{len(deck) - expect_fallback}/{expect_fallback})",
+            file=sys.stderr,
+        )
+        return 1
+
+    out = {
+        "metric": f"binary wire ingest, gnm({n},{m}) x {len(deck)}",
+        "value": round(speedup, 2),
+        "unit": "x vs JSON ingest (graphs/sec)",
+        "wire_binary_ingest_per_sec": round(bin_gps, 2),
+        "wire_json_ingest_per_sec": round(json_gps, 2),
+        "wire_passthrough": passthrough,
+        "wire_fallback_json": fallback,
+        "wire_mixed_passthrough": mixed_pass,
+        "wire_mixed_fallback_json": mixed_fallback,
+    }
+    print(json.dumps(out))
+    if args.metrics_out:
+        metrics = {
+            "wire_binary_ingest_per_sec": bin_gps,
+            "wire_json_ingest_per_sec": json_gps,
+            "wire_speedup": speedup,
+            "wire_passthrough": passthrough,
+            "wire_fallback_json": fallback,
+            "wire_mixed_passthrough": mixed_pass,
+            "wire_mixed_fallback_json": mixed_fallback,
+            "wire_graphs": len(deck),
+        }
+        with open(args.metrics_out, "w") as f:
+            json.dump(
+                {
+                    "schema": "ghs-bench-metrics-v1",
+                    "config": {
+                        "workload": "gate-wire-v1",
+                        "deck": f"gnm({n},{m},seeds {SEED}..)"
+                        f"x{len(deck)}",
+                    },
+                    "metrics": metrics,
+                },
+                f,
+                indent=2,
+            )
+            f.write("\n")
+    return 0
+
+
 def run_verify_bench(args) -> int:
     """Certificate-checker overhead metrics (``gate-verify-bench-v1``):
     what one MST certificate costs, per engine, at interactive and bulk
@@ -1435,6 +1648,19 @@ def main(argv=None) -> int:
                    help="forwarding hits AND misses driven in --fleet-tcp "
                    "(fleet.forward.hit/miss then gate exactly)")
     p.add_argument(
+        "--wire", action="store_true",
+        help="measure the binary wire plane instead of the RMAT bench: "
+        "B-frame vs JSON ingest throughput (graphs/sec, FAILS below 5x), "
+        "digest/edge parity, and EXACT opaque-passthrough counters "
+        "through all-binary and mixed-build TCP echo fleets "
+        "(gate-wire-v1, docs/FLEET.md \"Binary wire\"); jax-free",
+    )
+    p.add_argument("--wire-nodes", type=int, default=70_000,
+                   help="deck graph nodes for --wire (the oversize bucket)")
+    p.add_argument("--wire-edges", type=int, default=3_000)
+    p.add_argument("--wire-graphs", type=int, default=16,
+                   help="graphs in the --wire ingest/fleet deck")
+    p.add_argument(
         "--update-stream", action="store_true",
         help="measure streaming MSF maintenance: windowed batched apply "
         "(stream/window.py) vs the sequential per-update path, edge-exact "
@@ -1499,6 +1725,8 @@ def main(argv=None) -> int:
         return run_kinds_bench(args)
     if args.fleet_tcp:
         return run_fleet_tcp_bench(args)
+    if args.wire:
+        return run_wire_bench(args)
     if args.update_stream:
         return run_update_stream_bench(args)
     if args.stream_sharded:

@@ -382,8 +382,23 @@ def _cmd_serve(args) -> int:
     health-checked failover (fleet/router.py, docs/FLEET.md)."""
     from distributed_ghs_implementation_tpu.serve.service import (
         MSTService,
+        serve_frames,
         serve_loop,
     )
+
+    def _serve_stdio(handler) -> int:
+        # One switch for both fleet and single-process serving: the binary
+        # wire swaps the carrier (framed binary stdio, B-frame ingest/
+        # egress), never the handler.
+        if args.wire == "binary":
+            if args.input:
+                with open(args.input, "rb") as f:
+                    return serve_frames(f, sys.stdout.buffer, handler)
+            return serve_frames(sys.stdin.buffer, sys.stdout.buffer, handler)
+        if args.input:
+            with open(args.input) as f:
+                return serve_loop(f, sys.stdout, handler)
+        return serve_loop(sys.stdin, sys.stdout, handler)
 
     if args.kernel:
         # Process default for every solve layer (kernel_choice), exported
@@ -499,10 +514,7 @@ def _cmd_serve(args) -> int:
             if args.fleet_elastic:
                 autoscaler = Autoscaler(router, policy).start()
             try:
-                if args.input:
-                    with open(args.input) as f:
-                        return serve_loop(f, sys.stdout, router)
-                return serve_loop(sys.stdin, sys.stdout, router)
+                return _serve_stdio(router)
             finally:
                 if autoscaler is not None:
                     autoscaler.close()
@@ -560,10 +572,7 @@ def _cmd_serve(args) -> int:
     if service.warmup_report is not None:
         print(f"warmup: {json.dumps(service.warmup_report)}", file=sys.stderr)
     try:
-        if args.input:
-            with open(args.input) as f:
-                return serve_loop(f, sys.stdout, service)
-        return serve_loop(sys.stdin, sys.stdout, service)
+        return _serve_stdio(service)
     finally:
         if args.warmup_record:
             from distributed_ghs_implementation_tpu.batch import warmup as warmup_mod
@@ -906,6 +915,14 @@ def build_parser() -> argparse.ArgumentParser:
     )
     srv.add_argument("--input",
                      help="read JSONL requests from this file instead of stdin")
+    srv.add_argument(
+        "--wire", choices=("json", "binary"), default="json",
+        help="front-door carrier: 'json' = text JSONL (default); 'binary' "
+        "= length-prefixed frames over binary stdio, accepting B-frames "
+        "(raw little-endian edge-array sections behind a compact header, "
+        "zero-copy ingest) and answering in kind per connection "
+        "(docs/SERVING.md \"Binary wire\")",
+    )
     srv.add_argument(
         "--fleet", type=int, default=0, metavar="N",
         help="serve through N digest-routed worker processes with "

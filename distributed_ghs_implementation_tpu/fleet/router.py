@@ -74,6 +74,10 @@ import threading
 import time
 from typing import Dict, List, Optional, Tuple
 
+from distributed_ghs_implementation_tpu.fleet.framing import (
+    SECTIONS_KEY,
+    fold_sections,
+)
 from distributed_ghs_implementation_tpu.fleet.hashing import HashRing
 from distributed_ghs_implementation_tpu.fleet.transport import (
     ChaosState,
@@ -235,12 +239,21 @@ def _next_pow2(x: int) -> int:
 def _request_oversize(request: dict) -> bool:
     """Would this solve bypass the lane engine (oversize)? Judged from the
     raw request so the router can steer it at a mesh-owning worker without
-    building a Graph twice. ``graph_path`` solves (size unknown without
-    I/O) and updates (session-pinned anyway) route normally."""
-    if request.get("op") != "solve" or "edges" not in request:
+    building a Graph twice. Binary requests declare ``num_edges`` in the
+    B-frame header, so the judgment never touches the edge sections —
+    part of the O(header) passthrough contract. ``graph_path`` solves
+    (size unknown without I/O) and updates (session-pinned anyway) route
+    normally."""
+    if request.get("op") != "solve":
+        return False
+    if "edges" in request:
+        m_raw = len(request["edges"])
+    elif SECTIONS_KEY in request and "num_edges" in request:
+        m_raw = int(request["num_edges"])
+    else:
         return False
     n = _next_pow2(max(1, int(request.get("num_nodes", 0))))
-    m = _next_pow2(max(1, len(request["edges"])))
+    m = _next_pow2(max(1, m_raw))
     return n > _OVERSIZE_NODE_BUCKET or m > _OVERSIZE_EDGE_BUCKET
 
 
@@ -873,6 +886,10 @@ class FleetRouter:
             # side flips on by echo — its first checksummed inbound frame
             # (fleet/transport.py, "CRC negotiation").
             w.transport.enable_crc()
+        if w.caps.get("wire") and w.transport is not None:
+            # The worker parses B-frames: section-bearing payloads pass
+            # through binary. Workers flip on by the same echo rule.
+            w.transport.enable_wire()
         w.last_pong = time.monotonic()
         w.ready.set()
 
@@ -1478,6 +1495,16 @@ class FleetRouter:
                 return Graph.from_edges(
                     int(request["num_nodes"]), request["edges"]
                 ).digest()
+            if SECTIONS_KEY in request and "num_nodes" in request:
+                # Binary solve without a digest hint: the one routing
+                # case that must decode sections. ``to_wire()`` always
+                # stamps the digest into the header, so a well-formed
+                # binary client never lands here.
+                from distributed_ghs_implementation_tpu.graphs.edgelist import (
+                    Graph,
+                )
+
+                return Graph.from_wire(request).digest()
         return None
 
     def _route(
@@ -1586,6 +1613,14 @@ class FleetRouter:
                 self._on_death(w, incarnation)
                 continue
             BUS.count("fleet.dispatch")
+            if SECTIONS_KEY in p.request:
+                # Binary payload: the transport passed the sections
+                # through opaquely (caps.wire peers — the O(header) hop)
+                # or folded them to classic JSON for a legacy worker.
+                BUS.count(
+                    "fleet.wire.passthrough" if w.caps.get("wire")
+                    else "fleet.wire.fallback_json"
+                )
             BUS.sample(f"fleet.queue.depth.{w.id}", len(w.pending))
             return None
 
@@ -1596,10 +1631,19 @@ class FleetRouter:
         ``None`` when the pair carries no verifiable claim (echo fleets,
         digest-only requests, responses without ``mst_edges``), else the
         :class:`verify.certify.Certificate`. NumPy engine: the router is
-        jax-free by design and the claim arrives as plain JSON anyway."""
-        if request.get("op") != "solve" or "edges" not in request \
-                or "num_nodes" not in request:
+        jax-free by design and the claim arrives as plain JSON anyway.
+        Binary requests certify too — folding the edge sections here is
+        deliberate: certification is the one router path that is ABOUT
+        the edges, so it pays to decode them (forwarded hits only,
+        never the passthrough dispatch)."""
+        if request.get("op") != "solve" or "num_nodes" not in request:
             return None
+        if "edges" not in request:
+            if SECTIONS_KEY not in request:
+                return None
+            request = fold_sections(request)
+            if "edges" not in request:
+                return None
         if not isinstance(response.get("mst_edges"), list):
             return None
         from distributed_ghs_implementation_tpu.verify.certify import (
@@ -1776,8 +1820,12 @@ class FleetRouter:
                 # journal that cannot append refuses the work — accepting
                 # without durability would be the round-12 router again.
                 try:
+                    # Binary payloads journal in their folded JSON form:
+                    # the journal is JSONL by schema, and a successor
+                    # router must be able to re-dispatch the replayed
+                    # request at ANY worker, caps.wire or not.
                     jid = self._journal.accept(
-                        request, key=key, cls=cls, lane=lane,
+                        fold_sections(request), key=key, cls=cls, lane=lane,
                         trace=tracing.wire_context(),
                     )
                 except (OSError, TimeoutError) as e:

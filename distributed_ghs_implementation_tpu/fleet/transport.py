@@ -56,7 +56,10 @@ from typing import IO, Callable, Optional, Tuple
 
 from distributed_ghs_implementation_tpu.fleet.framing import (
     FrameError,
+    encode_bframe,
     encode_frame,
+    fold_sections,
+    frame_sections,
     read_frame,
 )
 
@@ -114,6 +117,15 @@ def build_hello(
     # untraced frames (GHS_FLEET_TRACE=0 simulates one in drills).
     hello["caps"].setdefault(
         "trace", os.environ.get("GHS_FLEET_TRACE", "1") != "0"
+    )
+    # Binary-wire capability: this build parses B-frames (raw array
+    # sections behind a JSON header, ``fleet/framing.py``), so the router
+    # may pass section-bearing payloads through opaquely instead of
+    # folding them to JSON. Same opt-in shape as CRC — a legacy worker
+    # without the cap gets classic JSON frames, per connection
+    # (GHS_FLEET_WIRE=0 simulates one in the mixed-build drills).
+    hello["caps"].setdefault(
+        "wire", os.environ.get("GHS_FLEET_WIRE", "1") != "0"
     )
     if warmed is not None:
         hello["caps"]["warmed"] = bool(warmed)
@@ -179,17 +191,42 @@ class Transport:
     the first inbound frame carrying a checksum proves the peer both
     emits and (being the same build) parses the form. Either way, no
     checksummed frame is ever sent at a peer that might not parse it.
+
+    **Binary-wire negotiation** rides the identical machinery one rung
+    up: ``enable_wire()`` (router side, from hello ``caps.wire``) or the
+    first inbound B-frame (worker side, echo-on-receipt) switches
+    section-bearing payloads to the binary form. A payload that carries
+    a :class:`~..fleet.framing.WireSections` toward a peer WITHOUT the
+    capability is folded to classic JSON at the send boundary
+    (``fold_sections``) — per-connection degradation, never an error.
     """
 
     kind = "abstract"
     crc_out = False  # emit checksummed frames (set via enable_crc)
+    wire_out = False  # emit binary B-frames (set via enable_wire)
 
     def enable_crc(self) -> None:
         self.crc_out = True
 
+    def enable_wire(self) -> None:
+        self.wire_out = True
+
     def _note_recv_meta(self, meta: dict) -> None:
         if meta.get("crc") and not self.crc_out:
             self.crc_out = True  # peer speaks checksummed frames: echo it
+        if meta.get("wire") and not self.wire_out:
+            self.wire_out = True  # peer speaks B-frames: echo it
+
+    def encode_for_peer(self, obj: dict) -> bytes:
+        """``obj`` in the richest form this peer negotiated: B-frame for
+        section-bearing payloads toward ``caps.wire`` peers, folded JSON
+        toward legacy peers, plain (CRC'd where negotiated) JSON for
+        everything else."""
+        if frame_sections(obj) is not None:
+            if self.wire_out:
+                return encode_bframe(obj)
+            return encode_frame(fold_sections(obj), crc=self.crc_out)
+        return encode_frame(obj, crc=self.crc_out)
 
     def send(self, obj: dict) -> None:
         raise NotImplementedError
@@ -225,7 +262,7 @@ class PipeTransport(Transport):
         self.frames = 0
 
     def send(self, obj: dict) -> None:
-        self.send_bytes(encode_frame(obj, crc=self.crc_out))
+        self.send_bytes(self.encode_for_peer(obj))
 
     def send_bytes(self, data: bytes) -> None:
         with self._lock:
@@ -313,7 +350,7 @@ class SocketTransport(Transport):
 
     # -- writing -------------------------------------------------------
     def send(self, obj: dict) -> None:
-        self.send_bytes(encode_frame(obj, crc=self.crc_out))
+        self.send_bytes(self.encode_for_peer(obj))
 
     def send_bytes(self, data: bytes) -> None:
         if self._pipelined:
@@ -536,6 +573,13 @@ class ChaosTransport(Transport):
         self._inner.enable_crc()
 
     @property
+    def wire_out(self) -> bool:
+        return self._inner.wire_out
+
+    def enable_wire(self) -> None:
+        self._inner.enable_wire()
+
+    @property
     def writes(self) -> int:
         return self._inner.writes
 
@@ -550,7 +594,7 @@ class ChaosTransport(Transport):
     def send(self, obj: dict) -> None:
         from distributed_ghs_implementation_tpu.utils.resilience import FAULTS
 
-        data = encode_frame(obj, crc=self._inner.crc_out)
+        data = self._inner.encode_for_peer(obj)
         state = self.state
         armed_delay = FAULTS.pop(CHAOS_DELAY_SITE)
         delay = state.delay() + (

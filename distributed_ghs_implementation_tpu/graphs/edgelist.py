@@ -69,8 +69,35 @@ class Graph:
         ``dedup`` (an MST never uses the heavier duplicate). Mirrors the edge
         list accepted by the reference driver
         (``ghs_implementation.py:416-429``).
+
+        Generator input streams through bounded chunks instead of one
+        ``list(edges)`` materialization — peak host memory is one chunk of
+        Python triples plus the arrays, not the whole deck twice. Chunked
+        conversion keeps the single-pass dtype semantics (any float triple
+        upcasts the whole array, exactly as one ``np.asarray`` would), so
+        digests are unchanged vs the materializing path (tested).
         """
-        arr = np.asarray(list(edges) if not isinstance(edges, np.ndarray) else edges)
+        if isinstance(edges, np.ndarray):
+            arr = edges
+        elif isinstance(edges, (list, tuple)):
+            arr = np.asarray(edges)
+        else:
+            import itertools
+
+            it = iter(edges)
+            blocks = []
+            while True:
+                block = list(itertools.islice(it, 65536))
+                if not block:
+                    break
+                blocks.append(np.asarray(block))
+            arr = (
+                blocks[0]
+                if len(blocks) == 1
+                else np.concatenate(blocks)
+                if blocks
+                else np.empty((0, 3))
+            )
         if arr.size == 0:
             e = np.zeros(0, dtype=np.int64)
             return Graph(int(num_nodes), e, e.copy(), np.zeros(0, dtype=np.int64))
@@ -158,6 +185,85 @@ class Graph:
         """:meth:`digest` as four int64 words — the array form checkpoint
         fingerprints and disk-cache entries embed (one decode, one place)."""
         return np.frombuffer(bytes.fromhex(self._digest), dtype=np.int64).copy()
+
+    # ------------------------------------------------------------------
+    # Binary wire codec (fleet/framing.py B-frames, docs/FLEET.md)
+    # ------------------------------------------------------------------
+    def to_wire(self) -> dict:
+        """This graph as a binary request fragment: ``num_nodes`` /
+        ``num_edges`` / ``digest`` as plain JSON fields (everything a
+        router needs — routing key, oversize bucket — stays in the
+        B-frame *header*) plus ``u``/``v``/``w`` as raw little-endian
+        sections. The canonical arrays go onto the wire as-is, so the
+        receiver's :meth:`from_wire` digest is byte-identical to ours."""
+        from distributed_ghs_implementation_tpu.fleet.framing import (
+            SECTIONS_KEY,
+            WireSections,
+        )
+
+        return {
+            "num_nodes": self.num_nodes,
+            "num_edges": self.num_edges,
+            "digest": self.digest(),
+            SECTIONS_KEY: WireSections()
+            .add("u", self.u)
+            .add("v", self.v)
+            .add("w", self.w),
+        }
+
+    @staticmethod
+    def from_wire(payload: dict) -> "Graph":
+        """Rebuild from a binary request fragment — ``np.frombuffer``
+        views over the received frame buffer, zero copies, zero
+        per-edge Python objects.
+
+        The arrays are trusted to be canonical only after a vectorized
+        check (in-range, ``u < v``, strictly lexsorted — what
+        :meth:`from_arrays` would produce); a non-canonical sender falls
+        back through :meth:`from_arrays` so the digest always names the
+        canonical form, exactly as the JSON ``edges`` path does. The
+        fast-path arrays are read-only views; every consumer treats
+        ``Graph`` arrays as immutable already (staging copies to
+        device)."""
+        from distributed_ghs_implementation_tpu.fleet.framing import (
+            SECTIONS_KEY,
+        )
+
+        secs = payload.get(SECTIONS_KEY)
+        if secs is None or not all(n in secs for n in ("u", "v", "w")):
+            raise ValueError(
+                "binary graph payload needs u/v/w sections "
+                f"(got {getattr(secs, 'names', None)})"
+            )
+        num_nodes = int(payload["num_nodes"])
+        u, v, w = secs.array("u"), secs.array("v"), secs.array("w")
+        if u.dtype != np.int64 or v.dtype != np.int64:
+            raise ValueError(
+                f"endpoint sections must be int64, got {u.dtype}/{v.dtype}"
+            )
+        if w.dtype not in (np.dtype(np.int64), np.dtype(np.float64)):
+            raise ValueError(f"weight section must be i8/f8, got {w.dtype}")
+        if not (u.shape == v.shape == w.shape):
+            raise ValueError(
+                f"section lengths disagree: {u.size}/{v.size}/{w.size}"
+            )
+        m = u.size
+        canonical = m == 0 or (
+            int(u.min()) >= 0
+            and int(v.max()) < num_nodes
+            and bool(np.all(u < v))
+            and bool(
+                np.all(
+                    (u[1:] > u[:-1]) | ((u[1:] == u[:-1]) & (v[1:] > v[:-1]))
+                )
+            )
+        )
+        if canonical:
+            if m == 0:
+                e = np.zeros(0, dtype=np.int64)
+                return Graph(num_nodes, e, e.copy(), w.astype(w.dtype))
+            return Graph(num_nodes, u, v, w)
+        return Graph.from_arrays(num_nodes, u, v, w)
 
     # ------------------------------------------------------------------
     # Views

@@ -137,7 +137,7 @@ def write_events_jsonl(
         }
         if label:
             header["process"] = str(label)
-        f.write(json.dumps(header) + "\n")
+        f.write(json.dumps(header, separators=(",", ":")) + "\n")
         for ph, name, cat, ts_ns, dur_ns, tid, args in bus.events():
             rec = {
                 "ph": ph,
@@ -150,7 +150,7 @@ def write_events_jsonl(
                 rec["dur_us"] = dur_ns / 1000.0
             if args:
                 rec["args"] = _jsonable(args)
-            f.write(json.dumps(rec) + "\n")
+            f.write(json.dumps(rec, separators=(",", ":")) + "\n")
         f.write(
             json.dumps(
                 {
@@ -158,7 +158,8 @@ def write_events_jsonl(
                     "counters": _jsonable(bus.counters()),
                     "histograms": _jsonable(bus.histograms()),
                     "events_dropped": bus.dropped,
-                }
+                },
+                separators=(",", ":"),
             )
             + "\n"
         )

@@ -303,5 +303,5 @@ class FleetPulse:
                         cls, self.budgets.get("default")
                     ),
                     "spans": spans,
-                }) + "\n")
+                }, separators=(",", ":")) + "\n")
                 BUS.count("pulse.exemplars")

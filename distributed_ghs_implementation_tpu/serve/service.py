@@ -73,6 +73,16 @@ from distributed_ghs_implementation_tpu.batch.warmup import (
     bucket_of,
     warmable_single,
 )
+from distributed_ghs_implementation_tpu.fleet.framing import (
+    SECTIONS_KEY,
+    FrameError,
+    WireSections,
+    encode_bframe,
+    encode_frame,
+    fold_sections,
+    frame_sections,
+    read_frame,
+)
 from distributed_ghs_implementation_tpu.graphs.edgelist import Graph
 from distributed_ghs_implementation_tpu.obs import tracing
 from distributed_ghs_implementation_tpu.obs.events import BUS
@@ -485,9 +495,13 @@ class MSTService:
                     rederive=_rederive_components,
                 )
             if request.get("labels_out"):
-                extra["labels"] = asolvers.labels_for_forest(
-                    result
-                ).tolist()
+                labels = asolvers.labels_for_forest(result)
+                if SECTIONS_KEY in request:
+                    extra[SECTIONS_KEY] = WireSections().add(
+                        "labels", labels
+                    )
+                else:
+                    extra["labels"] = labels.tolist()
         elif kind == "k_msf":
             k = params["k"]
             result = self.store.get(kind_key, graph)
@@ -554,7 +568,7 @@ class MSTService:
         if verified is not None:
             out["verified"] = verified
         out.update(self._result_fields(result, request))
-        out.update(extra)
+        self._merge_fields(out, extra)
         return out
 
     def _handle_cached_probe(self, request: dict) -> dict:
@@ -665,7 +679,11 @@ class MSTService:
                 ),
             })
         elif kind == "components" and request.get("labels_out"):
-            extra["labels"] = asolvers.labels_for_forest(result).tolist()
+            labels = asolvers.labels_for_forest(result)
+            if SECTIONS_KEY in request:
+                extra[SECTIONS_KEY] = WireSections().add("labels", labels)
+            else:
+                extra["labels"] = labels.tolist()
         out = {
             "ok": True,
             "op": "solve",
@@ -675,7 +693,7 @@ class MSTService:
             "cached": True,
         }
         out.update(self._result_fields(result, request))
-        out.update(extra)
+        self._merge_fields(out, extra)
         return out
 
     def _handle_update(self, request: dict) -> dict:
@@ -893,6 +911,12 @@ class MSTService:
             return Graph.from_edges(
                 int(request["num_nodes"]), request["edges"]
             )
+        if SECTIONS_KEY in request:
+            # Binary ingest (docs/FLEET.md "Binary wire plane"): u/v/w
+            # arrive as raw little-endian sections; frombuffer views, no
+            # JSON list ever existed. Digest/cache keys are byte-identical
+            # to the edges path by the codec's canonical-form contract.
+            return Graph.from_wire(request)
         raise ValueError("solve needs either graph_path or num_nodes+edges")
 
     def _remember(self, digest: str, result: MSTResult, backend: str) -> None:
@@ -920,8 +944,36 @@ class MSTService:
         if result.incidents is not None and len(result.incidents):
             out["incident_summary"] = result.incidents.summary()
         if request.get("edges_out"):
-            out["mst_edges"] = [[int(a), int(b)] for a, b in result.edges]
+            # Vectorized either way: one fancy-index per endpoint column,
+            # never a per-edge Python loop. Binary clients (the request
+            # arrived with sections) get the answer back as sections.
+            import numpy as np
+
+            ids = np.asarray(result.edge_ids)
+            mst_u = result.graph.u[ids]
+            mst_v = result.graph.v[ids]
+            if SECTIONS_KEY in request:
+                out[SECTIONS_KEY] = (
+                    WireSections().add("mst_u", mst_u).add("mst_v", mst_v)
+                )
+            else:
+                out["mst_edges"] = np.stack(
+                    [mst_u, mst_v], axis=1
+                ).tolist()
         return out
+
+    @staticmethod
+    def _merge_fields(out: dict, extra: dict) -> None:
+        """``out.update(extra)`` that unions binary egress sections
+        instead of letting one response field family clobber the other
+        (``edges_out`` + ``labels_out`` on one binary request)."""
+        have = out.get(SECTIONS_KEY)
+        more = extra.get(SECTIONS_KEY)
+        if isinstance(have, WireSections) and isinstance(more, WireSections):
+            for name in more.names:
+                have.add(name, more.array(name))
+            extra = {k: v for k, v in extra.items() if k != SECTIONS_KEY}
+        out.update(extra)
 
 
 class _DrainSignal(Exception):
@@ -983,7 +1035,18 @@ def serve_loop(
                             response = {"ok": False, "error": f"bad JSON: {e}"}
                         else:
                             response = service.handle(request)
-                        out_stream.write(json.dumps(response) + "\n")
+                        # Compact separators, same as every framed payload
+                        # (fleet/framing.py): egress bytes are protocol,
+                        # not pretty-printing. Any binary egress sections
+                        # fold to their JSON forms — the text protocol
+                        # cannot carry raw buffers.
+                        out_stream.write(
+                            json.dumps(
+                                fold_sections(response),
+                                separators=(",", ":"),
+                            )
+                            + "\n"
+                        )
                         out_stream.flush()
                     else:
                         response = {}
@@ -998,3 +1061,59 @@ def serve_loop(
         for sig, handler in previous.items():
             signal.signal(sig, handler)
     return 0
+
+
+def serve_frames(
+    in_stream: IO[bytes], out_stream: IO[bytes], service=None
+) -> int:
+    """The binary front door (``ghs serve --wire binary``): length-prefixed
+    frames (``fleet/framing.py``) over binary stdio instead of text JSONL.
+
+    Same ops, same service — only the carrier changes. Requests arrive as
+    classic JSON frames or B-frames (raw ``u``/``v``/``w`` array sections
+    behind a compact header, crc32 over both); the first inbound B-frame
+    flips binary egress on, after which section-bearing responses
+    (``edges_out`` / ``labels_out``) go back as B-frames too — the same
+    echo-on-receipt negotiation the fleet transports use. JSON frames in,
+    JSON (checksummed) frames out: a legacy framed client never sees a
+    byte it cannot parse.
+
+    A garbled frame is terminal: past a :class:`FrameError` the stream is
+    no longer frame-aligned, so the loop reports it (one best-effort error
+    frame) and exits nonzero — the supervisor restarts the process, which
+    is the same contract the fleet's channel reader applies. Clean EOF or
+    an acknowledged ``shutdown`` exits zero.
+    """
+    service = service or MSTService()
+    wire_out = False
+    with BUS.span("serve.session", cat="serve"):
+        while True:
+            meta: dict = {}
+            try:
+                request = read_frame(in_stream, meta=meta)
+            except FrameError as e:
+                BUS.count("serve.errors")
+                try:
+                    out_stream.write(
+                        encode_frame(
+                            {"ok": False, "error": f"bad frame: {e}"},
+                            crc=True,
+                        )
+                    )
+                    out_stream.flush()
+                except OSError:
+                    pass
+                return 1
+            if request is None:
+                return 0
+            if meta.get("wire"):
+                wire_out = True
+            response = service.handle(request)
+            if wire_out and frame_sections(response) is not None:
+                data = encode_bframe(response)
+            else:
+                data = encode_frame(fold_sections(response), crc=True)
+            out_stream.write(data)
+            out_stream.flush()
+            if response.get("op") == "shutdown" and response.get("ok"):
+                return 0

@@ -1,0 +1,448 @@
+"""Binary wire plane (round 24, docs/FLEET.md "Binary wire"): B-frame
+encode/decode, the zero-copy graph codec, wire-format parity (the same
+graph through JSON frames, B-frames, and graph_path must produce one
+digest, one solve result, one store key), transport capability
+negotiation, the binary serve front door, and the malformed-frame fuzz
+contract (every garbled B-frame is a typed FrameError with bounded
+allocation — never a crash, never a silent mis-parse)."""
+
+import io
+import json
+import zlib
+
+import numpy as np
+import pytest
+
+from distributed_ghs_implementation_tpu.fleet.framing import (
+    SECTIONS_KEY,
+    FrameError,
+    WireSections,
+    encode_bframe,
+    encode_frame,
+    fold_sections,
+    frame_sections,
+    read_frame,
+)
+from distributed_ghs_implementation_tpu.fleet.transport import (
+    PipeTransport,
+    build_hello,
+)
+from distributed_ghs_implementation_tpu.graphs.edgelist import Graph
+from distributed_ghs_implementation_tpu.graphs.generators import (
+    gnm_random_graph,
+)
+from distributed_ghs_implementation_tpu.obs.events import BUS
+
+
+@pytest.fixture(autouse=True)
+def _clean_global_bus():
+    BUS.enable()
+    BUS.clear()
+    yield
+    BUS.enable()
+    BUS.clear()
+
+
+def _edges(g):
+    return [[int(a), int(b), int(c)] for a, b, c in zip(g.u, g.v, g.w)]
+
+
+def _read_bytes(data: bytes, **kw):
+    return read_frame(io.BytesIO(data), **kw)
+
+
+def _raw_bframe(header: bytes, sections: bytes) -> bytes:
+    """A wire-correct B-frame around arbitrary header/section bytes — the
+    crc is honest, so only the defect under test trips the reader."""
+    crc = zlib.crc32(sections, zlib.crc32(header))
+    return (
+        b"B%d %d %08x\n" % (len(header), len(sections), crc)
+        + header + sections + b"\n"
+    )
+
+
+# ----------------------------------------------------------------------
+# B-frame encode/decode round trips
+# ----------------------------------------------------------------------
+def test_bframe_roundtrip_top_level_sections():
+    g = gnm_random_graph(64, 160, seed=7)
+    obj = {"op": "solve", **g.to_wire()}
+    data = encode_bframe(obj)
+    meta: dict = {}
+    out = _read_bytes(data, meta=meta)
+    assert meta == {"crc": True, "wire": True}
+    assert out["op"] == "solve"
+    assert out["digest"] == g.digest()
+    secs = out[SECTIONS_KEY]
+    assert isinstance(secs, WireSections)
+    assert secs.names == ("u", "v", "w")
+    np.testing.assert_array_equal(secs.array("u"), g.u)
+    np.testing.assert_array_equal(secs.array("v"), g.v)
+    np.testing.assert_array_equal(secs.array("w"), g.w)
+
+
+def test_bframe_roundtrip_nested_envelope():
+    # The fleet wraps exactly one envelope around a request; the sections
+    # must survive one nesting level down.
+    g = gnm_random_graph(32, 80, seed=8)
+    obj = {"id": 7, "req": {"op": "solve", **g.to_wire()}}
+    out = _read_bytes(encode_bframe(obj))
+    assert out["id"] == 7
+    secs = out["req"][SECTIONS_KEY]
+    np.testing.assert_array_equal(secs.array("w"), g.w)
+
+
+def test_bframe_passthrough_reencode_is_byte_identical():
+    # The router's opaque-forwarding contract: a decoded B-frame re-encodes
+    # to the same bytes without the section elements ever being touched
+    # (decode-side chunks() is the received buffer itself).
+    g = gnm_random_graph(48, 120, seed=9)
+    data = encode_bframe({"op": "solve", **g.to_wire()})
+    decoded = _read_bytes(data)
+    assert encode_bframe(decoded) == data
+    secs = decoded[SECTIONS_KEY]
+    chunks = secs.chunks()
+    assert len(chunks) == 1  # ONE spliced buffer, not per-section copies
+
+
+def test_bframe_empty_sections_and_empty_graph():
+    g = Graph.from_edges(5, [])
+    out = _read_bytes(encode_bframe({"op": "solve", **g.to_wire()}))
+    rebuilt = Graph.from_wire(out)
+    assert rebuilt.num_edges == 0 and rebuilt.num_nodes == 5
+    assert rebuilt.digest() == g.digest()
+
+
+def test_plain_json_frames_still_read_with_wire_meta_false():
+    meta: dict = {}
+    out = _read_bytes(encode_frame({"op": "stats"}, crc=True), meta=meta)
+    assert out == {"op": "stats"}
+    assert meta == {"crc": True, "wire": False}
+
+
+# ----------------------------------------------------------------------
+# Zero-copy codec + fold parity
+# ----------------------------------------------------------------------
+def test_from_wire_digest_and_arrays_match_sender():
+    g = gnm_random_graph(200, 600, seed=11)
+    out = _read_bytes(encode_bframe({"op": "solve", **g.to_wire()}))
+    rebuilt = Graph.from_wire(out)
+    assert rebuilt.digest() == g.digest()
+    np.testing.assert_array_equal(rebuilt.u, g.u)
+    np.testing.assert_array_equal(rebuilt.v, g.v)
+    np.testing.assert_array_equal(rebuilt.w, g.w)
+    # Canonical fast path: the arrays are frombuffer views over the one
+    # received frame buffer, not copies.
+    assert rebuilt.u.base is not None
+
+
+def test_from_wire_non_canonical_sender_falls_back_to_canonical_digest():
+    g = Graph.from_edges(6, [(0, 1, 3), (1, 2, 5), (0, 2, 4), (3, 4, 1)])
+    # A sender shipping unsorted, flipped-endpoint arrays: the receiver
+    # must still end at the canonical digest, exactly as the JSON path.
+    secs = (
+        WireSections()
+        .add("u", np.array([2, 1, 4, 1], dtype=np.int64))
+        .add("v", np.array([0, 0, 3, 2], dtype=np.int64))
+        .add("w", np.array([4, 3, 1, 5], dtype=np.int64))
+    )
+    payload = {"num_nodes": 6, SECTIONS_KEY: secs}
+    roundtripped = _read_bytes(encode_bframe(payload))
+    assert Graph.from_wire(roundtripped).digest() == g.digest()
+
+
+def test_fold_sections_matches_classic_json_request():
+    g = gnm_random_graph(40, 100, seed=12)
+    folded = fold_sections({"op": "solve", **g.to_wire()})
+    assert folded["edges"] == _edges(g)
+    assert SECTIONS_KEY not in folded
+    assert json.dumps(folded)  # pure JSON again, serializable
+    # And the response-shape fold: mst_u/mst_v become mst_edges pairs.
+    resp = {
+        "ok": True,
+        SECTIONS_KEY: WireSections()
+        .add("mst_u", g.u[:3])
+        .add("mst_v", g.v[:3]),
+    }
+    assert fold_sections(resp)["mst_edges"] == [
+        [int(a), int(b)] for a, b in zip(g.u[:3], g.v[:3])
+    ]
+
+
+def test_from_edges_generator_input_digest_parity():
+    # Streamed (generator) construction must hash identically to the
+    # materializing list path — int and float weight decks both.
+    triples = [(0, 1, 3), (1, 2, 5), (0, 2, 4), (2, 3, 9), (0, 3, 2)]
+    assert (
+        Graph.from_edges(4, iter(triples)).digest()
+        == Graph.from_edges(4, triples).digest()
+    )
+    ftriples = [(a, b, w + 0.5) for a, b, w in triples]
+    assert (
+        Graph.from_edges(4, (t for t in ftriples)).digest()
+        == Graph.from_edges(4, ftriples).digest()
+    )
+    # Chunk-boundary crossing: a deck larger than one 65536 block.
+    big = [(i, i + 1, i % 97) for i in range(70000)]
+    assert (
+        Graph.from_edges(70001, iter(big)).digest()
+        == Graph.from_edges(70001, big).digest()
+    )
+
+
+# ----------------------------------------------------------------------
+# Wire-format parity through the serving stack
+# ----------------------------------------------------------------------
+def test_solve_parity_json_bframe_graph_path(tmp_path):
+    from distributed_ghs_implementation_tpu.graphs import io as gio
+    from distributed_ghs_implementation_tpu.serve.service import MSTService
+    from distributed_ghs_implementation_tpu.serve.store import (
+        solve_cache_key,
+    )
+
+    g = gnm_random_graph(80, 240, seed=13)
+    path = gio.write_npz(g, str(tmp_path / "g.npz"))
+    svc = MSTService()
+
+    json_req = {"op": "solve", "num_nodes": g.num_nodes,
+                "edges": _edges(g), "edges_out": True}
+    bin_req = _read_bytes(
+        encode_bframe({"op": "solve", **g.to_wire(), "edges_out": True})
+    )
+    path_req = {"op": "solve", "graph_path": path, "edges_out": True}
+
+    r_json = svc.handle(json_req)
+    r_bin = svc.handle(bin_req)
+    r_path = svc.handle(path_req)
+    for r in (r_json, r_bin, r_path):
+        assert r["ok"], r
+    # One identity: same digest, same store key, same answer.
+    assert r_json["digest"] == r_bin["digest"] == r_path["digest"]
+    assert (
+        solve_cache_key(Graph.from_wire(bin_req))
+        == solve_cache_key(Graph.from_edges(g.num_nodes, _edges(g)))
+    )
+    assert (
+        r_json["total_weight"]
+        == r_bin["total_weight"]
+        == r_path["total_weight"]
+    )
+    # The JSON solve populated the store; the other two forms must HIT it
+    # (byte-identical cache keys, not merely equal answers).
+    assert not r_json["cached"]
+    assert r_bin["cached"] and r_path["cached"]
+    # Binary request -> binary egress; JSON request -> folded pairs; the
+    # two egress forms describe the same forest.
+    secs = r_bin[SECTIONS_KEY]
+    pairs = np.stack(
+        [secs.array("mst_u"), secs.array("mst_v")], axis=1
+    ).tolist()
+    assert pairs == r_json["mst_edges"]
+
+
+# ----------------------------------------------------------------------
+# Transport negotiation (caps.wire, echo-on-receipt, fold-at-boundary)
+# ----------------------------------------------------------------------
+def test_hello_advertises_wire_cap_and_env_opt_out(monkeypatch):
+    assert build_hello(0)["caps"]["wire"] is True
+    monkeypatch.setenv("GHS_FLEET_WIRE", "0")
+    assert build_hello(0)["caps"]["wire"] is False
+
+
+def test_encode_for_peer_folds_without_wire_cap():
+    t = PipeTransport(io.BytesIO(), io.BytesIO())
+    g = gnm_random_graph(24, 60, seed=14)
+    payload = {"op": "solve", **g.to_wire()}
+    # Legacy peer: section-bearing payload leaves as classic JSON.
+    meta: dict = {}
+    out = _read_bytes(t.encode_for_peer(dict(payload)), meta=meta)
+    assert not meta["wire"]
+    assert out["edges"] == _edges(g) and SECTIONS_KEY not in out
+    # caps.wire peer: the same payload leaves as a B-frame.
+    t.enable_wire()
+    meta = {}
+    out = _read_bytes(t.encode_for_peer(dict(payload)), meta=meta)
+    assert meta["wire"]
+    assert isinstance(out[SECTIONS_KEY], WireSections)
+    # Sectionless payloads stay plain either way.
+    meta = {}
+    _read_bytes(t.encode_for_peer({"op": "stats"}), meta=meta)
+    assert not meta["wire"]
+
+
+def test_transport_echo_on_receipt_flips_wire_out():
+    g = gnm_random_graph(16, 40, seed=15)
+    inbound = io.BytesIO(encode_bframe({"op": "solve", **g.to_wire()}))
+    t = PipeTransport(io.BytesIO(), inbound)
+    assert not t.wire_out
+    frame = t.recv()
+    assert isinstance(frame[SECTIONS_KEY], WireSections)
+    assert t.wire_out and t.crc_out  # B-frames imply the crc capability
+
+
+# ----------------------------------------------------------------------
+# Binary serve front door (serve --wire binary)
+# ----------------------------------------------------------------------
+def test_serve_frames_binary_round_trip_and_shutdown():
+    from distributed_ghs_implementation_tpu.serve.service import (
+        serve_frames,
+    )
+
+    g = gnm_random_graph(30, 90, seed=16)
+    in_stream = io.BytesIO(
+        encode_bframe({"op": "solve", **g.to_wire(), "edges_out": True})
+        + encode_frame({"op": "shutdown"}, crc=True)
+    )
+    out_stream = io.BytesIO()
+    assert serve_frames(in_stream, out_stream) == 0
+    out_stream.seek(0)
+    meta: dict = {}
+    resp = read_frame(out_stream, meta=meta)
+    assert resp["ok"] and resp["digest"] == g.digest()
+    assert meta["wire"]  # binary in -> binary egress
+    secs = resp[SECTIONS_KEY]
+    assert "mst_u" in secs and "mst_v" in secs
+    bye = read_frame(out_stream)
+    assert bye["ok"] and bye["op"] == "shutdown"
+
+
+def test_serve_frames_json_client_never_sees_a_bframe():
+    from distributed_ghs_implementation_tpu.serve.service import (
+        serve_frames,
+    )
+
+    g = gnm_random_graph(30, 90, seed=16)
+    in_stream = io.BytesIO(
+        encode_frame(
+            {"op": "solve", "num_nodes": g.num_nodes, "edges": _edges(g),
+             "edges_out": True},
+            crc=True,
+        )
+    )
+    out_stream = io.BytesIO()
+    assert serve_frames(in_stream, out_stream) == 0  # clean EOF
+    out_stream.seek(0)
+    meta: dict = {}
+    resp = read_frame(out_stream, meta=meta)
+    assert resp["ok"] and resp["digest"] == g.digest()
+    assert not meta["wire"]  # folded JSON back, per-connection
+    assert resp["mst_edges"] and SECTIONS_KEY not in resp
+
+
+def test_serve_frames_garbled_stream_exits_nonzero():
+    from distributed_ghs_implementation_tpu.serve.service import (
+        serve_frames,
+    )
+
+    out_stream = io.BytesIO()
+    rc = serve_frames(io.BytesIO(b"not a frame at all\n"), out_stream)
+    assert rc == 1
+    out_stream.seek(0)
+    err = read_frame(out_stream)
+    assert not err["ok"] and "bad frame" in err["error"]
+
+
+# ----------------------------------------------------------------------
+# Fuzz: every malformed B-frame is a typed FrameError, allocation bounded
+# ----------------------------------------------------------------------
+def _sample_bframe() -> bytes:
+    g = gnm_random_graph(20, 50, seed=17)
+    return encode_bframe({"op": "solve", **g.to_wire()})
+
+
+def test_bframe_truncation_at_every_byte_is_typed():
+    data = _sample_bframe()
+    # Cut everywhere except the trailing newline (EOF there still parsed
+    # a complete frame — the newline is cosmetic framing).
+    for cut in range(len(data) - 1):
+        stream = io.BytesIO(data[:cut])
+        if cut == 0:
+            assert read_frame(stream) is None  # clean EOF, not an error
+        else:
+            with pytest.raises(FrameError):
+                read_frame(stream)
+
+
+def test_bframe_bit_flip_at_every_byte_is_typed():
+    data = _sample_bframe()
+    for pos in range(len(data) - 1):  # trailing newline is unchecked
+        flipped = bytearray(data)
+        flipped[pos] ^= 0x40
+        try:
+            out = _read_bytes(bytes(flipped))
+        except FrameError:
+            continue  # the contract: typed rejection
+        except Exception as e:  # noqa: BLE001 — anything else is the bug
+            raise AssertionError(
+                f"flip at byte {pos} escaped FrameError: {type(e).__name__}: {e}"
+            ) from e
+        raise AssertionError(
+            f"flip at byte {pos} produced a frame: {type(out).__name__}"
+        )
+
+
+def test_bframe_section_table_must_tile_exactly():
+    u = np.arange(4, dtype=np.int64)
+    header_short = json.dumps(
+        {"op": "solve", SECTIONS_KEY: [["u", "<i8", 3]]},
+        separators=(",", ":"),
+    ).encode()
+    header_long = json.dumps(
+        {"op": "solve", SECTIONS_KEY: [["u", "<i8", 5]]},
+        separators=(",", ":"),
+    ).encode()
+    for header in (header_short, header_long):
+        with pytest.raises(FrameError):
+            _read_bytes(_raw_bframe(header, u.tobytes()))
+
+
+def test_bframe_declared_lengths_bounded_before_allocation():
+    # A corrupt/adversarial header must not size an allocation: the
+    # declared byte counts are checked against max_bytes FIRST...
+    with pytest.raises(FrameError):
+        _read_bytes(
+            b"B20 999999999999 00000000\n", max_bytes=64 * 1024
+        )
+    # ...and an honest-but-oversize frame respects a caller's tighter cap
+    # (max_bytes extends to the section declarations, not just the header).
+    data = _sample_bframe()
+    with pytest.raises(FrameError):
+        _read_bytes(data, max_bytes=100)
+    # Section-table counts are validated against bytes ALREADY read, so a
+    # huge count in a tiny frame is a cheap typed error, not an allocation.
+    header = json.dumps(
+        {"op": "solve", SECTIONS_KEY: [["u", "<i8", 10**12]]},
+        separators=(",", ":"),
+    ).encode()
+    with pytest.raises(FrameError):
+        _read_bytes(_raw_bframe(header, b"\x00" * 16))
+
+
+def test_bframe_rejects_unknown_dtype_and_bad_tables():
+    u = np.arange(2, dtype=np.int64)
+    bad_headers = [
+        # dtype outside the closed whitelist must never size anything.
+        {"op": "solve", SECTIONS_KEY: [["u", "<c16", 1]]},
+        # malformed entry shapes
+        {"op": "solve", SECTIONS_KEY: [["u", "<i8"]]},
+        {"op": "solve", SECTIONS_KEY: [[1, "<i8", 2]]},
+        {"op": "solve", SECTIONS_KEY: [["u", "<i8", -1]]},
+        {"op": "solve", SECTIONS_KEY: [["u", "<i8", True]]},
+        # duplicate section names
+        {"op": "solve", SECTIONS_KEY: [["u", "<i8", 1], ["u", "<i8", 1]]},
+        # a table longer than _MAX_SECTIONS is garbage, not a graph
+        {"op": "solve",
+         SECTIONS_KEY: [[f"s{i}", "<u1", 0] for i in range(65)]
+         + [["u", "<i8", 2]]},
+    ]
+    for head in bad_headers:
+        header = json.dumps(head, separators=(",", ":")).encode()
+        with pytest.raises(FrameError):
+            _read_bytes(_raw_bframe(header, u.tobytes()))
+    # Section bytes with no table to claim them: frame-alignment is gone.
+    header = json.dumps({"op": "solve"}, separators=(",", ":")).encode()
+    with pytest.raises(FrameError):
+        _read_bytes(_raw_bframe(header, u.tobytes()))
+    # A header that is not JSON at all.
+    with pytest.raises(FrameError):
+        _read_bytes(_raw_bframe(b"not json", b""))

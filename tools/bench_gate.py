@@ -216,6 +216,19 @@ KINDS = {
     # field, a worker not re-establishing context — never jitter.
     "orphan_spans": "exact",
     "traces_joined": "exact",
+    # gate-wire-v1 (bench.py --wire): the passthrough split is fully
+    # deterministic — seeded deck digests, a deterministic ring, echo
+    # workers — so a changed count means the router started (or stopped)
+    # decoding edge sections on a dispatch path, or the per-connection
+    # capability negotiation changed. Never jitter. wire_speedup is a
+    # wall-clock ratio (floor, like batch_speedup); the *_per_sec ingest
+    # throughputs need no override — the suffix already floors them.
+    "wire_passthrough": "exact",
+    "wire_fallback_json": "exact",
+    "wire_mixed_passthrough": "exact",
+    "wire_mixed_fallback_json": "exact",
+    "wire_graphs": "exact",
+    "wire_speedup": "throughput",
     # gate-kernel-v1 (tools/profile_levels.py --compare-kernels and
     # bench.py --kernel): the fused-Pallas vs XLA level-kernel ratio is a
     # wall-clock pair — gate as a throughput floor. On hosts where Pallas

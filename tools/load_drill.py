@@ -156,7 +156,16 @@ class Arrival:
     updates: Optional[list] = None  # the update ops for a stream arrival
 
 
+#: Set by main() from ``--wire binary``: solve requests then carry raw
+#: u/v/w B-frame sections (Graph.to_wire) instead of the JSON edges list,
+#: so the whole deck exercises the binary ingest + opaque-passthrough
+#: plane end to end (same digests — the deck stays bit-reproducible).
+_WIRE_BINARY = False
+
+
 def _graph_request(g, cls: str) -> dict:
+    if _WIRE_BINARY:
+        return {"op": "solve", **g.to_wire(), "slo_class": cls}
     return {
         "op": "solve",
         "num_nodes": g.num_nodes,
@@ -931,6 +940,13 @@ def _run_drill(args, resources: dict) -> dict:
             # overhead number.
             transport=args.transport,
             test_echo=args.test_echo,
+            # Mixed-build fleets: the named worker spawns as a legacy
+            # build (hello without caps.wire), so its connection degrades
+            # binary dispatches to folded JSON while siblings stay opaque.
+            worker_env=(
+                {args.wire_legacy_worker: {"GHS_FLEET_WIRE": "0"}}
+                if args.wire_legacy_worker is not None else None
+            ),
             batch_lanes=0 if args.test_echo else args.lanes,
             batch_wait_s=args.batch_wait,
             max_sessions=256,
@@ -1520,6 +1536,24 @@ def _run_drill(args, resources: dict) -> dict:
             "post-window stats from every live worker (counter gates "
             "trustworthy)", not stats_missing,
         ))
+    if fleet_router is not None and args.wire == "binary":
+        wire_pass = fleet_counters.get("fleet.wire.passthrough", 0)
+        wire_fb = fleet_counters.get("fleet.wire.fallback_json", 0)
+        checks.append(
+            ("binary solve dispatches rode the wire plane", wire_pass >= 1)
+        )
+        if args.wire_legacy_worker is None:
+            checks.append(
+                ("no JSON fallback in an all-binary fleet", wire_fb == 0)
+            )
+        else:
+            # The mixed-build contract: the legacy worker's ring share
+            # degrades per connection (folded JSON), never errors — and
+            # the capable workers keep the opaque path.
+            checks.append(
+                ("legacy worker's share degraded to folded JSON",
+                 wire_fb >= 1)
+            )
     if args.update_heavy:
         checks += [
             ("zero errors (stale head re-syncs excluded)", errors == 0),
@@ -1760,6 +1794,12 @@ def _run_drill(args, resources: dict) -> dict:
         "counts": counts,
         "chaos": "off" if args.no_chaos else ("heavy" if args.chaos else "mid"),
     }
+    if args.wire != "json":
+        # Only stamped off the default so existing baselines' config
+        # blocks keep matching byte-for-byte.
+        config["wire"] = args.wire
+        if args.wire_legacy_worker is not None:
+            config["wire_legacy_worker"] = args.wire_legacy_worker
     if args.oversize_heavy:
         config["oversize_heavy"] = True
         config["sharded_lane"] = bool(args.sharded_lane)
@@ -2793,6 +2833,22 @@ def main(argv=None) -> int:
                    help="with --fleet: spawn jax-free echo workers (canned "
                    "answers, full transport/failover fidelity) — the CI "
                    "TCP kill drill's mode")
+    p.add_argument("--wire", choices=("json", "binary"), default="json",
+                   help="solve-request carrier: 'binary' builds the deck "
+                   "as B-frame section requests (Graph.to_wire — raw "
+                   "little-endian u/v/w, zero-copy ingest) so the drill "
+                   "exercises binary ingest and the router's opaque "
+                   "passthrough end to end; digests (and so routing, "
+                   "caching, and the deck's reproducibility) are "
+                   "unchanged (docs/FLEET.md \"Binary wire\")")
+    p.add_argument("--wire-legacy-worker", type=int, default=None,
+                   metavar="K",
+                   help="with --fleet --wire binary: spawn worker K as a "
+                   "legacy build (GHS_FLEET_WIRE=0 — its hello carries no "
+                   "caps.wire), so the drill proves the mixed-build "
+                   "contract: K's ring share degrades to folded JSON per "
+                   "connection, siblings stay opaque, zero lost accepted "
+                   "queries")
     p.add_argument("--kill-router", action="store_true",
                    help="with --fleet --test-echo --transport tcp: crash "
                    "the ROUTER mid-window with accepted work outstanding "
@@ -2880,6 +2936,14 @@ def main(argv=None) -> int:
     args = p.parse_args(argv)
     if args.ramp:
         args.arrival = "ramp"
+    global _WIRE_BINARY
+    _WIRE_BINARY = args.wire == "binary"
+    if args.wire_legacy_worker is not None:
+        if args.wire != "binary":
+            p.error("--wire-legacy-worker needs --wire binary")
+        if not args.fleet or not 0 <= args.wire_legacy_worker < args.fleet:
+            p.error("--wire-legacy-worker K needs --fleet N with "
+                    "0 <= K < N")
     if args.kill_worker is not None and (
         not args.fleet or not 0 <= args.kill_worker < args.fleet
     ):
